@@ -1,0 +1,31 @@
+"""TPU-native randomized Byzantine consensus simulation framework.
+
+Built from scratch to the capability surface of ``sithu/ByzantineRandomizedConsensus``
+(see SURVEY.md — the reference mount was empty, so the blueprint derives from
+BASELINE.json's north star and the published algorithms: Ben-Or 1983, Bracha 1987,
+Cachin-Kursawe-Shoup 2005).
+
+Layering (SURVEY.md §1):
+
+- ``core``     — front-end object model: Replica, Network, Adversary, Simulator
+- ``models``   — protocol round logic: Ben-Or, Bracha (RBC count-level), coins
+- ``ops``      — kernels: the counter-based PRF, scheduling masks, quorum tallies
+- ``backends`` — the SimulatorBackend seam: ``cpu`` oracle loop, ``jax`` vectorized
+- ``utils``    — metrics/histograms, sweep checkpointing
+"""
+
+from byzantinerandomizedconsensus_tpu.config import SimConfig, PRESETS, preset
+from byzantinerandomizedconsensus_tpu.backends.base import get_backend, register_backend
+from byzantinerandomizedconsensus_tpu.core.simulator import Simulator
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "SimConfig",
+    "PRESETS",
+    "preset",
+    "Simulator",
+    "get_backend",
+    "register_backend",
+    "__version__",
+]

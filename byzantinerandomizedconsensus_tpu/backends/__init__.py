@@ -1,0 +1,48 @@
+"""Backend registry: ``cpu`` (oracle, default), ``numpy`` (vectorized host),
+``jax`` (jit/TPU), ``jax_cpu`` (jit pinned to host devices, for CI bit-matching)."""
+
+from byzantinerandomizedconsensus_tpu.backends.base import (
+    SimResult,
+    SimulatorBackend,
+    available_backends,
+    get_backend,
+    register_backend,
+)
+
+
+def _cpu():
+    from byzantinerandomizedconsensus_tpu.backends.cpu import CpuBackend
+
+    return CpuBackend()
+
+
+def _numpy():
+    from byzantinerandomizedconsensus_tpu.backends.numpy_backend import NumpyBackend
+
+    return NumpyBackend()
+
+
+def _jax():
+    from byzantinerandomizedconsensus_tpu.backends.jax_backend import JaxBackend
+
+    return JaxBackend()
+
+
+def _jax_cpu():
+    from byzantinerandomizedconsensus_tpu.backends.jax_backend import JaxBackend
+
+    return JaxBackend(device="cpu")
+
+
+register_backend("cpu", _cpu)
+register_backend("numpy", _numpy)
+register_backend("jax", _jax)
+register_backend("jax_cpu", _jax_cpu)
+
+__all__ = [
+    "SimResult",
+    "SimulatorBackend",
+    "available_backends",
+    "get_backend",
+    "register_backend",
+]

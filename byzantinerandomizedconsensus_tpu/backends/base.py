@@ -1,0 +1,77 @@
+"""The SimulatorBackend seam (BASELINE.json:5; SURVEY.md §1).
+
+The front-end (Replica/Adversary/Network object model, CLI, metrics) talks to a
+backend through one call: ``run(cfg, inst_ids) -> SimResult``. The CPU oracle loop is
+the default backend; the JAX/TPU backend plugs in behind the same boundary. Because
+instance ``i``'s trajectory depends only on ``(cfg, seed, i)`` (spec §1), ``inst_ids``
+may be any subset — the sampled bit-match harness relies on this.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu.config import SimConfig
+
+
+@dataclasses.dataclass
+class SimResult:
+    """Per-instance outputs (spec §1): the bit-match surface."""
+
+    config: SimConfig
+    inst_ids: np.ndarray   # (I,) int64 — which instances these rows are
+    rounds: np.ndarray     # (I,) int32 — rounds to termination (== round_cap if capped)
+    decision: np.ndarray   # (I,) uint8 — 0/1 decided value, 2 = undecided (overflow)
+    wall_s: float = 0.0
+
+    @property
+    def instances_per_sec(self) -> float:
+        return len(self.inst_ids) / self.wall_s if self.wall_s > 0 else float("inf")
+
+
+class SimulatorBackend(abc.ABC):
+    name: str = "?"
+
+    @abc.abstractmethod
+    def run(self, cfg: SimConfig, inst_ids: Optional[np.ndarray] = None) -> SimResult:
+        """Simulate the given instances (default: all of them) to termination."""
+
+    @staticmethod
+    def _resolve_inst_ids(cfg: SimConfig, inst_ids) -> np.ndarray:
+        if inst_ids is None:
+            return np.arange(cfg.instances, dtype=np.int64)
+        ids = np.asarray(inst_ids, dtype=np.int64)
+        if ids.size and (ids.min() < 0 or ids.max() >= cfg.instances):
+            raise ValueError("inst_ids out of range for config")
+        return ids
+
+    def timed_run(self, cfg: SimConfig, inst_ids=None) -> SimResult:
+        t0 = time.perf_counter()
+        res = self.run(cfg, inst_ids)
+        res.wall_s = time.perf_counter() - t0
+        return res
+
+
+_REGISTRY: dict[str, Callable[[], SimulatorBackend]] = {}
+_INSTANCES: dict[str, SimulatorBackend] = {}
+
+
+def register_backend(name: str, factory: Callable[[], SimulatorBackend]) -> None:
+    _REGISTRY[name] = factory
+
+
+def get_backend(name: str) -> SimulatorBackend:
+    if name not in _INSTANCES:
+        if name not in _REGISTRY:
+            raise KeyError(f"unknown backend {name!r}; known: {sorted(_REGISTRY)}")
+        _INSTANCES[name] = _REGISTRY[name]()
+    return _INSTANCES[name]
+
+
+def available_backends() -> list[str]:
+    return sorted(_REGISTRY)

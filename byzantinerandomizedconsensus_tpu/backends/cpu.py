@@ -1,0 +1,95 @@
+"""CPU oracle backend (SURVEY.md §7 step 2) — the default, bit-exact reference.
+
+Per-instance, per-replica object loop over the front-end model (Replica, Network,
+Adversary). Correctness-first and independent of the vectorized models/ code: this is
+the arbiter implementation the JAX/TPU backend must bit-match (BASELINE.json:5).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu.backends.base import SimResult, SimulatorBackend
+from byzantinerandomizedconsensus_tpu.config import SimConfig
+from byzantinerandomizedconsensus_tpu.core.adversary import make_adversary
+from byzantinerandomizedconsensus_tpu.core.network import Network
+from byzantinerandomizedconsensus_tpu.core.replica import Replica
+from byzantinerandomizedconsensus_tpu.ops import prf
+
+
+class CpuBackend(SimulatorBackend):
+    name = "cpu"
+
+    def run(self, cfg: SimConfig, inst_ids: Optional[np.ndarray] = None) -> SimResult:
+        cfg = cfg.validate()
+        ids = self._resolve_inst_ids(cfg, inst_ids)
+        rounds = np.empty(len(ids), dtype=np.int32)
+        decision = np.empty(len(ids), dtype=np.uint8)
+        for k, i in enumerate(ids):
+            rounds[k], decision[k] = self._run_instance(cfg, int(i))
+        return SimResult(config=cfg, inst_ids=ids, rounds=rounds, decision=decision)
+
+    @staticmethod
+    def _invalid(cfg: SimConfig, t: int, values: np.ndarray, g_prev) -> np.ndarray:
+        """Per-sender invalidity per spec §5.1b, from the previous step's global
+        live-valid counts (g0, g1). Independent scalar re-implementation of
+        models/validation.py for the oracle cross-check."""
+        n, f = cfg.n, cfg.f
+        q = n - f
+        g0, g1 = g_prev
+        if t == 1:
+            ok = {1: g1 >= (q + 1) // 2, 0: g0 >= q // 2 + 1, 2: True}
+        else:
+            lo = max(0, q - g0, q - n // 2)
+            hi = min(g1, q, n // 2)
+            ok = {1: g1 >= n // 2 + 1, 0: g0 >= n // 2 + 1, 2: lo <= hi}
+        return np.array([not ok[int(v)] for v in values], dtype=bool)
+
+    @staticmethod
+    def _initial_estimates(cfg: SimConfig, instance: int) -> np.ndarray:
+        replica = np.arange(cfg.n, dtype=np.uint32)
+        if cfg.init == "all0":
+            return np.zeros(cfg.n, dtype=np.uint8)
+        if cfg.init == "all1":
+            return np.ones(cfg.n, dtype=np.uint8)
+        if cfg.init == "split":
+            return (replica & 1).astype(np.uint8)
+        return prf.prf_bit(cfg.seed, instance, 0, 0, replica, 0, prf.INIT_EST, xp=np).astype(np.uint8)
+
+    def _run_instance(self, cfg: SimConfig, instance: int):
+        est0 = self._initial_estimates(cfg, instance)
+        replicas = [Replica(cfg, j, est0[j]) for j in range(cfg.n)]
+        net = Network(cfg, cfg.seed, instance)
+        adv = make_adversary(cfg, cfg.seed, instance)
+        correct = [j for j in range(cfg.n) if not adv.faulty[j]]
+
+        for r in range(cfg.round_cap):
+            g_prev = None  # global live-valid counts of the previous step (bracha)
+            for t in range(cfg.steps_per_round):
+                honest = np.array([rep.send_value(t) for rep in replicas], dtype=np.uint8)
+                values, silent, bias = adv.inject(r, t, honest)
+                if cfg.protocol == "bracha":
+                    # spec §5.1b: invalid messages are silenced before delivery.
+                    if t > 0:
+                        silent = silent | self._invalid(cfg, t, values, g_prev)
+                    live = ~silent
+                    g_prev = (int(np.count_nonzero(live & (values == 0))),
+                              int(np.count_nonzero(live & (values == 1))))
+                vmat, mask = net.deliver(r, t, values, silent, bias)
+                for rep in replicas:
+                    rep.on_deliver(t, vmat[rep.index], mask[rep.index])
+            if cfg.coin == "shared":
+                shared = int(prf.prf_bit(cfg.seed, instance, r, prf.COIN_STEP, 0, 0,
+                                         prf.SHARED_COIN, xp=np))
+                coin = [shared] * cfg.n
+            else:
+                replica = np.arange(cfg.n, dtype=np.uint32)
+                coin = prf.prf_bit(cfg.seed, instance, r, prf.COIN_STEP, replica, 0,
+                                   prf.LOCAL_COIN, xp=np)
+            for rep in replicas:
+                rep.end_round(int(coin[rep.index]))
+            if all(replicas[j].decided for j in correct):
+                return r + 1, replicas[correct[0]].decided_val
+        return cfg.round_cap, 2

@@ -1,0 +1,103 @@
+"""JAX/TPU backend (SURVEY.md §7 step 4) — the performance core.
+
+One instance-chunk is simulated by a single jit'd ``lax.while_loop`` whose body is the
+vectorized round (models/benor.py / models/bracha.py with ``xp = jax.numpy``): mask
+generation from the PRF, tallies, coin, decided-mask-frozen state update. Control flow
+is compiler-friendly: static shapes, no data-dependent Python branching; the loop
+predicate is ``any instance still undecided and round < cap`` (SURVEY.md §7
+hard-part 2 — cost per chunk is the max rounds in the chunk, with the cap and the
+overflow bucket keeping CPU/TPU agreement on capped instances).
+
+Chunking bounds the O(B·n²) mask transient (hard-part 3); the last chunk is padded to
+the chunk size so XLA compiles exactly one program per config.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from byzantinerandomizedconsensus_tpu.backends.base import SimResult, SimulatorBackend
+from byzantinerandomizedconsensus_tpu.config import SimConfig
+from byzantinerandomizedconsensus_tpu.models import benor, bracha, state as state_mod
+from byzantinerandomizedconsensus_tpu.models.adversaries import AdversaryModel
+
+
+def _run_chunk(cfg: SimConfig, inst_ids: jnp.ndarray):
+    """Simulate one padded chunk; returns (rounds (B,), decision (B,))."""
+    round_body = benor.round_body if cfg.protocol == "benor" else bracha.round_body
+    adv = AdversaryModel(cfg)
+    setup = adv.setup(cfg.seed, inst_ids, xp=jnp)
+    faulty = setup["faulty"]
+    st = state_mod.init_state(cfg, cfg.seed, inst_ids, xp=jnp)
+    done_at = jnp.full(inst_ids.shape[0], -1, dtype=jnp.int32)
+
+    def cond(carry):
+        r, _, done_at = carry
+        return (r < cfg.round_cap) & ~jnp.all(done_at >= 0)
+
+    def body(carry):
+        r, st, done_at = carry
+        st = round_body(cfg, cfg.seed, inst_ids, r, st, adv, setup, xp=jnp)
+        done_now = state_mod.all_correct_decided(st, faulty, xp=jnp)
+        done_at = jnp.where((done_at < 0) & done_now, r + 1, done_at)
+        return r + 1, st, done_at
+
+    _, st, done_at = jax.lax.while_loop(cond, body, (jnp.int32(0), st, done_at))
+    done = done_at >= 0
+    rounds = jnp.where(done, done_at, cfg.round_cap).astype(jnp.int32)
+    decision = state_mod.extract_decision(st, faulty, done, xp=jnp)
+    return rounds, decision
+
+
+class JaxBackend(SimulatorBackend):
+    """``device='tpu'|'cpu'|None`` pins the computation; None = JAX default device."""
+
+    name = "jax"
+
+    def __init__(self, chunk_bytes: int = 1 << 30, max_chunk: int = 1 << 14, device=None):
+        self.chunk_bytes = chunk_bytes
+        self.max_chunk = max_chunk
+        self.device = device
+        self._compiled = {}
+
+    def _chunk_size(self, cfg: SimConfig) -> int:
+        per_inst = cfg.n * cfg.n * 4 * 4  # ~4 live (B,n,n) u32-sized transients
+        return max(1, min(self.max_chunk, self.chunk_bytes // per_inst))
+
+    def _fn(self, cfg: SimConfig):
+        if cfg not in self._compiled:
+            self._compiled[cfg] = jax.jit(partial(_run_chunk, cfg))
+        return self._compiled[cfg]
+
+    def _device_ctx(self):
+        if self.device is None:
+            import contextlib
+
+            return contextlib.nullcontext()
+        return jax.default_device(jax.devices(self.device)[0])
+
+    def run(self, cfg: SimConfig, inst_ids: Optional[np.ndarray] = None) -> SimResult:
+        cfg = cfg.validate()
+        ids = self._resolve_inst_ids(cfg, inst_ids)
+        chunk = min(self._chunk_size(cfg), max(1, len(ids)))
+        fn = self._fn(cfg)
+
+        rounds_out = np.empty(len(ids), dtype=np.int32)
+        decision_out = np.empty(len(ids), dtype=np.uint8)
+        for lo in range(0, len(ids), chunk):
+            hi = min(lo + chunk, len(ids))
+            cids = ids[lo:hi]
+            if len(cids) < chunk:  # pad to the compiled shape; padded rows discarded
+                cids = np.concatenate([cids, np.full(chunk - len(cids), cids[-1])])
+            with self._device_ctx():
+                r, d = fn(jnp.asarray(cids, dtype=jnp.uint32))
+            rounds_out[lo:hi] = np.asarray(r)[: hi - lo]
+            decision_out[lo:hi] = np.asarray(d)[: hi - lo]
+
+        return SimResult(config=cfg, inst_ids=ids, rounds=rounds_out, decision=decision_out)

@@ -1,0 +1,58 @@
+"""Numpy vectorized backend — the models/ round logic run eagerly on host.
+
+Shares the array-level round bodies with the JAX backend (xp=numpy vs xp=jax.numpy),
+which triangulates the bit-match: ``cpu`` (independent per-replica oracle) vs
+``numpy`` checks the vectorized *logic*; ``numpy`` vs ``jax`` checks the *compiler*
+path (jit, XLA sort, dtype semantics).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu.backends.base import SimResult, SimulatorBackend
+from byzantinerandomizedconsensus_tpu.config import SimConfig
+from byzantinerandomizedconsensus_tpu.models import benor, bracha, state as state_mod
+from byzantinerandomizedconsensus_tpu.models.adversaries import AdversaryModel
+
+
+class NumpyBackend(SimulatorBackend):
+    name = "numpy"
+
+    def __init__(self, chunk_bytes: int = 1 << 28):
+        self.chunk_bytes = chunk_bytes
+
+    def _chunk_size(self, cfg: SimConfig) -> int:
+        per_inst = cfg.n * cfg.n * 4 * 4  # ~4 live (B,n,n) u32-sized transients
+        return max(1, min(1 << 14, self.chunk_bytes // per_inst))
+
+    def run(self, cfg: SimConfig, inst_ids: Optional[np.ndarray] = None) -> SimResult:
+        cfg = cfg.validate()
+        ids = self._resolve_inst_ids(cfg, inst_ids)
+        round_body = benor.round_body if cfg.protocol == "benor" else bracha.round_body
+        adv = AdversaryModel(cfg)
+        chunk = self._chunk_size(cfg)
+
+        rounds_out = np.full(len(ids), cfg.round_cap, dtype=np.int32)
+        decision_out = np.full(len(ids), 2, dtype=np.uint8)
+
+        for lo in range(0, len(ids), chunk):
+            sl = slice(lo, min(lo + chunk, len(ids)))
+            cids = ids[sl]
+            setup = adv.setup(cfg.seed, cids, xp=np)
+            st = state_mod.init_state(cfg, cfg.seed, cids, xp=np)
+            faulty = setup["faulty"]
+            done_at = np.full(len(cids), -1, dtype=np.int32)
+            for r in range(cfg.round_cap):
+                if np.all(done_at >= 0):
+                    break
+                st = round_body(cfg, cfg.seed, cids, r, st, adv, setup, xp=np)
+                done_now = state_mod.all_correct_decided(st, faulty, xp=np)
+                done_at = np.where((done_at < 0) & done_now, r + 1, done_at)
+            done = done_at >= 0
+            rounds_out[sl] = np.where(done, done_at, cfg.round_cap)
+            decision_out[sl] = state_mod.extract_decision(st, faulty, done, xp=np)
+
+        return SimResult(config=cfg, inst_ids=ids, rounds=rounds_out, decision=decision_out)

@@ -1,0 +1,132 @@
+"""CLI / sweep driver (SURVEY.md C9): select a benchmark config or custom parameters,
+run on a chosen backend, emit JSON summaries and histograms.
+
+Usage examples:
+    python -m byzantinerandomizedconsensus_tpu.cli run --preset config4 --backend jax
+    python -m byzantinerandomizedconsensus_tpu.cli run --protocol bracha -n 64 -f 21 \
+        --instances 1000 --adversary byzantine --coin shared --backend numpy
+    python -m byzantinerandomizedconsensus_tpu.cli sweep --out sweep_out --backend jax
+    python -m byzantinerandomizedconsensus_tpu.cli bitmatch --preset config2 --samples 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu import PRESETS, SimConfig, Simulator, preset
+from byzantinerandomizedconsensus_tpu.utils import metrics, sweep
+
+
+def _add_config_args(p: argparse.ArgumentParser, default_backend: str = "cpu") -> None:
+    p.add_argument("--preset", choices=sorted(PRESETS), default=None)
+    p.add_argument("--protocol", choices=["benor", "bracha"], default=None)
+    p.add_argument("-n", type=int, default=None)
+    p.add_argument("-f", type=int, default=None)
+    p.add_argument("--instances", type=int, default=None)
+    p.add_argument("--adversary", choices=["none", "crash", "byzantine", "adaptive"],
+                   default=None)
+    p.add_argument("--coin", choices=["local", "shared"], default=None)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--round-cap", type=int, default=None)
+    p.add_argument("--init", choices=["random", "all0", "all1", "split"], default=None)
+    p.add_argument("--backend", default=default_backend,
+                   help="cpu (oracle) | numpy | jax | jax_cpu")
+
+
+def _config_from(args) -> SimConfig:
+    # Every explicitly-passed flag applies — also on top of a preset.
+    overrides = {k: v for k, v in [
+        ("protocol", args.protocol), ("n", args.n), ("f", args.f),
+        ("instances", args.instances), ("adversary", args.adversary),
+        ("coin", args.coin), ("seed", args.seed), ("round_cap", args.round_cap),
+        ("init", args.init),
+    ] if v is not None}
+    if args.preset:
+        return preset(args.preset, **overrides)
+    defaults = dict(protocol="benor", n=4, f=1, instances=1, adversary="none",
+                    coin="local", seed=0, round_cap=256, init="random")
+    defaults.update(overrides)
+    return SimConfig(**defaults).validate()
+
+
+def cmd_run(args) -> int:
+    cfg = _config_from(args)
+    res = Simulator(cfg, args.backend).run()
+    out = metrics.summary(res)
+    out["backend"] = args.backend
+    if args.hist:
+        out["round_histogram"] = metrics.round_histogram(res).tolist()
+    print(json.dumps(out))
+    return 0
+
+
+def cmd_bitmatch(args) -> int:
+    """Sampled CPU-oracle vs accelerated-backend bit-match check."""
+    if args.backend == "cpu":
+        print("bitmatch compares the cpu oracle against an accelerated backend; "
+              "pass --backend numpy|jax|jax_cpu", file=sys.stderr)
+        return 2
+    cfg = _config_from(args)
+    rng = np.random.default_rng(cfg.seed)
+    ids = np.unique(rng.integers(0, cfg.instances, size=args.samples))
+    ref = Simulator(cfg, "cpu").run(ids)
+    got = Simulator(cfg, args.backend).run(ids)
+    ok = bool(np.array_equal(ref.rounds, got.rounds)
+              and np.array_equal(ref.decision, got.decision))
+    print(json.dumps({
+        "bitmatch": ok,
+        "backend": args.backend,
+        "samples": ids.tolist(),
+        "oracle_rounds": ref.rounds.tolist(),
+        "backend_rounds": got.rounds.tolist(),
+    }))
+    return 0 if ok else 1
+
+
+def cmd_sweep(args) -> int:
+    out = sweep.run_sweep(
+        pathlib.Path(args.out), backend=args.backend,
+        ns=tuple(int(x) for x in args.ns) if args.ns else sweep.SWEEP_NS,
+        instances=args.instances, seed=args.seed,
+        shard_instances=args.shard_instances, coin=args.coin,
+        progress=lambda msg: print(msg, file=sys.stderr),
+    )
+    print(json.dumps(out))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="byzantinerandomizedconsensus_tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p_run = sub.add_parser("run", help="run one config to termination")
+    _add_config_args(p_run)
+    p_run.add_argument("--hist", action="store_true", help="include the round histogram")
+    p_run.set_defaults(fn=cmd_run)
+
+    p_bm = sub.add_parser("bitmatch", help="sampled oracle-vs-backend bit-match")
+    _add_config_args(p_bm, default_backend="jax")
+    p_bm.add_argument("--samples", type=int, default=4)
+    p_bm.set_defaults(fn=cmd_bitmatch)
+
+    p_sw = sub.add_parser("sweep", help="config-5 adaptive sweep (resumable)")
+    p_sw.add_argument("--out", default="sweep_out")
+    p_sw.add_argument("--backend", default="jax")
+    p_sw.add_argument("--ns", nargs="*", type=int, default=None)
+    p_sw.add_argument("--instances", type=int, default=sweep.SWEEP_INSTANCES)
+    p_sw.add_argument("--shard-instances", type=int, default=500)
+    p_sw.add_argument("--seed", type=int, default=0)
+    p_sw.add_argument("--coin", choices=["local", "shared"], default="shared")
+    p_sw.set_defaults(fn=cmd_sweep)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,92 @@
+"""SimConfig and the five benchmark presets (spec/PROTOCOL.md §7, BASELINE.json:6-12)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Literal
+
+from byzantinerandomizedconsensus_tpu.ops import prf
+
+Protocol = Literal["benor", "bracha"]
+AdversaryKind = Literal["none", "crash", "byzantine", "adaptive"]
+CoinKind = Literal["local", "shared"]
+InitKind = Literal["random", "all0", "all1", "split"]
+
+
+@dataclasses.dataclass(frozen=True)
+class SimConfig:
+    protocol: Protocol = "benor"
+    n: int = 4
+    f: int = 1
+    instances: int = 1
+    adversary: AdversaryKind = "none"
+    coin: CoinKind = "local"
+    seed: int = 0
+    round_cap: int = 256
+    crash_window: int = 4
+    init: InitKind = "random"
+
+    @property
+    def steps_per_round(self) -> int:
+        return 2 if self.protocol == "benor" else 3
+
+    @property
+    def lying_adversary(self) -> bool:
+        """Selects Ben-Or Protocol B thresholds (spec §5.1)."""
+        return self.adversary in ("byzantine", "adaptive")
+
+    def validate(self) -> "SimConfig":
+        if not (0 < self.n <= prf.MAX_N):
+            raise ValueError(f"n={self.n} out of range (1..{prf.MAX_N})")
+        if not (0 <= self.f < self.n):
+            raise ValueError(f"f={self.f} out of range for n={self.n}")
+        if not (0 < self.instances <= prf.MAX_INSTANCES):
+            raise ValueError(f"instances={self.instances} out of range (1..{prf.MAX_INSTANCES})")
+        if not (0 < self.round_cap <= prf.MAX_ROUNDS):
+            raise ValueError(f"round_cap={self.round_cap} out of range (1..{prf.MAX_ROUNDS})")
+        # Resilience bounds (spec §5.1/§5.2): benor Protocol A needs n > 2f, benor
+        # Protocol B (lying adversaries) needs n > 5f, bracha needs n > 3f (the
+        # n > 3f Byzantine benchmark pairing is Bracha — config 3).
+        if self.protocol == "bracha":
+            if 3 * self.f >= self.n:
+                raise ValueError(f"bracha requires n > 3f (got n={self.n}, f={self.f})")
+        elif self.lying_adversary:
+            if 5 * self.f >= self.n:
+                raise ValueError(
+                    f"benor+{self.adversary} requires n > 5f (got n={self.n}, f={self.f}); "
+                    "use protocol='bracha' for n > 3f resilience"
+                )
+        elif 2 * self.f >= self.n:
+            raise ValueError(f"benor requires n > 2f (got n={self.n}, f={self.f})")
+        return self
+
+
+def _f_opt(n: int) -> int:
+    return (n - 1) // 3
+
+
+# Benchmark presets (BASELINE.json:6-12; pinned in spec/PROTOCOL.md §7).
+PRESETS: dict[str, SimConfig] = {
+    "config1": SimConfig(protocol="benor", n=4, f=1, instances=1, adversary="none", coin="local"),
+    "config2": SimConfig(protocol="benor", n=64, f=21, instances=10_000, adversary="crash", coin="local"),
+    "config3": SimConfig(protocol="bracha", n=256, f=85, instances=1_000, adversary="byzantine", coin="shared"),
+    "config4": SimConfig(protocol="bracha", n=512, f=170, instances=100_000, adversary="none", coin="shared"),
+}
+
+# Config 5 is a sweep (spec §7): bracha, adaptive adversary, shared coin.
+SWEEP_NS = (128, 256, 384, 512, 640, 768, 896, 1024)
+SWEEP_INSTANCES = 2_000
+
+
+def sweep_point(n: int, seed: int = 0, instances: int = SWEEP_INSTANCES) -> SimConfig:
+    return SimConfig(
+        protocol="bracha", n=n, f=_f_opt(n), instances=instances,
+        adversary="adaptive", coin="shared", seed=seed,
+    ).validate()
+
+
+def preset(name: str, **overrides) -> SimConfig:
+    cfg = PRESETS[name]
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    return cfg.validate()

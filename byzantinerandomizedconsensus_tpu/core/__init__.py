@@ -1,0 +1,7 @@
+"""Front-end object model: Replica, Network, Adversary, Simulator (SURVEY.md §1).
+
+These classes mirror the reference's surface (BASELINE.json:5 — "the existing
+Replica/Adversary/Network classes stay as the front-end") and double as the CPU
+oracle: an implementation of spec/PROTOCOL.md that is *independent* of the vectorized
+models/ logic, so the bit-match test checks two genuinely different codepaths.
+"""

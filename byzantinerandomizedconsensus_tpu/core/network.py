@@ -1,0 +1,50 @@
+"""Network — in-process message transport for one instance (SURVEY.md C2; spec §4).
+
+Materialises the per-step (n_recv, n_send) delivery mask: each receiver gets exactly
+the n-f live senders whose combined scheduling key is smallest. Implemented here
+*independently* of ops/masks.py (row-wise numpy.partition vs the vectorized sort) so
+the oracle cross-checks the vectorized selection semantics.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu.ops import prf
+
+
+class Network:
+    def __init__(self, cfg, seed: int, instance: int):
+        self.cfg = cfg
+        self.seed = seed
+        self.instance = instance
+        self._recv = np.arange(cfg.n, dtype=np.uint32)
+
+    def delivery_mask(self, rnd: int, t: int, silent: np.ndarray, bias: np.ndarray) -> np.ndarray:
+        """(n, n) bool delivered(recv, send). ``silent``: (n,) bool; ``bias``: (n, n)
+        or (1, n) uint32 per-(recv, send) bias bits (spec §4/§6.4)."""
+        n, f = self.cfg.n, self.cfg.f
+        mask = np.empty((n, n), dtype=bool)
+        send = self._recv
+        for v in range(n):
+            sched = prf.prf_u32(self.seed, self.instance, rnd, t,
+                                np.uint32(v), send, prf.SCHED, xp=np)
+            bias_row = bias[0] if bias.shape[0] == 1 else bias[v]
+            combined = (
+                (silent.astype(np.uint32) << np.uint32(31))
+                | (bias_row.astype(np.uint32) << np.uint32(30))
+                | (((sched >> np.uint32(12)) & np.uint32(0xFFFFF)) << np.uint32(10))
+                | send
+            )
+            combined[v] = v  # own message always delivered (spec §4)
+            kth = np.partition(combined, n - f - 1)[n - f - 1]
+            mask[v] = (combined <= kth) & ~silent
+            mask[v, v] = True  # own delivery is exempt from silence (spec §4)
+        return mask
+
+    def deliver(self, rnd: int, t: int, values, silent: np.ndarray, bias: np.ndarray):
+        """Returns (vmat (n_recv, n_send) uint8, mask (n_recv, n_send) bool)."""
+        n = self.cfg.n
+        values = np.asarray(values, dtype=np.uint8)
+        vmat = np.broadcast_to(values, (n, n)) if values.ndim == 1 else values
+        return vmat, self.delivery_mask(rnd, t, silent, bias)

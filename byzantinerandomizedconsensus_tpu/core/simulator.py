@@ -1,0 +1,24 @@
+"""Simulator — the user-facing front end (SURVEY.md §3.1 entry point).
+
+Selects a backend through the SimulatorBackend seam and returns SimResult plus derived
+metrics. ``backend='cpu'`` is the default, as in the north star (BASELINE.json:5 —
+"the existing CPU loop remains the default").
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu.backends.base import SimResult, get_backend
+from byzantinerandomizedconsensus_tpu.config import SimConfig
+
+
+class Simulator:
+    def __init__(self, cfg: SimConfig, backend: str = "cpu"):
+        self.cfg = cfg.validate()
+        self.backend = get_backend(backend)
+
+    def run(self, inst_ids: Optional[np.ndarray] = None) -> SimResult:
+        return self.backend.timed_run(self.cfg, inst_ids)

@@ -1,0 +1,7 @@
+"""Vectorized protocol round logic — array-level, generic over numpy / jax.numpy.
+
+These functions implement spec/PROTOCOL.md §5-§6 over struct-of-arrays state with a
+leading instance-batch axis. They are consumed by the ``numpy`` and ``jax`` backends;
+the ``cpu`` oracle backend is an independent per-replica implementation of the same
+spec (``core/replica.py``) used to cross-check this one.
+"""

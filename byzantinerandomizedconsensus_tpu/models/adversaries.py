@@ -1,0 +1,120 @@
+"""Vectorized adversaries-as-data (spec/PROTOCOL.md §6; SURVEY.md C3, §7 step 5).
+
+An adversary is (a) a static per-instance setup — faulty set, crash rounds — and (b) a
+pure per-step injection function mapping honest outgoing values to
+``(values, silent, bias)``:
+
+- ``values``: (B, n) common per-sender wire values, or (B, n, n) per-(recv, send) for
+  the plain-Ben-Or Byzantine equivocation path (spec §6.3);
+- ``silent``: (B, n) bool sender silence flags;
+- ``bias``:   (B, 1, n) or (B, n, n) scheduling-bias bits (spec §4 bit 30).
+
+Everything is a pure function of (seed, instance, round, step, current honest votes) —
+jit-compatible, and the adaptive adversary provably sees only round-t state, never
+future coins (SURVEY.md §4.5).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu.ops import prf
+
+
+def faulty_mask(cfg, seed, inst_ids, xp=np):
+    """(B, n) bool — the f replicas with smallest combined FAULTY_RANK keys (spec §3.2)."""
+    B = inst_ids.shape[0]
+    if cfg.adversary == "none" or cfg.f == 0:
+        return xp.zeros((B, cfg.n), dtype=bool)
+    replica = xp.arange(cfg.n, dtype=xp.uint32)[None, :]
+    rank = prf.prf_u32(seed, xp.asarray(inst_ids, dtype=xp.uint32)[:, None],
+                       0, 0, replica, 0, prf.FAULTY_RANK, xp=xp)
+    key = (rank & xp.uint32(0xFFFFFC00)) | replica
+    if xp is np:
+        kth = np.partition(key, cfg.f - 1, axis=-1)[..., cfg.f - 1]
+    else:
+        kth = xp.sort(key, axis=-1)[..., cfg.f - 1]
+    return key <= kth[..., None]
+
+
+def crash_rounds(cfg, seed, inst_ids, xp=np):
+    """(B, n) int32 crash round per replica (only meaningful where faulty; spec §3.3)."""
+    replica = xp.arange(cfg.n, dtype=xp.uint32)[None, :]
+    c = prf.prf_u32(seed, xp.asarray(inst_ids, dtype=xp.uint32)[:, None],
+                    0, 0, replica, 0, prf.CRASH_ROUND, xp=xp)
+    return (c % xp.uint32(cfg.crash_window)).astype(xp.int32)
+
+
+class AdversaryModel:
+    """Static dispatch on cfg.adversary; instances hold only static config."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+
+    def setup(self, seed, inst_ids, xp=np):
+        cfg = self.cfg
+        fm = faulty_mask(cfg, seed, inst_ids, xp=xp)
+        if cfg.adversary == "crash":
+            cr = crash_rounds(cfg, seed, inst_ids, xp=xp)
+        else:
+            cr = xp.zeros(fm.shape, dtype=xp.int32)
+        return {"faulty": fm, "crash_round": cr}
+
+    def inject(self, seed, inst_ids, rnd, t, honest_values, setup, xp=np):
+        """Apply the adversary to one step's honest outgoing values (spec §6).
+
+        ``honest_values``: (B, n) uint8 in {0,1,2} — what each replica's honest state
+        machine sends this step (faulty replicas run the honest machine too, §6.3).
+        Returns (values, silent, bias) as described in the module docstring.
+        """
+        cfg = self.cfg
+        B, n = honest_values.shape
+        faulty = setup["faulty"]
+        no_bias = xp.zeros((B, 1, n), dtype=xp.uint32)
+        zero_silent = xp.zeros((B, n), dtype=bool)
+
+        if cfg.adversary == "none":
+            return honest_values, zero_silent, no_bias
+
+        if cfg.adversary == "crash":
+            silent = faulty & (xp.asarray(rnd, dtype=xp.int32) >= setup["crash_round"])
+            return honest_values, silent, no_bias
+
+        inst = xp.asarray(inst_ids, dtype=xp.uint32)[:, None]
+        send = xp.arange(n, dtype=xp.uint32)[None, :]
+
+        if cfg.adversary == "byzantine":
+            if cfg.protocol == "bracha":
+                # RBC count-level outcome, common to all receivers (spec §6.3).
+                b = prf.prf_u32(seed, inst, rnd, t, 0, send, prf.BYZ_VALUE, xp=xp) & xp.uint32(3)
+                silent = faulty & (b == 0)
+                v = xp.where(b == 1, xp.uint8(0),
+                             xp.where(b == 2, xp.uint8(1), honest_values.astype(xp.uint8)))
+                values = xp.where(faulty, v, honest_values).astype(xp.uint8)
+                return values, silent, no_bias
+            # Plain Ben-Or pairing: full per-receiver equivocation matrix (spec §6.3).
+            recv3 = xp.arange(n, dtype=xp.uint32)[None, :, None]
+            send3 = xp.arange(n, dtype=xp.uint32)[None, None, :]
+            inst3 = xp.asarray(inst_ids, dtype=xp.uint32)[:, None, None]
+            e = prf.prf_u32(seed, inst3, rnd, t, recv3, send3, prf.BYZ_VALUE, xp=xp)
+            vmat = (e % xp.uint32(3)).astype(xp.uint8)  # {0,1,2=silent-to-this-recv}
+            values = xp.where(faulty[:, None, :], vmat,
+                              xp.broadcast_to(honest_values[:, None, :], (B, n, n)).astype(xp.uint8))
+            return values, zero_silent, no_bias
+
+        if cfg.adversary == "adaptive":
+            # spec §6.4 — observe honest votes, push the minority value, bias delivery.
+            honest_live = ~faulty
+            nonbot = honest_values != 2
+            h1 = (honest_live & nonbot & (honest_values == 1)).sum(-1, dtype=xp.int32)
+            h0 = (honest_live & nonbot & (honest_values == 0)).sum(-1, dtype=xp.int32)
+            minority = xp.where(h1 <= h0, xp.uint8(1), xp.uint8(0))
+            values = xp.where(faulty, minority[:, None], honest_values).astype(xp.uint8)
+            # Receiver v prefers value 0 iff v < n/2; senders whose wire value matches
+            # the receiver's preference get bias 0 (delivered first), others bias 1.
+            pref = (xp.arange(n, dtype=xp.int32) >= (n + 1) // 2)[None, :, None].astype(xp.uint8)
+            vv = values[:, None, :]
+            bias = ((vv == 2) | (vv != pref)).astype(xp.uint32)
+            return values, zero_silent, bias
+
+        raise ValueError(f"unknown adversary {cfg.adversary}")

@@ -1,0 +1,54 @@
+"""Ben-Or randomized binary consensus — vectorized round body (spec/PROTOCOL.md §5.1).
+
+One round = 2 broadcast steps (report, propose) + coin. State is struct-of-arrays with
+leading batch axis B: ``est`` (B,n) u8, ``decided`` (B,n) bool, ``decided_val`` (B,n)
+u8, ``phase`` (B,n) i32. All thresholds are absolute in n and f (strict ``2*c > n``),
+all arithmetic integer [Ben-Or, PODC 1983].
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu.models import coins
+from byzantinerandomizedconsensus_tpu.ops import masks, tally
+
+
+def _step_counts(cfg, seed, inst_ids, rnd, t, values, silent, bias, xp):
+    m = masks.delivery_mask(cfg, seed, inst_ids, rnd, t, silent, bias, xp=xp)
+    return tally.tally01(m, values, xp=xp)
+
+
+def round_body(cfg, seed, inst_ids, rnd, state, adv, setup, xp=np):
+    """Execute one Ben-Or round; returns the new state dict."""
+    n, f = cfg.n, cfg.f
+    est, decided = state["est"], state["decided"]
+
+    # Protocol A (benign) vs Protocol B (lying) thresholds — spec §5.1.
+    quorum_rhs = n + f if cfg.lying_adversary else n
+    adopt_min = f + 1 if cfg.lying_adversary else 1
+
+    # Step 0 — report: broadcast est.
+    v0, silent0, bias0 = adv.inject(seed, inst_ids, rnd, 0, est, setup, xp=xp)
+    r0, r1 = _step_counts(cfg, seed, inst_ids, rnd, 0, v0, silent0, bias0, xp)
+    prop = xp.where(2 * r1 > quorum_rhs, xp.uint8(1),
+                    xp.where(2 * r0 > quorum_rhs, xp.uint8(0), xp.uint8(2)))
+
+    # Step 1 — propose: broadcast prop (bot = 2 excluded from counts).
+    v1, silent1, bias1 = adv.inject(seed, inst_ids, rnd, 1, prop, setup, xp=xp)
+    p0, p1 = _step_counts(cfg, seed, inst_ids, rnd, 1, v1, silent1, bias1, xp)
+    w = (p1 >= p0).astype(xp.uint8)
+    c = xp.where(w == 1, p1, p0)
+
+    coin = coins.coin_bits(cfg, seed, inst_ids, rnd, xp=xp)
+    new_est = xp.where(c >= adopt_min, w, coin).astype(xp.uint8)
+    decide_now = (2 * c > n + f) if cfg.lying_adversary else (c >= f + 1)
+
+    # Updates apply to every not-yet-decided replica (spec §6.3 eligibility rule).
+    upd = ~decided
+    state = dict(state)
+    state["est"] = xp.where(upd, new_est, est)
+    state["decided_val"] = xp.where(upd & decide_now, w, state["decided_val"])
+    state["decided"] = decided | (upd & decide_now)
+    state["phase"] = state["phase"] + upd.astype(xp.int32)
+    return state

@@ -1,0 +1,63 @@
+"""Bracha-style randomized consensus over reliable broadcast — vectorized round body
+(spec/PROTOCOL.md §5.2) [Bracha, Information & Computation 75, 1987].
+
+One round = 3 broadcast steps, each conceptually wrapped in Bracha reliable broadcast
+(echo > (n+f)/2, ready amplification at f+1, accept at 2f+1). RBC is simulated at the
+count level via its delivered guarantees under n > 3f (no equivocation within a step,
+all-or-nothing faulty outcomes) — see spec §5.2 for the adversary-completeness
+argument (SURVEY.md §7 hard-part 5). Thresholds: > n/2 absolute for decide-proposals,
+2f+1 to decide, f+1 to adopt.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu.models import coins, validation
+from byzantinerandomizedconsensus_tpu.ops import masks, tally
+
+
+def _step_counts(cfg, seed, inst_ids, rnd, t, values, silent, bias, xp):
+    m = masks.delivery_mask(cfg, seed, inst_ids, rnd, t, silent, bias, xp=xp)
+    return tally.tally01(m, values, xp=xp)
+
+
+def round_body(cfg, seed, inst_ids, rnd, state, adv, setup, xp=np):
+    """Execute one Bracha round; returns the new state dict."""
+    n, f = cfg.n, cfg.f
+    est, decided = state["est"], state["decided"]
+
+    # Step 0 — broadcast est; majority of delivered (ties -> 1).
+    v0, s0, b0 = adv.inject(seed, inst_ids, rnd, 0, est, setup, xp=xp)
+    g0_0, g0_1 = validation.live_counts(v0, s0, xp=xp)
+    c0_0, c0_1 = _step_counts(cfg, seed, inst_ids, rnd, 0, v0, s0, b0, xp)
+    m = (c0_1 >= c0_0).astype(xp.uint8)
+
+    # Step 1 — broadcast m; invalid messages silenced pre-delivery (spec §5.1b);
+    # decide-proposal needs an absolute > n/2 quorum.
+    v1, s1, b1 = adv.inject(seed, inst_ids, rnd, 1, m, setup, xp=xp)
+    s1 = s1 | validation.validate_step1(cfg, v1, g0_0, g0_1, xp=xp)
+    g1_0, g1_1 = validation.live_counts(v1, s1, xp=xp)
+    c1_0, c1_1 = _step_counts(cfg, seed, inst_ids, rnd, 1, v1, s1, b1, xp)
+    d = xp.where(2 * c1_1 > n, xp.uint8(1),
+                 xp.where(2 * c1_0 > n, xp.uint8(0), xp.uint8(2)))
+
+    # Step 2 — broadcast d (bot = 2 excluded from counts); validated against G1.
+    v2, s2, b2 = adv.inject(seed, inst_ids, rnd, 2, d, setup, xp=xp)
+    s2 = s2 | validation.validate_step2(cfg, v2, g1_0, g1_1, xp=xp)
+    c2_0, c2_1 = _step_counts(cfg, seed, inst_ids, rnd, 2, v2, s2, b2, xp)
+    w = (c2_1 >= c2_0).astype(xp.uint8)
+    c = xp.where(w == 1, c2_1, c2_0)
+
+    coin = coins.coin_bits(cfg, seed, inst_ids, rnd, xp=xp)
+    decide_now = c >= 2 * f + 1
+    adopt = c >= f + 1
+    new_est = xp.where(adopt, w, coin).astype(xp.uint8)
+
+    upd = ~decided
+    state = dict(state)
+    state["est"] = xp.where(upd, new_est, est)
+    state["decided_val"] = xp.where(upd & decide_now, w, state["decided_val"])
+    state["decided"] = decided | (upd & decide_now)
+    state["phase"] = state["phase"] + upd.astype(xp.int32)
+    return state

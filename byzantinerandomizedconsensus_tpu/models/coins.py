@@ -1,0 +1,24 @@
+"""Common-coin implementations (spec/PROTOCOL.md §5.3; SURVEY.md C6).
+
+``local``  — independent fair bit per (instance, round, replica)  [Ben-Or 1983].
+``shared`` — one common bit per (instance, round): the threshold-signature *stub* of
+BASELINE.json:10 (Cachin-Kursawe-Shoup shared coin with the share combination replaced
+by a keyed PRF — the north star explicitly stubs the cryptography).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu.ops import prf
+
+
+def coin_bits(cfg, seed, inst_ids, rnd, xp=np):
+    """Coin bits for every replica, shape (B, n) uint8."""
+    inst = xp.asarray(inst_ids, dtype=xp.uint32)[:, None]
+    if cfg.coin == "shared":
+        bit = prf.prf_bit(seed, inst, rnd, prf.COIN_STEP, 0, 0, prf.SHARED_COIN, xp=xp)
+        return xp.broadcast_to(bit.astype(xp.uint8), (inst.shape[0], cfg.n))
+    replica = xp.arange(cfg.n, dtype=xp.uint32)[None, :]
+    bit = prf.prf_bit(seed, inst, rnd, prf.COIN_STEP, replica, 0, prf.LOCAL_COIN, xp=xp)
+    return bit.astype(xp.uint8)

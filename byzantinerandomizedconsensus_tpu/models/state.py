@@ -1,0 +1,43 @@
+"""State initialisation and result extraction shared by the vectorized backends."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu.ops import prf
+
+
+def init_est(cfg, seed, inst_ids, xp=np):
+    """(B, n) uint8 initial estimates (spec §3.1)."""
+    B = inst_ids.shape[0]
+    if cfg.init == "all0":
+        return xp.zeros((B, cfg.n), dtype=xp.uint8)
+    if cfg.init == "all1":
+        return xp.ones((B, cfg.n), dtype=xp.uint8)
+    replica = xp.arange(cfg.n, dtype=xp.uint32)[None, :]
+    if cfg.init == "split":
+        return xp.broadcast_to((replica & xp.uint32(1)).astype(xp.uint8), (B, cfg.n))
+    inst = xp.asarray(inst_ids, dtype=xp.uint32)[:, None]
+    return prf.prf_bit(seed, inst, 0, 0, replica, 0, prf.INIT_EST, xp=xp).astype(xp.uint8)
+
+
+def init_state(cfg, seed, inst_ids, xp=np):
+    B = inst_ids.shape[0]
+    return {
+        "est": init_est(cfg, seed, inst_ids, xp=xp),
+        "decided": xp.zeros((B, cfg.n), dtype=bool),
+        "decided_val": xp.zeros((B, cfg.n), dtype=xp.uint8),
+        "phase": xp.zeros((B, cfg.n), dtype=xp.int32),
+    }
+
+
+def all_correct_decided(state, faulty, xp=np):
+    """(B,) bool — instance termination predicate (spec §1)."""
+    return xp.all(state["decided"] | faulty, axis=-1)
+
+
+def extract_decision(state, faulty, done, xp=np):
+    """(B,) uint8 — decided value of the lowest-indexed correct replica, 2 if undone."""
+    first_correct = xp.argmax(~faulty, axis=-1)
+    val = xp.take_along_axis(state["decided_val"], first_correct[:, None], axis=-1)[:, 0]
+    return xp.where(done, val, xp.uint8(2)).astype(xp.uint8)

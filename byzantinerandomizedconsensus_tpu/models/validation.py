@@ -1,0 +1,42 @@
+"""Bracha message validation at count level (spec/PROTOCOL.md §5.1b) — vectorized.
+
+Invalid messages are merged into the silent set *before* the delivery mask is drawn,
+so they never consume a wait-quota slot. This is what defeats garbage-flooding
+liveness attacks by the adaptive scheduler while keeping Bracha's agreement intact.
+All inputs/outputs are integer arrays with leading batch axis B.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def live_counts(values, silent, xp=np):
+    """Global per-instance counts G_b of live messages with value b. (B,) int32 each."""
+    live = ~silent
+    g0 = (live & (values == 0)).sum(axis=-1, dtype=xp.int32)
+    g1 = (live & (values == 1)).sum(axis=-1, dtype=xp.int32)
+    return g0, g1
+
+
+def validate_step1(cfg, values, g0_0, g0_1, xp=np):
+    """(B, n) bool — invalid step-1 (x) messages, from step-0 global counts."""
+    q = cfg.n - cfg.f
+    ok1 = g0_1 >= (q + 1) // 2        # x=1: can be a ties->1 majority of a q-subset
+    ok0 = g0_0 >= q // 2 + 1          # x=0: must be a strict majority
+    return ~xp.where(values == 1, ok1[:, None],
+                     xp.where(values == 0, ok0[:, None], True))
+
+
+def validate_step2(cfg, values, g1_0, g1_1, xp=np):
+    """(B, n) bool — invalid step-2 (z) messages, from valid step-1 global counts."""
+    n, f = cfg.n, cfg.f
+    q = n - f
+    okv1 = g1_1 >= n // 2 + 1
+    okv0 = g1_0 >= n // 2 + 1
+    # z = bot: some q-subset of valid step-1 messages has no > n/2 majority.
+    lo = xp.maximum(xp.maximum(0, q - g1_0), q - n // 2)
+    hi = xp.minimum(xp.minimum(g1_1, q), n // 2)
+    okbot = lo <= hi
+    return ~xp.where(values == 1, okv1[:, None],
+                     xp.where(values == 0, okv0[:, None], okbot[:, None]))

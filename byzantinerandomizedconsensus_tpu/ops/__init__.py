@@ -1,0 +1,1 @@
+"""Kernels shared by all backends: PRF, scheduling masks, quorum tallies."""

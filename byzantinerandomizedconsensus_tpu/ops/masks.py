@@ -1,0 +1,68 @@
+"""Delivery-mask generation (spec/PROTOCOL.md §4) — the per-step message matrix.
+
+Each receiver obtains messages from exactly the ``n-f`` live senders with the smallest
+combined scheduling key. The combined key packs, from high to low bits:
+``silent(1) | bias(1) | prf_top20(20) | sender_index(10)`` — distinct by construction,
+so "the n-f smallest" is exact integer selection with no ties, identical under numpy's
+``partition`` and XLA's ``sort``.
+
+This is the O(n^2) object of the north star (BASELINE.json:5): on the TPU backend it is
+materialised per instance-chunk and never stored across steps (SURVEY.md §7
+hard-part 3).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu.ops import prf
+
+
+def combined_keys(cfg, seed, inst_ids, rnd, t, silent, bias, xp=np):
+    """Combined scheduling keys, shape (B, n, n) uint32, axes (instance, recv, send).
+
+    ``silent``: (B, n) bool per sender; ``bias``: (B, n, n) or (B, 1, n) uint32/bool
+    per (recv, send) (0 unless the adaptive adversary is active).
+    """
+    n = cfg.n
+    u32 = xp.uint32
+    recv = xp.arange(n, dtype=xp.uint32)[None, :, None]
+    send = xp.arange(n, dtype=xp.uint32)[None, None, :]
+    sched = prf.prf_u32(
+        seed, xp.asarray(inst_ids, dtype=xp.uint32)[:, None, None],
+        rnd, t, recv, send, prf.SCHED, xp=xp,
+    )
+    silent_b = xp.asarray(silent, dtype=xp.uint32)[:, None, :]
+    bias_b = xp.asarray(bias, dtype=xp.uint32)
+    combined = (
+        (silent_b << u32(31))
+        | (bias_b << u32(30))
+        | (((sched >> u32(12)) & u32(0xFFFFF)) << u32(10))
+        | send
+    )
+    # A replica always receives its own message: combined = recv index (spec §4).
+    own = recv == send
+    combined = xp.where(own, xp.broadcast_to(recv, combined.shape), combined)
+    return combined
+
+
+def mask_from_keys(combined, n_deliver: int, silent, xp=np):
+    """Delivery mask (B, n, n) bool from combined keys: the ``n_deliver`` smallest
+    per receiver row, excluding silent senders (redundant by the bit-31 argument in
+    spec §4, kept as a guard)."""
+    if xp is np:
+        kth = np.partition(combined, n_deliver - 1, axis=-1)[..., n_deliver - 1]
+    else:
+        kth = xp.sort(combined, axis=-1)[..., n_deliver - 1]
+    mask = combined <= kth[..., None]
+    n = combined.shape[-1]
+    own = xp.eye(n, dtype=bool)[None]
+    # Own message is delivered unconditionally (spec §4): exempt from silence AND
+    # from the quota selection (aligned with the oracle's Network.delivery_mask).
+    return (mask & ~xp.asarray(silent, dtype=bool)[:, None, :]) | own
+
+
+def delivery_mask(cfg, seed, inst_ids, rnd, t, silent, bias, xp=np):
+    """(B, n, n) bool — delivered(recv, send) per spec §4."""
+    combined = combined_keys(cfg, seed, inst_ids, rnd, t, silent, bias, xp=xp)
+    return mask_from_keys(combined, cfg.n - cfg.f, silent, xp=xp)

@@ -1,0 +1,115 @@
+"""The single source of randomness: Threefry-2x32 counter-based PRF.
+
+spec/PROTOCOL.md §2 is the normative definition. Every random draw in the simulation
+(initial estimates, coins, faulty sets, crash rounds, Byzantine choices, message
+scheduling) is one evaluation of ``prf_u32`` — this is what makes the CPU oracle and
+the JAX/TPU backend bit-match (SURVEY.md §7 hard-part 1): randomness is addressed by
+*coordinates*, never by draw order.
+
+The implementation is written once, generic over the array namespace (``numpy`` or
+``jax.numpy``): all operations are uint32 elementwise arithmetic with wraparound, which
+both namespaces implement identically. Validated against JAX's own threefry in
+``tests/test_prf.py``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# Field-packing limits (spec/PROTOCOL.md §2). Asserted by backends at config time.
+MAX_INSTANCES = 1 << 17
+MAX_N = 1 << 10
+MAX_ROUNDS = 1 << 16
+
+# Purposes (spec/PROTOCOL.md §2).
+INIT_EST = 0
+LOCAL_COIN = 1
+SHARED_COIN = 2
+FAULTY_RANK = 3
+CRASH_ROUND = 4
+BYZ_VALUE = 5
+SCHED = 6
+
+# The step index used for coin draws (outside the protocol's message steps).
+COIN_STEP = 3
+
+_ROTATIONS = (13, 15, 26, 6, 17, 29, 16, 24)
+_PARITY = 0x1BD11BDA
+
+
+def _rotl32(x, r, xp):
+    u32 = xp.uint32
+    return ((x << u32(r)) | (x >> u32(32 - r))) & xp.uint32(0xFFFFFFFF)
+
+
+def threefry2x32(k0, k1, x0, x1, xp=np):
+    """Threefry-2x32, 20 rounds. All inputs uint32 arrays (broadcastable); returns
+    the first output word as uint32. Matches jax._src.prng.threefry_2x32's first word.
+    """
+    u32 = xp.uint32
+    k0 = xp.asarray(k0, dtype=xp.uint32)
+    k1 = xp.asarray(k1, dtype=xp.uint32)
+    x0 = xp.asarray(x0, dtype=xp.uint32)
+    x1 = xp.asarray(x1, dtype=xp.uint32)
+    # numpy emits overflow RuntimeWarnings for 0-d/scalar uint ops (wraparound is
+    # intended here); promote to 1-d and restore the shape at the end.
+    scalar_in = xp is np and x0.ndim == 0 and x1.ndim == 0
+    if scalar_in:
+        x0 = x0.reshape(1)
+        x1 = x1.reshape(1)
+
+    ks = (k0, k1, k0 ^ k1 ^ u32(_PARITY))
+    x0 = x0 + ks[0]
+    x1 = x1 + ks[1]
+
+    # Key-schedule injections after each group of 4 rounds (spec §2).
+    inject = (
+        (ks[1], ks[2], 1),
+        (ks[2], ks[0], 2),
+        (ks[0], ks[1], 3),
+        (ks[1], ks[2], 4),
+        (ks[2], ks[0], 5),
+    )
+    for g in range(5):
+        rots = _ROTATIONS[(g % 2) * 4 : (g % 2) * 4 + 4]
+        for r in rots:
+            x0 = x0 + x1
+            x1 = _rotl32(x1, r, xp)
+            x1 = x1 ^ x0
+        a, b, inc = inject[g]
+        x0 = x0 + a
+        x1 = x1 + b + u32(inc)
+    if scalar_in:
+        return x0[0]
+    return x0
+
+
+def seed_key(seed: int):
+    """Split a 64-bit python int seed into the (k0, k1) uint32 key pair."""
+    seed = int(seed) & 0xFFFFFFFFFFFFFFFF
+    return np.uint32(seed & 0xFFFFFFFF), np.uint32((seed >> 32) & 0xFFFFFFFF)
+
+
+def prf_u32(seed, instance, rnd, step, recv, send, purpose, xp=np):
+    """One PRF evaluation per spec/PROTOCOL.md §2.
+
+    ``seed`` is a python int; all other arguments are integers or integer arrays
+    (mutually broadcastable). Returns uint32 of the broadcast shape.
+
+    Packing:
+        x0 = (send << 17) | instance
+        x1 = (rnd << 16) | (recv << 6) | (step << 4) | purpose
+    """
+    k0, k1 = seed_key(seed)
+    u32 = xp.uint32
+    instance = xp.asarray(instance, dtype=xp.uint32)
+    rnd = xp.asarray(rnd, dtype=xp.uint32)
+    recv = xp.asarray(recv, dtype=xp.uint32)
+    send = xp.asarray(send, dtype=xp.uint32)
+    x0 = (send << u32(17)) | instance
+    x1 = (rnd << u32(16)) | (recv << u32(6)) | (u32(int(step) << 4)) | u32(int(purpose))
+    return threefry2x32(k0, k1, x0, x1, xp=xp)
+
+
+def prf_bit(seed, instance, rnd, step, recv, send, purpose, xp=np):
+    return prf_u32(seed, instance, rnd, step, recv, send, purpose, xp=xp) & xp.uint32(1)

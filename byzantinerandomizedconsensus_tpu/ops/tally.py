@@ -1,0 +1,28 @@
+"""Quorum tally kernels (SURVEY.md C5): masked vote counts per receiver.
+
+All counts are int32 integer arithmetic — no floating point in any decision path
+(SURVEY.md §7 hard-part 1). Values on the wire are {0, 1, 2=bot}.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def count_value(mask, values, val: int, xp=np):
+    """Count delivered messages equal to ``val``.
+
+    ``mask``: (B, n_recv, n_send) bool; ``values``: (B, n_send) for common
+    per-sender values, or (B, n_recv, n_send) for per-receiver (equivocation) values.
+    Returns (B, n_recv) int32.
+    """
+    if values.ndim == 2:
+        eq = values[:, None, :] == val
+    else:
+        eq = values == val
+    return (mask & eq).sum(axis=-1, dtype=xp.int32)
+
+
+def tally01(mask, values, xp=np):
+    """Counts of value 0 and value 1 (bot excluded). Returns two (B, n_recv) int32."""
+    return count_value(mask, values, 0, xp=xp), count_value(mask, values, 1, xp=xp)

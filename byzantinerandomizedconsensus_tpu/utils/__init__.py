@@ -1,0 +1,1 @@
+"""Metrics, histograms, and sweep checkpointing (SURVEY.md C8, §5)."""

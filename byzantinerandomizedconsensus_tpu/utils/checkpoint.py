@@ -1,0 +1,55 @@
+"""Resumable sweep checkpointing (SURVEY.md §5 checkpoint/resume).
+
+Sweeps write one ``.npz`` shard per (config-point, seed-chunk); an interrupted sweep
+resumes by skipping shards already on disk. Shard files carry the per-instance arrays
+(the bit-match surface), so partial sweeps remain fully auditable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu.backends.base import SimResult
+from byzantinerandomizedconsensus_tpu.config import SimConfig
+
+
+def shard_name(cfg: SimConfig, lo: int, hi: int) -> str:
+    return (f"{cfg.protocol}_n{cfg.n}_f{cfg.f}_{cfg.adversary}_{cfg.coin}"
+            f"_s{cfg.seed}_i{lo}-{hi}.npz")
+
+
+def save_shard(out_dir: pathlib.Path, cfg: SimConfig, res: SimResult) -> pathlib.Path:
+    out_dir.mkdir(parents=True, exist_ok=True)
+    lo, hi = int(res.inst_ids.min()), int(res.inst_ids.max()) + 1
+    path = out_dir / shard_name(cfg, lo, hi)
+    tmp = path.with_suffix(".tmp.npz")
+    np.savez_compressed(
+        tmp,
+        inst_ids=res.inst_ids,
+        rounds=res.rounds,
+        decision=res.decision,
+        config=np.frombuffer(json.dumps(dataclasses.asdict(cfg)).encode(), dtype=np.uint8),
+        wall_s=np.float64(res.wall_s),
+    )
+    tmp.rename(path)  # atomic publish: partial writes never count as done
+    return path
+
+
+def load_shard(path: pathlib.Path) -> SimResult:
+    data = np.load(path)
+    cfg = SimConfig(**json.loads(bytes(data["config"]).decode()))
+    return SimResult(
+        config=cfg,
+        inst_ids=data["inst_ids"],
+        rounds=data["rounds"],
+        decision=data["decision"],
+        wall_s=float(data["wall_s"]),
+    )
+
+
+def have_shard(out_dir: pathlib.Path, cfg: SimConfig, lo: int, hi: int) -> bool:
+    return (out_dir / shard_name(cfg, lo, hi)).exists()

@@ -1,0 +1,52 @@
+"""Round/decision histograms and headline metrics (SURVEY.md C8; BASELINE.json:2).
+
+Histograms are derived from the per-instance (rounds, decision) arrays — the bit-match
+surface — and include the overflow bucket for capped instances (SURVEY.md §7
+hard-part 2): ``decision == 2`` marks undecided-at-cap, and such instances sit in the
+``rounds == round_cap`` bin.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu.backends.base import SimResult
+
+
+def round_histogram(res: SimResult) -> np.ndarray:
+    """(round_cap + 1,) int64 — counts of rounds-to-decision; index r = "terminated in
+    r rounds" (index 0 unused), with capped instances in the final bin."""
+    return np.bincount(res.rounds, minlength=res.config.round_cap + 1).astype(np.int64)
+
+
+def decision_histogram(res: SimResult) -> np.ndarray:
+    """(3,) int64 — counts of decisions 0, 1, and 2 (= undecided at cap)."""
+    return np.bincount(res.decision, minlength=3).astype(np.int64)
+
+
+def summary(res: SimResult) -> dict:
+    decided = res.decision != 2
+    dh = decision_histogram(res)
+    return {
+        "protocol": res.config.protocol,
+        "n": res.config.n,
+        "f": res.config.f,
+        "adversary": res.config.adversary,
+        "coin": res.config.coin,
+        "seed": res.config.seed,
+        "instances": int(len(res.inst_ids)),
+        "decided": int(decided.sum()),
+        "undecided_at_cap": int(dh[2]),
+        "round_cap": res.config.round_cap,
+        "mean_rounds_decided": float(res.rounds[decided].mean()) if decided.any() else None,
+        "max_rounds": int(res.rounds.max()) if len(res.rounds) else 0,
+        "decision_histogram": dh.tolist(),
+        "wall_s": res.wall_s,
+        "instances_per_sec": res.instances_per_sec if res.wall_s else None,
+    }
+
+
+def dump_summary(res: SimResult) -> str:
+    return json.dumps(summary(res))

@@ -1,0 +1,64 @@
+"""Config-5 sweep driver (SURVEY.md §3.5, C9): n in {128..1024}, f = (n-1)//3,
+adaptive adversary, round-distribution as the artifact. Resumable via checkpoint
+shards; instances are chunked so an interrupted point restarts mid-way, not from 0.
+"""
+
+from __future__ import annotations
+
+import pathlib
+from typing import Iterable, Optional
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu.backends.base import get_backend
+from byzantinerandomizedconsensus_tpu.config import SWEEP_INSTANCES, SWEEP_NS, sweep_point
+from byzantinerandomizedconsensus_tpu.utils import checkpoint, metrics
+
+
+def run_sweep(
+    out_dir: pathlib.Path,
+    backend: str = "jax",
+    ns: Iterable[int] = SWEEP_NS,
+    instances: int = SWEEP_INSTANCES,
+    seed: int = 0,
+    shard_instances: int = 500,
+    coin: str = "shared",
+    progress=print,
+) -> dict:
+    """Run (or resume) the sweep; returns {n: summary-with-round-histogram}."""
+    be = get_backend(backend)
+    out = {}
+    for n in ns:
+        cfg = sweep_point(n, seed=seed, instances=instances)
+        if coin != cfg.coin:
+            import dataclasses
+
+            cfg = dataclasses.replace(cfg, coin=coin).validate()
+        shards = []
+        for lo in range(0, instances, shard_instances):
+            hi = min(lo + shard_instances, instances)
+            if checkpoint.have_shard(out_dir, cfg, lo, hi):
+                shards.append(checkpoint.load_shard(out_dir / checkpoint.shard_name(cfg, lo, hi)))
+                continue
+            res = be.timed_run(cfg, np.arange(lo, hi, dtype=np.int64))
+            checkpoint.save_shard(out_dir, cfg, res)
+            shards.append(res)
+            progress(f"sweep n={n}: instances [{lo},{hi}) "
+                     f"{res.instances_per_sec:.0f} inst/s")
+        merged = _merge(cfg, shards)
+        s = metrics.summary(merged)
+        s["round_histogram"] = metrics.round_histogram(merged).tolist()
+        out[n] = s
+    return out
+
+
+def _merge(cfg, shards):
+    from byzantinerandomizedconsensus_tpu.backends.base import SimResult
+
+    return SimResult(
+        config=cfg,
+        inst_ids=np.concatenate([s.inst_ids for s in shards]),
+        rounds=np.concatenate([s.rounds for s in shards]),
+        decision=np.concatenate([s.decision for s in shards]),
+        wall_s=sum(s.wall_s for s in shards),
+    )

@@ -1,0 +1,43 @@
+"""Regenerate the frozen golden vectors (spec/PROTOCOL.md §8).
+
+Run as ``python -m spec.golden.regen`` from the repo root. Any diff in the committed
+``golden.npz`` is a *spec change* and must be called out in review — these arrays are
+the arbiter for both backends.
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu import SimConfig, Simulator
+
+GOLDEN_CONFIGS = {
+    "benor_n4": SimConfig(protocol="benor", n=4, f=1, instances=200, adversary="none",
+                          coin="local", round_cap=128, seed=0),
+    "benor_crash": SimConfig(protocol="benor", n=8, f=3, instances=100, adversary="crash",
+                             coin="local", round_cap=256, seed=2),
+    "bracha_byz": SimConfig(protocol="bracha", n=10, f=3, instances=100,
+                            adversary="byzantine", coin="shared", round_cap=64, seed=1),
+    "bracha_adaptive": SimConfig(protocol="bracha", n=13, f=4, instances=100,
+                                 adversary="adaptive", coin="shared", round_cap=64, seed=3),
+}
+
+PATH = pathlib.Path(__file__).parent / "golden.npz"
+
+
+def main() -> None:
+    out = {}
+    for name, cfg in GOLDEN_CONFIGS.items():
+        res = Simulator(cfg, "cpu").run()
+        out[f"{name}__rounds"] = res.rounds
+        out[f"{name}__decision"] = res.decision
+        print(f"{name}: mean_rounds={res.rounds.mean():.3f} "
+              f"decisions={np.bincount(res.decision, minlength=3).tolist()}")
+    np.savez_compressed(PATH, **out)
+    print(f"wrote {PATH}")
+
+
+if __name__ == "__main__":
+    main()

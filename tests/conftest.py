@@ -1,0 +1,11 @@
+"""Test env: force JAX onto 8 virtual CPU devices (SURVEY.md §4.3) before jax imports.
+
+Real-TPU runs (bench.py, CLI) are unaffected — this applies to the test process only.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

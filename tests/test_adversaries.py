@@ -1,0 +1,110 @@
+"""Adversary unit tests (SURVEY.md §4.5): crash silences exactly the chosen replicas,
+Byzantine equivocation produces per-receiver differences, the adaptive hook is a pure
+function of round-t state, and faulty-set selection is exact."""
+
+import numpy as np
+import pytest
+
+from byzantinerandomizedconsensus_tpu.config import SimConfig
+from byzantinerandomizedconsensus_tpu.core.adversary import make_adversary
+from byzantinerandomizedconsensus_tpu.models.adversaries import AdversaryModel, faulty_mask
+
+
+def test_faulty_set_size_and_determinism():
+    cfg = SimConfig(protocol="bracha", n=64, f=21, instances=50, adversary="byzantine",
+                    coin="shared", seed=5).validate()
+    ids = np.arange(50, dtype=np.int64)
+    fm = faulty_mask(cfg, cfg.seed, ids, xp=np)
+    np.testing.assert_array_equal(fm.sum(-1), np.full(50, cfg.f))
+    fm2 = faulty_mask(cfg, cfg.seed, ids, xp=np)
+    np.testing.assert_array_equal(fm, fm2)
+    # oracle-side selection matches the vectorized one
+    for i in (0, 17, 49):
+        adv = make_adversary(cfg, cfg.seed, i)
+        np.testing.assert_array_equal(adv.faulty, fm[i])
+    # different instances get different sets (whp)
+    assert not np.array_equal(fm[0], fm[1])
+
+
+def test_none_adversary_has_no_faults():
+    cfg = SimConfig(protocol="bracha", n=512, f=170, instances=3, adversary="none",
+                    coin="shared", seed=0).validate()
+    fm = faulty_mask(cfg, cfg.seed, np.arange(3), xp=np)
+    assert not fm.any()
+
+
+def test_crash_silences_only_faulty_and_sticks():
+    cfg = SimConfig(protocol="benor", n=16, f=7, instances=20, adversary="crash",
+                    coin="local", seed=6, crash_window=4).validate()
+    ids = np.arange(20, dtype=np.int64)
+    adv = AdversaryModel(cfg)
+    setup = adv.setup(cfg.seed, ids, xp=np)
+    honest = np.zeros((20, 16), dtype=np.uint8)
+    prev_silent = np.zeros((20, 16), dtype=bool)
+    for r in range(6):
+        _, silent, _ = adv.inject(cfg.seed, ids, r, 0, honest, setup, xp=np)
+        assert not (silent & ~setup["faulty"]).any(), "crash silenced a correct replica"
+        assert (prev_silent <= silent).all(), "a crashed replica came back"
+        prev_silent = silent
+    # by round >= crash_window all faulty replicas have crashed
+    assert (prev_silent == setup["faulty"]).all()
+
+
+def test_byzantine_equivocation_differs_per_receiver():
+    cfg = SimConfig(protocol="benor", n=16, f=3, instances=10, adversary="byzantine",
+                    coin="local", seed=7).validate()
+    ids = np.arange(10, dtype=np.int64)
+    adv = AdversaryModel(cfg)
+    setup = adv.setup(cfg.seed, ids, xp=np)
+    honest = np.ones((10, 16), dtype=np.uint8)
+    values, silent, _ = adv.inject(cfg.seed, ids, 0, 0, honest, setup, xp=np)
+    assert values.ndim == 3, "plain-byzantine pairing must use the equivocation matrix"
+    fidx = np.argmax(setup["faulty"][0])
+    col = values[0, :, fidx]
+    assert len(np.unique(col)) > 1, "faulty sender never equivocated"
+    # honest columns are constant
+    hidx = np.argmax(~setup["faulty"][0])
+    assert len(np.unique(values[0, :, hidx])) == 1
+
+
+def test_byzantine_rbc_common_outcome():
+    cfg = SimConfig(protocol="bracha", n=16, f=5, instances=10, adversary="byzantine",
+                    coin="shared", seed=8).validate()
+    ids = np.arange(10, dtype=np.int64)
+    adv = AdversaryModel(cfg)
+    setup = adv.setup(cfg.seed, ids, xp=np)
+    honest = np.ones((10, 16), dtype=np.uint8)
+    values, silent, _ = adv.inject(cfg.seed, ids, 0, 0, honest, setup, xp=np)
+    assert values.ndim == 2, "bracha pairing must deliver a common per-sender outcome"
+    # over many (instance, sender, step) draws, all four outcomes occur
+    outs = set()
+    for r in range(4):
+        for t in range(3):
+            v, s, _ = adv.inject(cfg.seed, ids, r, t, honest, setup, xp=np)
+            f = setup["faulty"]
+            outs |= set(np.asarray(v[f & ~s]).tolist())
+            if (f & s).any():
+                outs.add("silent")
+    assert outs >= {0, 1, "silent"}
+
+
+def test_adaptive_pushes_minority_and_is_pure():
+    cfg = SimConfig(protocol="bracha", n=16, f=5, instances=8, adversary="adaptive",
+                    coin="shared", seed=9).validate()
+    ids = np.arange(8, dtype=np.int64)
+    adv = AdversaryModel(cfg)
+    setup = adv.setup(cfg.seed, ids, xp=np)
+    # construct a 10-vs-1 honest split; minority is 0 where honest ones dominate
+    honest = np.ones((8, 16), dtype=np.uint8)
+    hidx = np.where(~setup["faulty"][0])[0]
+    honest[0, hidx[0]] = 0
+    values, silent, bias = adv.inject(cfg.seed, ids, 3, 1, honest, setup, xp=np)
+    assert (values[0, setup["faulty"][0]] == 0).all(), "adaptive must push the minority value"
+    # purity: same inputs -> same outputs (no hidden state, no future information)
+    values2, silent2, bias2 = adv.inject(cfg.seed, ids, 3, 1, honest, setup, xp=np)
+    np.testing.assert_array_equal(values, values2)
+    np.testing.assert_array_equal(bias, bias2)
+    # bias splits receivers into two camps with opposite preferences
+    assert bias.shape == (8, 16, 16)
+    lo, hi = bias[0, 0], bias[0, 15]
+    assert not np.array_equal(lo, hi), "receiver halves must be biased oppositely"

@@ -1,0 +1,79 @@
+"""The north-star acceptance test (BASELINE.json:5; SURVEY.md §4.2): identical
+per-instance (rounds, decision) across the independent CPU oracle, the numpy
+vectorized backend, and the jit'd JAX backend — exhaustively at small n, on sampled
+instance subsets at benchmark scale."""
+
+import numpy as np
+import pytest
+
+from byzantinerandomizedconsensus_tpu import SimConfig, Simulator, preset
+
+SMALL = [
+    SimConfig(protocol="benor", n=4, f=1, instances=60, adversary="none", coin="local",
+              round_cap=64, seed=0),
+    SimConfig(protocol="benor", n=9, f=4, instances=40, adversary="crash", coin="local",
+              round_cap=96, seed=1),
+    SimConfig(protocol="benor", n=16, f=3, instances=40, adversary="byzantine",
+              coin="local", round_cap=64, seed=2),
+    SimConfig(protocol="benor", n=11, f=2, instances=40, adversary="adaptive",
+              coin="shared", round_cap=64, seed=3),
+    SimConfig(protocol="bracha", n=10, f=3, instances=40, adversary="byzantine",
+              coin="shared", round_cap=64, seed=4),
+    SimConfig(protocol="bracha", n=16, f=5, instances=40, adversary="adaptive",
+              coin="shared", round_cap=64, seed=5),
+    SimConfig(protocol="bracha", n=13, f=4, instances=40, adversary="crash",
+              coin="local", round_cap=64, seed=6),
+    SimConfig(protocol="bracha", n=7, f=2, instances=40, adversary="none", coin="shared",
+              round_cap=64, seed=7),
+]
+
+
+def _ids(cfg):
+    return SMALL.index(cfg)
+
+
+@pytest.mark.parametrize("cfg", SMALL, ids=lambda c: f"{c.protocol}-n{c.n}f{c.f}-{c.adversary}-{c.coin}")
+def test_small_exhaustive(cfg):
+    ref = Simulator(cfg, "cpu").run()
+    for backend in ("numpy", "jax"):
+        got = Simulator(cfg, backend).run()
+        np.testing.assert_array_equal(ref.rounds, got.rounds, err_msg=f"rounds {backend}")
+        np.testing.assert_array_equal(ref.decision, got.decision, err_msg=f"decision {backend}")
+
+
+@pytest.mark.parametrize("name,n_sample", [("config2", 6), ("config3", 4), ("config4", 3)])
+def test_benchmark_configs_sampled(name, n_sample):
+    """Sampled bit-match at benchmark scale: instance i depends only on (cfg, seed, i),
+    so the oracle simulates a pseudo-random subset and must match the batched run."""
+    cfg = preset(name, round_cap=64)
+    rng = np.random.default_rng(hash(name) % 2**32)
+    ids = np.unique(rng.integers(0, cfg.instances, size=n_sample))
+    ref = Simulator(cfg, "cpu").run(ids)
+    for backend in ("numpy", "jax"):
+        got = Simulator(cfg, backend).run(ids)
+        np.testing.assert_array_equal(ref.rounds, got.rounds, err_msg=f"rounds {backend}")
+        np.testing.assert_array_equal(ref.decision, got.decision, err_msg=f"decision {backend}")
+
+
+def test_subset_equals_full_run():
+    """Batched full run restricted to a subset equals the subset run (instance
+    independence — spec §1)."""
+    cfg = SimConfig(protocol="bracha", n=16, f=5, instances=50, adversary="byzantine",
+                    coin="shared", round_cap=64, seed=9)
+    full = Simulator(cfg, "numpy").run()
+    ids = np.array([0, 7, 13, 49])
+    sub = Simulator(cfg, "numpy").run(ids)
+    np.testing.assert_array_equal(full.rounds[ids], sub.rounds)
+    np.testing.assert_array_equal(full.decision[ids], sub.decision)
+
+
+def test_jax_chunking_invariance():
+    """Chunk size must not affect results (padding correctness)."""
+    from byzantinerandomizedconsensus_tpu.backends.jax_backend import JaxBackend
+
+    cfg = SimConfig(protocol="bracha", n=10, f=3, instances=37, adversary="byzantine",
+                    coin="shared", round_cap=64, seed=12)
+    a = JaxBackend(max_chunk=8).run(cfg)
+    b = JaxBackend(max_chunk=64).run(cfg)
+    np.testing.assert_array_equal(a.rounds, b.rounds)
+    np.testing.assert_array_equal(a.decision, b.decision)

@@ -1,0 +1,24 @@
+"""Golden-vector regression (spec §8): every backend must reproduce the frozen
+per-instance outputs exactly. A mismatch means either a backend bug or an
+intentional spec change (then regen via ``python -m spec.golden.regen``)."""
+
+import pathlib
+
+import numpy as np
+import pytest
+
+from byzantinerandomizedconsensus_tpu import Simulator
+
+from spec.golden.regen import GOLDEN_CONFIGS, PATH
+
+
+@pytest.mark.parametrize("name", sorted(GOLDEN_CONFIGS))
+@pytest.mark.parametrize("backend", ["cpu", "numpy", "jax"])
+def test_golden(name, backend):
+    if not PATH.exists():
+        pytest.fail("golden.npz missing — run `python -m spec.golden.regen`")
+    data = np.load(PATH)
+    cfg = GOLDEN_CONFIGS[name]
+    res = Simulator(cfg, backend).run()
+    np.testing.assert_array_equal(res.rounds, data[f"{name}__rounds"])
+    np.testing.assert_array_equal(res.decision, data[f"{name}__decision"])

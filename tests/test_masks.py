@@ -1,0 +1,77 @@
+"""Delivery-mask unit tests (spec §4): exact n-f delivery, own-message rule, silent
+exclusion, numpy/jnp agreement, and the oracle Network's independent implementation."""
+
+import numpy as np
+import pytest
+
+from byzantinerandomizedconsensus_tpu.config import SimConfig
+from byzantinerandomizedconsensus_tpu.core.network import Network
+from byzantinerandomizedconsensus_tpu.ops import masks
+
+
+@pytest.fixture
+def cfg():
+    return SimConfig(protocol="bracha", n=16, f=5, instances=4, adversary="byzantine",
+                     coin="shared", seed=11).validate()
+
+
+def _mk(cfg, silent, bias=None, xp=np, rnd=2, t=1):
+    ids = np.arange(4, dtype=np.int64)
+    if bias is None:
+        bias = xp.zeros((4, 1, cfg.n), dtype=xp.uint32)
+    return masks.delivery_mask(cfg, cfg.seed, xp.asarray(ids), rnd, t,
+                               xp.asarray(silent), bias, xp=xp)
+
+
+def test_exact_quota_and_own_delivery(cfg):
+    silent = np.zeros((4, cfg.n), dtype=bool)
+    silent[:, 3] = True  # one silent sender
+    m = _mk(cfg, silent)
+    assert m.shape == (4, cfg.n, cfg.n)
+    # exactly n-f delivered per receiver
+    np.testing.assert_array_equal(m.sum(-1), np.full((4, cfg.n), cfg.n - cfg.f))
+    # silent sender never delivered to anyone else (only to itself)
+    others = np.ones(cfg.n, dtype=bool)
+    others[3] = False
+    assert not m[:, others, 3].any()
+    # own message always delivered, silence notwithstanding (spec §4)
+    diag = np.einsum("bii->bi", m.astype(np.int32))
+    np.testing.assert_array_equal(diag, np.ones((4, cfg.n), dtype=np.int32))
+
+
+def test_numpy_jnp_and_oracle_agree(cfg):
+    import jax.numpy as jnp
+
+    silent = np.zeros((4, cfg.n), dtype=bool)
+    silent[:, 0] = True
+    silent[:, 7] = True
+    m_np = _mk(cfg, silent, xp=np)
+    m_jnp = _mk(cfg, silent, xp=jnp)
+    np.testing.assert_array_equal(m_np, np.asarray(m_jnp))
+
+    # oracle Network (independent row-wise implementation)
+    for b, inst in enumerate(range(4)):
+        net = Network(cfg, cfg.seed, inst)
+        m_net = net.delivery_mask(2, 1, silent[b], np.zeros((1, cfg.n), dtype=np.uint32))
+        np.testing.assert_array_equal(m_np[b], m_net)
+
+
+def test_bias_prefers_unbiased_senders(cfg):
+    """Biased senders are delivered only when unbiased ones can't fill the quota."""
+    silent = np.zeros((4, cfg.n), dtype=bool)
+    bias = np.zeros((4, 1, cfg.n), dtype=np.uint32)
+    bias[:, :, : cfg.n // 2] = 1  # first half biased away
+    m = _mk(cfg, silent, bias=bias)
+    # quota is n-f = 11; unbiased senders are 8 -> all 8 delivered, 3 biased fill up
+    unbiased = m[:, :, cfg.n // 2 :].sum(-1)
+    np.testing.assert_array_equal(unbiased, np.full((4, cfg.n), cfg.n // 2))
+    assert (m.sum(-1) == cfg.n - cfg.f).all()
+
+
+def test_mask_changes_with_round_step(cfg):
+    silent = np.zeros((4, cfg.n), dtype=bool)
+    a = _mk(cfg, silent, rnd=1, t=0)
+    b = _mk(cfg, silent, rnd=1, t=1)
+    c = _mk(cfg, silent, rnd=2, t=0)
+    assert not np.array_equal(a, b)
+    assert not np.array_equal(a, c)

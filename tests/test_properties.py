@@ -1,0 +1,104 @@
+"""Protocol property tests (SURVEY.md §4.1): Agreement, Validity, Termination — the
+[ALG] invariants, checked as backend-independent oracles over the vectorized state
+(fast, many instances) and spot-checked on the CPU oracle."""
+
+import numpy as np
+import pytest
+
+from byzantinerandomizedconsensus_tpu import SimConfig, Simulator
+from byzantinerandomizedconsensus_tpu.models import benor, bracha, state as state_mod
+from byzantinerandomizedconsensus_tpu.models.adversaries import AdversaryModel
+
+
+def run_to_state(cfg, rounds=None):
+    """Run all instances with the numpy models path; return (state, faulty)."""
+    cfg = cfg.validate()
+    ids = np.arange(cfg.instances, dtype=np.int64)
+    adv = AdversaryModel(cfg)
+    setup = adv.setup(cfg.seed, ids, xp=np)
+    st = state_mod.init_state(cfg, cfg.seed, ids, xp=np)
+    body = benor.round_body if cfg.protocol == "benor" else bracha.round_body
+    for r in range(rounds or cfg.round_cap):
+        st = body(cfg, cfg.seed, ids, r, st, adv, setup, xp=np)
+        if state_mod.all_correct_decided(st, setup["faulty"], xp=np).all():
+            break
+    return st, setup["faulty"]
+
+
+CONFIGS = [
+    SimConfig(protocol="benor", n=4, f=1, instances=300, adversary="none", coin="local",
+              round_cap=128, seed=21),
+    SimConfig(protocol="benor", n=16, f=7, instances=200, adversary="crash", coin="local",
+              round_cap=256, seed=22),
+    SimConfig(protocol="benor", n=16, f=3, instances=200, adversary="byzantine",
+              coin="local", round_cap=256, seed=23),
+    SimConfig(protocol="benor", n=16, f=3, instances=200, adversary="adaptive",
+              coin="shared", round_cap=256, seed=24),
+    SimConfig(protocol="bracha", n=16, f=5, instances=200, adversary="byzantine",
+              coin="shared", round_cap=128, seed=25),
+    SimConfig(protocol="bracha", n=16, f=5, instances=200, adversary="adaptive",
+              coin="shared", round_cap=128, seed=26),
+    SimConfig(protocol="bracha", n=10, f=3, instances=200, adversary="crash",
+              coin="shared", round_cap=128, seed=27),
+]
+
+_id = lambda c: f"{c.protocol}-n{c.n}f{c.f}-{c.adversary}-{c.coin}"
+
+
+@pytest.mark.parametrize("cfg", CONFIGS, ids=_id)
+def test_agreement(cfg):
+    """No two correct replicas of one instance ever decide different values."""
+    st, faulty = run_to_state(cfg)
+    correct_decided = st["decided"] & ~faulty
+    vals = st["decided_val"]
+    # max and min over decided correct replicas must coincide per instance
+    vmax = np.where(correct_decided, vals, 0).max(axis=1)
+    vmin = np.where(correct_decided, vals, 1).min(axis=1)
+    has2 = correct_decided.sum(axis=1) >= 2
+    assert (vmax[has2] == vmin[has2]).all(), "agreement violated"
+
+
+@pytest.mark.parametrize("cfg", CONFIGS, ids=_id)
+@pytest.mark.parametrize("v", [0, 1])
+def test_validity(cfg, v):
+    """If every correct replica starts with v, every correct decision is v — and with
+    unanimous starts the instance must decide (round 1 under any schedule, spec §5)."""
+    import dataclasses
+
+    cfg2 = dataclasses.replace(cfg, init=f"all{v}", instances=50)
+    st, faulty = run_to_state(cfg2)
+    correct = ~faulty
+    assert (st["decided"] | ~correct).all(), "unanimous instance failed to terminate"
+    assert (np.where(correct, st["decided_val"], v) == v).all(), "validity violated"
+
+
+@pytest.mark.parametrize(
+    "cfg",
+    [c for c in CONFIGS if c.coin == "shared" or c.n <= 4 or c.adversary == "none"],
+    ids=_id,
+)
+def test_termination_quantile(cfg):
+    """Probabilistic termination, asserted on quantiles (SURVEY.md §4.1): shared-coin
+    and tiny-n local-coin regimes decide well before the cap for ≥ 95% of instances."""
+    res = Simulator(cfg, "numpy").run()
+    frac = float((res.decision != 2).mean())
+    assert frac >= 0.95, f"only {frac:.2%} of instances terminated"
+
+
+def test_decided_state_frozen():
+    """Once decided, est/decided_val never change (decided-mask freezing)."""
+    cfg = SimConfig(protocol="bracha", n=10, f=3, instances=100, adversary="byzantine",
+                    coin="shared", round_cap=32, seed=31)
+    ids = np.arange(cfg.instances, dtype=np.int64)
+    adv = AdversaryModel(cfg)
+    setup = adv.setup(cfg.seed, ids, xp=np)
+    st = state_mod.init_state(cfg, cfg.seed, ids, xp=np)
+    frozen = {}
+    for r in range(cfg.round_cap):
+        prev = st
+        st = bracha.round_body(cfg, cfg.seed, ids, r, st, adv, setup, xp=np)
+        was = prev["decided"]
+        assert (st["decided"] | ~was).all(), "decided bit un-set"
+        assert (st["decided_val"][was] == prev["decided_val"][was]).all()
+        assert (st["est"][was] == prev["est"][was]).all()
+        assert (st["phase"][was] == prev["phase"][was]).all()
