@@ -1,0 +1,62 @@
+"""Headline benchmark (BASELINE.json:2): config 4 — Bracha + shared coin, n=512,
+f=170, 100k instances — run to termination on the JAX backend, reporting
+consensus-instances/sec.
+
+The north-star target (BASELINE.json:5) is 100k instances in < 60 s on a v4-8,
+i.e. ~1,667 inst/s; ``vs_baseline`` is measured-throughput / that target. The
+reference publishes no numbers of its own (BASELINE.json "published": {}), so the
+driver-set target is the baseline.
+
+Prints exactly ONE JSON line on stdout.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+TARGET_INST_PER_SEC = 100_000 / 60.0  # north-star: 100k instances < 60 s
+
+
+def main() -> int:
+    from byzantinerandomizedconsensus_tpu import Simulator, preset
+
+    from byzantinerandomizedconsensus_tpu.backends import get_backend
+
+    instances = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    cfg = preset("config4", instances=instances)
+    sim = Simulator(cfg, "jax")
+
+    # Warm-up: compile the round kernel at the exact chunk shape the timed run uses
+    # (a smaller warm-up batch would compile a different program and leave the real
+    # compile inside the timed window).
+    chunk = min(get_backend("jax")._chunk_size(cfg), instances)
+    sim.run(np.arange(chunk, dtype=np.int64))
+
+    t0 = time.perf_counter()
+    res = sim.run()
+    wall = time.perf_counter() - t0
+
+    inst_per_sec = instances / wall
+    undecided = int((res.decision == 2).sum())
+    print(json.dumps({
+        "metric": "consensus_instances_per_sec@n512_f170_shared_coin",
+        "value": round(inst_per_sec, 1),
+        "unit": "instances/s",
+        "vs_baseline": round(inst_per_sec / TARGET_INST_PER_SEC, 3),
+        "detail": {
+            "instances": instances,
+            "wall_s": round(wall, 2),
+            "mean_rounds_to_decision": round(float(res.rounds.mean()), 4),
+            "undecided": undecided,
+        },
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,10 +34,19 @@ def _jax_cpu():
     return JaxBackend(device="cpu")
 
 
+def _jax_sharded(n_model: str = "1"):
+    """``jax_sharded`` or ``jax_sharded:<n_model>`` — replica-shard count over the
+    mesh's model axis (must divide the device count and cfg.n)."""
+    from byzantinerandomizedconsensus_tpu.parallel.sharded import JaxShardedBackend
+
+    return JaxShardedBackend(n_model=int(n_model))
+
+
 register_backend("cpu", _cpu)
 register_backend("numpy", _numpy)
 register_backend("jax", _jax)
 register_backend("jax_cpu", _jax_cpu)
+register_backend("jax_sharded", _jax_sharded)
 
 __all__ = [
     "SimResult",

@@ -42,6 +42,27 @@ class SimulatorBackend(abc.ABC):
         """Simulate the given instances (default: all of them) to termination."""
 
     @staticmethod
+    def _run_chunked(fn, ids: np.ndarray, chunk: int):
+        """Run ``fn(chunk_ids) -> (rounds, decision)`` over fixed-size chunks.
+
+        The tail chunk is padded (with a repeated last id) to the compiled shape so
+        exactly one program per config is compiled; padded rows are discarded.
+        """
+        import jax.numpy as jnp
+
+        rounds_out = np.empty(len(ids), dtype=np.int32)
+        decision_out = np.empty(len(ids), dtype=np.uint8)
+        for lo in range(0, len(ids), chunk):
+            hi = min(lo + chunk, len(ids))
+            cids = ids[lo:hi]
+            if len(cids) < chunk:
+                cids = np.concatenate([cids, np.full(chunk - len(cids), cids[-1])])
+            r, d = fn(jnp.asarray(cids, dtype=jnp.uint32))
+            rounds_out[lo:hi] = np.asarray(r)[: hi - lo]
+            decision_out[lo:hi] = np.asarray(d)[: hi - lo]
+        return rounds_out, decision_out
+
+    @staticmethod
     def _resolve_inst_ids(cfg: SimConfig, inst_ids) -> np.ndarray:
         if inst_ids is None:
             return np.arange(cfg.instances, dtype=np.int64)
@@ -66,10 +87,14 @@ def register_backend(name: str, factory: Callable[[], SimulatorBackend]) -> None
 
 
 def get_backend(name: str) -> SimulatorBackend:
+    """Look up a backend; ``name`` may carry a parameter suffix, e.g.
+    ``jax_sharded:4`` → the ``jax_sharded`` factory called with ``"4"``."""
     if name not in _INSTANCES:
-        if name not in _REGISTRY:
+        base, _, param = name.partition(":")
+        if base not in _REGISTRY:
             raise KeyError(f"unknown backend {name!r}; known: {sorted(_REGISTRY)}")
-        _INSTANCES[name] = _REGISTRY[name]()
+        factory = _REGISTRY[base]
+        _INSTANCES[name] = factory(param) if param else factory()
     return _INSTANCES[name]
 
 

@@ -88,16 +88,6 @@ class JaxBackend(SimulatorBackend):
         chunk = min(self._chunk_size(cfg), max(1, len(ids)))
         fn = self._fn(cfg)
 
-        rounds_out = np.empty(len(ids), dtype=np.int32)
-        decision_out = np.empty(len(ids), dtype=np.uint8)
-        for lo in range(0, len(ids), chunk):
-            hi = min(lo + chunk, len(ids))
-            cids = ids[lo:hi]
-            if len(cids) < chunk:  # pad to the compiled shape; padded rows discarded
-                cids = np.concatenate([cids, np.full(chunk - len(cids), cids[-1])])
-            with self._device_ctx():
-                r, d = fn(jnp.asarray(cids, dtype=jnp.uint32))
-            rounds_out[lo:hi] = np.asarray(r)[: hi - lo]
-            decision_out[lo:hi] = np.asarray(d)[: hi - lo]
-
+        with self._device_ctx():
+            rounds_out, decision_out = self._run_chunked(fn, ids, chunk)
         return SimResult(config=cfg, inst_ids=ids, rounds=rounds_out, decision=decision_out)

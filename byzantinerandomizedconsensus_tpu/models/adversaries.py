@@ -60,15 +60,21 @@ class AdversaryModel:
             cr = xp.zeros(fm.shape, dtype=xp.int32)
         return {"faulty": fm, "crash_round": cr}
 
-    def inject(self, seed, inst_ids, rnd, t, honest_values, setup, xp=np):
+    def inject(self, seed, inst_ids, rnd, t, honest_values, setup, xp=np, recv_ids=None):
         """Apply the adversary to one step's honest outgoing values (spec §6).
 
         ``honest_values``: (B, n) uint8 in {0,1,2} — what each replica's honest state
         machine sends this step (faulty replicas run the honest machine too, §6.3).
-        Returns (values, silent, bias) as described in the module docstring.
+        Returns (values, silent, bias) as described in the module docstring; the
+        receiver axis of per-receiver outputs (equivocation values, adaptive bias) is
+        restricted to ``recv_ids`` (global indices) when given — the replica-shard
+        path of parallel/sharded.py. Sender-axis outputs are always full width.
         """
         cfg = self.cfg
         B, n = honest_values.shape
+        if recv_ids is None:
+            recv_ids = xp.arange(n, dtype=xp.uint32)
+        recv_ids = xp.asarray(recv_ids, dtype=xp.uint32)
         faulty = setup["faulty"]
         no_bias = xp.zeros((B, 1, n), dtype=xp.uint32)
         zero_silent = xp.zeros((B, n), dtype=bool)
@@ -93,13 +99,14 @@ class AdversaryModel:
                 values = xp.where(faulty, v, honest_values).astype(xp.uint8)
                 return values, silent, no_bias
             # Plain Ben-Or pairing: full per-receiver equivocation matrix (spec §6.3).
-            recv3 = xp.arange(n, dtype=xp.uint32)[None, :, None]
+            R = recv_ids.shape[0]
+            recv3 = recv_ids[None, :, None]
             send3 = xp.arange(n, dtype=xp.uint32)[None, None, :]
             inst3 = xp.asarray(inst_ids, dtype=xp.uint32)[:, None, None]
             e = prf.prf_u32(seed, inst3, rnd, t, recv3, send3, prf.BYZ_VALUE, xp=xp)
             vmat = (e % xp.uint32(3)).astype(xp.uint8)  # {0,1,2=silent-to-this-recv}
             values = xp.where(faulty[:, None, :], vmat,
-                              xp.broadcast_to(honest_values[:, None, :], (B, n, n)).astype(xp.uint8))
+                              xp.broadcast_to(honest_values[:, None, :], (B, R, n)).astype(xp.uint8))
             return values, zero_silent, no_bias
 
         if cfg.adversary == "adaptive":
@@ -112,7 +119,7 @@ class AdversaryModel:
             values = xp.where(faulty, minority[:, None], honest_values).astype(xp.uint8)
             # Receiver v prefers value 0 iff v < n/2; senders whose wire value matches
             # the receiver's preference get bias 0 (delivered first), others bias 1.
-            pref = (xp.arange(n, dtype=xp.int32) >= (n + 1) // 2)[None, :, None].astype(xp.uint8)
+            pref = (recv_ids.astype(xp.int32) >= (n + 1) // 2)[None, :, None].astype(xp.uint8)
             vv = values[:, None, :]
             bias = ((vv == 2) | (vv != pref)).astype(xp.uint32)
             return values, zero_silent, bias

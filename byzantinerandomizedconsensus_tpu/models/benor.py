@@ -14,14 +14,23 @@ from byzantinerandomizedconsensus_tpu.models import coins
 from byzantinerandomizedconsensus_tpu.ops import masks, tally
 
 
-def _step_counts(cfg, seed, inst_ids, rnd, t, values, silent, bias, xp):
-    m = masks.delivery_mask(cfg, seed, inst_ids, rnd, t, silent, bias, xp=xp)
+def _step_counts(cfg, seed, inst_ids, rnd, t, values, silent, bias, xp, recv_ids=None):
+    m = masks.delivery_mask(cfg, seed, inst_ids, rnd, t, silent, bias, xp=xp,
+                            recv_ids=recv_ids)
     return tally.tally01(m, values, xp=xp)
 
 
-def round_body(cfg, seed, inst_ids, rnd, state, adv, setup, xp=np):
-    """Execute one Ben-Or round; returns the new state dict."""
+def round_body(cfg, seed, inst_ids, rnd, state, adv, setup, xp=np,
+               recv_ids=None, gather=None):
+    """Execute one Ben-Or round; returns the new state dict.
+
+    ``recv_ids``/``gather`` support the replica-sharded path (parallel/sharded.py):
+    state arrays carry only the local receiver shard; ``gather`` all-gathers a
+    (B, R) per-sender value array to full (B, n) width before broadcast.
+    """
     n, f = cfg.n, cfg.f
+    if gather is None:
+        gather = lambda v: v
     est, decided = state["est"], state["decided"]
 
     # Protocol A (benign) vs Protocol B (lying) thresholds — spec §5.1.
@@ -29,18 +38,20 @@ def round_body(cfg, seed, inst_ids, rnd, state, adv, setup, xp=np):
     adopt_min = f + 1 if cfg.lying_adversary else 1
 
     # Step 0 — report: broadcast est.
-    v0, silent0, bias0 = adv.inject(seed, inst_ids, rnd, 0, est, setup, xp=xp)
-    r0, r1 = _step_counts(cfg, seed, inst_ids, rnd, 0, v0, silent0, bias0, xp)
+    v0, silent0, bias0 = adv.inject(seed, inst_ids, rnd, 0, gather(est), setup,
+                                    xp=xp, recv_ids=recv_ids)
+    r0, r1 = _step_counts(cfg, seed, inst_ids, rnd, 0, v0, silent0, bias0, xp, recv_ids)
     prop = xp.where(2 * r1 > quorum_rhs, xp.uint8(1),
                     xp.where(2 * r0 > quorum_rhs, xp.uint8(0), xp.uint8(2)))
 
     # Step 1 — propose: broadcast prop (bot = 2 excluded from counts).
-    v1, silent1, bias1 = adv.inject(seed, inst_ids, rnd, 1, prop, setup, xp=xp)
-    p0, p1 = _step_counts(cfg, seed, inst_ids, rnd, 1, v1, silent1, bias1, xp)
+    v1, silent1, bias1 = adv.inject(seed, inst_ids, rnd, 1, gather(prop), setup,
+                                    xp=xp, recv_ids=recv_ids)
+    p0, p1 = _step_counts(cfg, seed, inst_ids, rnd, 1, v1, silent1, bias1, xp, recv_ids)
     w = (p1 >= p0).astype(xp.uint8)
     c = xp.where(w == 1, p1, p0)
 
-    coin = coins.coin_bits(cfg, seed, inst_ids, rnd, xp=xp)
+    coin = coins.coin_bits(cfg, seed, inst_ids, rnd, xp=xp, recv_ids=recv_ids)
     new_est = xp.where(c >= adopt_min, w, coin).astype(xp.uint8)
     decide_now = (2 * c > n + f) if cfg.lying_adversary else (c >= f + 1)
 

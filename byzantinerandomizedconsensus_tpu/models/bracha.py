@@ -17,39 +17,52 @@ from byzantinerandomizedconsensus_tpu.models import coins, validation
 from byzantinerandomizedconsensus_tpu.ops import masks, tally
 
 
-def _step_counts(cfg, seed, inst_ids, rnd, t, values, silent, bias, xp):
-    m = masks.delivery_mask(cfg, seed, inst_ids, rnd, t, silent, bias, xp=xp)
+def _step_counts(cfg, seed, inst_ids, rnd, t, values, silent, bias, xp, recv_ids=None):
+    m = masks.delivery_mask(cfg, seed, inst_ids, rnd, t, silent, bias, xp=xp,
+                            recv_ids=recv_ids)
     return tally.tally01(m, values, xp=xp)
 
 
-def round_body(cfg, seed, inst_ids, rnd, state, adv, setup, xp=np):
-    """Execute one Bracha round; returns the new state dict."""
+def round_body(cfg, seed, inst_ids, rnd, state, adv, setup, xp=np,
+               recv_ids=None, gather=None):
+    """Execute one Bracha round; returns the new state dict.
+
+    ``recv_ids``/``gather`` support the replica-sharded path (parallel/sharded.py):
+    state arrays carry only the local receiver shard; ``gather`` all-gathers a
+    (B, R) per-sender value array to full (B, n) width before broadcast. Validation
+    and live counts operate on full sender width and need no changes.
+    """
     n, f = cfg.n, cfg.f
+    if gather is None:
+        gather = lambda v: v
     est, decided = state["est"], state["decided"]
 
     # Step 0 — broadcast est; majority of delivered (ties -> 1).
-    v0, s0, b0 = adv.inject(seed, inst_ids, rnd, 0, est, setup, xp=xp)
+    v0, s0, b0 = adv.inject(seed, inst_ids, rnd, 0, gather(est), setup, xp=xp,
+                            recv_ids=recv_ids)
     g0_0, g0_1 = validation.live_counts(v0, s0, xp=xp)
-    c0_0, c0_1 = _step_counts(cfg, seed, inst_ids, rnd, 0, v0, s0, b0, xp)
+    c0_0, c0_1 = _step_counts(cfg, seed, inst_ids, rnd, 0, v0, s0, b0, xp, recv_ids)
     m = (c0_1 >= c0_0).astype(xp.uint8)
 
     # Step 1 — broadcast m; invalid messages silenced pre-delivery (spec §5.1b);
     # decide-proposal needs an absolute > n/2 quorum.
-    v1, s1, b1 = adv.inject(seed, inst_ids, rnd, 1, m, setup, xp=xp)
+    v1, s1, b1 = adv.inject(seed, inst_ids, rnd, 1, gather(m), setup, xp=xp,
+                            recv_ids=recv_ids)
     s1 = s1 | validation.validate_step1(cfg, v1, g0_0, g0_1, xp=xp)
     g1_0, g1_1 = validation.live_counts(v1, s1, xp=xp)
-    c1_0, c1_1 = _step_counts(cfg, seed, inst_ids, rnd, 1, v1, s1, b1, xp)
+    c1_0, c1_1 = _step_counts(cfg, seed, inst_ids, rnd, 1, v1, s1, b1, xp, recv_ids)
     d = xp.where(2 * c1_1 > n, xp.uint8(1),
                  xp.where(2 * c1_0 > n, xp.uint8(0), xp.uint8(2)))
 
     # Step 2 — broadcast d (bot = 2 excluded from counts); validated against G1.
-    v2, s2, b2 = adv.inject(seed, inst_ids, rnd, 2, d, setup, xp=xp)
+    v2, s2, b2 = adv.inject(seed, inst_ids, rnd, 2, gather(d), setup, xp=xp,
+                            recv_ids=recv_ids)
     s2 = s2 | validation.validate_step2(cfg, v2, g1_0, g1_1, xp=xp)
-    c2_0, c2_1 = _step_counts(cfg, seed, inst_ids, rnd, 2, v2, s2, b2, xp)
+    c2_0, c2_1 = _step_counts(cfg, seed, inst_ids, rnd, 2, v2, s2, b2, xp, recv_ids)
     w = (c2_1 >= c2_0).astype(xp.uint8)
     c = xp.where(w == 1, c2_1, c2_0)
 
-    coin = coins.coin_bits(cfg, seed, inst_ids, rnd, xp=xp)
+    coin = coins.coin_bits(cfg, seed, inst_ids, rnd, xp=xp, recv_ids=recv_ids)
     decide_now = c >= 2 * f + 1
     adopt = c >= f + 1
     new_est = xp.where(adopt, w, coin).astype(xp.uint8)

@@ -13,12 +13,14 @@ import numpy as np
 from byzantinerandomizedconsensus_tpu.ops import prf
 
 
-def coin_bits(cfg, seed, inst_ids, rnd, xp=np):
-    """Coin bits for every replica, shape (B, n) uint8."""
+def coin_bits(cfg, seed, inst_ids, rnd, xp=np, recv_ids=None):
+    """Coin bits, shape (B, R) uint8 — R = len(recv_ids) (a replica shard) or n."""
     inst = xp.asarray(inst_ids, dtype=xp.uint32)[:, None]
+    if recv_ids is None:
+        recv_ids = xp.arange(cfg.n, dtype=xp.uint32)
+    replica = xp.asarray(recv_ids, dtype=xp.uint32)[None, :]
     if cfg.coin == "shared":
         bit = prf.prf_bit(seed, inst, rnd, prf.COIN_STEP, 0, 0, prf.SHARED_COIN, xp=xp)
-        return xp.broadcast_to(bit.astype(xp.uint8), (inst.shape[0], cfg.n))
-    replica = xp.arange(cfg.n, dtype=xp.uint32)[None, :]
+        return xp.broadcast_to(bit.astype(xp.uint8), (inst.shape[0], replica.shape[1]))
     bit = prf.prf_bit(seed, inst, rnd, prf.COIN_STEP, replica, 0, prf.LOCAL_COIN, xp=xp)
     return bit.astype(xp.uint8)

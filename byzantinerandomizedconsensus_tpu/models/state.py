@@ -7,27 +7,32 @@ import numpy as np
 from byzantinerandomizedconsensus_tpu.ops import prf
 
 
-def init_est(cfg, seed, inst_ids, xp=np):
-    """(B, n) uint8 initial estimates (spec §3.1)."""
+def init_est(cfg, seed, inst_ids, xp=np, recv_ids=None):
+    """(B, R) uint8 initial estimates (spec §3.1); R = len(recv_ids) or n."""
     B = inst_ids.shape[0]
+    if recv_ids is None:
+        recv_ids = xp.arange(cfg.n, dtype=xp.uint32)
+    replica = xp.asarray(recv_ids, dtype=xp.uint32)[None, :]
+    R = replica.shape[1]
     if cfg.init == "all0":
-        return xp.zeros((B, cfg.n), dtype=xp.uint8)
+        return xp.zeros((B, R), dtype=xp.uint8)
     if cfg.init == "all1":
-        return xp.ones((B, cfg.n), dtype=xp.uint8)
-    replica = xp.arange(cfg.n, dtype=xp.uint32)[None, :]
+        return xp.ones((B, R), dtype=xp.uint8)
     if cfg.init == "split":
-        return xp.broadcast_to((replica & xp.uint32(1)).astype(xp.uint8), (B, cfg.n))
+        return xp.broadcast_to((replica & xp.uint32(1)).astype(xp.uint8), (B, R))
     inst = xp.asarray(inst_ids, dtype=xp.uint32)[:, None]
     return prf.prf_bit(seed, inst, 0, 0, replica, 0, prf.INIT_EST, xp=xp).astype(xp.uint8)
 
 
-def init_state(cfg, seed, inst_ids, xp=np):
+def init_state(cfg, seed, inst_ids, xp=np, recv_ids=None):
     B = inst_ids.shape[0]
+    est = init_est(cfg, seed, inst_ids, xp=xp, recv_ids=recv_ids)
+    R = est.shape[1]
     return {
-        "est": init_est(cfg, seed, inst_ids, xp=xp),
-        "decided": xp.zeros((B, cfg.n), dtype=bool),
-        "decided_val": xp.zeros((B, cfg.n), dtype=xp.uint8),
-        "phase": xp.zeros((B, cfg.n), dtype=xp.int32),
+        "est": est,
+        "decided": xp.zeros((B, R), dtype=bool),
+        "decided_val": xp.zeros((B, R), dtype=xp.uint8),
+        "phase": xp.zeros((B, R), dtype=xp.int32),
     }
 
 

@@ -18,15 +18,19 @@ import numpy as np
 from byzantinerandomizedconsensus_tpu.ops import prf
 
 
-def combined_keys(cfg, seed, inst_ids, rnd, t, silent, bias, xp=np):
-    """Combined scheduling keys, shape (B, n, n) uint32, axes (instance, recv, send).
+def combined_keys(cfg, seed, inst_ids, rnd, t, silent, bias, xp=np, recv_ids=None):
+    """Combined scheduling keys, shape (B, R, n) uint32, axes (instance, recv, send).
 
-    ``silent``: (B, n) bool per sender; ``bias``: (B, n, n) or (B, 1, n) uint32/bool
-    per (recv, send) (0 unless the adaptive adversary is active).
+    ``silent``: (B, n) bool per sender; ``bias``: (B, R, n) or (B, 1, n) uint32/bool
+    per (recv, send) (0 unless the adaptive adversary is active). ``recv_ids`` is an
+    optional (R,) array of *global* receiver indices — a replica-axis shard of the
+    full matrix (parallel/sharded.py); default is all n receivers.
     """
     n = cfg.n
     u32 = xp.uint32
-    recv = xp.arange(n, dtype=xp.uint32)[None, :, None]
+    if recv_ids is None:
+        recv_ids = xp.arange(n, dtype=xp.uint32)
+    recv = xp.asarray(recv_ids, dtype=xp.uint32)[None, :, None]
     send = xp.arange(n, dtype=xp.uint32)[None, None, :]
     sched = prf.prf_u32(
         seed, xp.asarray(inst_ids, dtype=xp.uint32)[:, None, None],
@@ -46,8 +50,8 @@ def combined_keys(cfg, seed, inst_ids, rnd, t, silent, bias, xp=np):
     return combined
 
 
-def mask_from_keys(combined, n_deliver: int, silent, xp=np):
-    """Delivery mask (B, n, n) bool from combined keys: the ``n_deliver`` smallest
+def mask_from_keys(combined, n_deliver: int, silent, xp=np, recv_ids=None):
+    """Delivery mask (B, R, n) bool from combined keys: the ``n_deliver`` smallest
     per receiver row, excluding silent senders (redundant by the bit-31 argument in
     spec §4, kept as a guard)."""
     if xp is np:
@@ -56,13 +60,17 @@ def mask_from_keys(combined, n_deliver: int, silent, xp=np):
         kth = xp.sort(combined, axis=-1)[..., n_deliver - 1]
     mask = combined <= kth[..., None]
     n = combined.shape[-1]
-    own = xp.eye(n, dtype=bool)[None]
+    if recv_ids is None:
+        recv_ids = xp.arange(n, dtype=xp.uint32)
+    own = (xp.asarray(recv_ids, dtype=xp.uint32)[:, None]
+           == xp.arange(n, dtype=xp.uint32)[None, :])[None]
     # Own message is delivered unconditionally (spec §4): exempt from silence AND
     # from the quota selection (aligned with the oracle's Network.delivery_mask).
     return (mask & ~xp.asarray(silent, dtype=bool)[:, None, :]) | own
 
 
-def delivery_mask(cfg, seed, inst_ids, rnd, t, silent, bias, xp=np):
-    """(B, n, n) bool — delivered(recv, send) per spec §4."""
-    combined = combined_keys(cfg, seed, inst_ids, rnd, t, silent, bias, xp=xp)
-    return mask_from_keys(combined, cfg.n - cfg.f, silent, xp=xp)
+def delivery_mask(cfg, seed, inst_ids, rnd, t, silent, bias, xp=np, recv_ids=None):
+    """(B, R, n) bool — delivered(recv, send) per spec §4."""
+    combined = combined_keys(cfg, seed, inst_ids, rnd, t, silent, bias, xp=xp,
+                             recv_ids=recv_ids)
+    return mask_from_keys(combined, cfg.n - cfg.f, silent, xp=xp, recv_ids=recv_ids)

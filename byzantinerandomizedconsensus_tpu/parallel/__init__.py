@@ -1,0 +1,11 @@
+"""Parallelism layer (SURVEY.md §2 P1-P3): the device-mesh scale-out path.
+
+- ``mesh``    — mesh construction helpers: ``(data, model)`` axes over any device set
+- ``sharded`` — the shard_map'd round driver: instances sharded over ``data`` (pure
+  Monte-Carlo data parallelism, no cross-talk), replicas sharded over ``model``
+  (all_gather of per-step sender values, psum of termination counts over ICI)
+"""
+
+from byzantinerandomizedconsensus_tpu.parallel.mesh import make_mesh
+
+__all__ = ["make_mesh"]

@@ -1,0 +1,45 @@
+"""Device-mesh construction (SURVEY.md §5 "Distributed communication backend").
+
+The simulator's two parallel axes map onto a 2-D ``jax.sharding.Mesh``:
+
+- ``data``  — independent consensus *instances* (Monte-Carlo data parallelism;
+  zero communication, so this axis can safely span DCN across hosts);
+- ``model`` — *replicas* within an instance (the O(n²) message matrix is sharded
+  by receiver row; per-step sender values ride ``all_gather`` and termination
+  counts ride ``psum``, so this axis should stay on ICI within a pod slice).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    n_data: Optional[int] = None,
+    n_model: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a ``(data, model)`` mesh. Defaults: all devices on the data axis.
+
+    ``n_data * n_model`` must equal the device count used; ``n_data=None`` infers
+    it from the device count and ``n_model``.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_data is None:
+        if len(devs) % n_model:
+            raise ValueError(f"{len(devs)} devices not divisible by n_model={n_model}")
+        n_data = len(devs) // n_model
+    if n_data * n_model != len(devs):
+        raise ValueError(
+            f"mesh {n_data}x{n_model} needs {n_data * n_model} devices, have {len(devs)}"
+        )
+    grid = np.asarray(devs).reshape(n_data, n_model)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))

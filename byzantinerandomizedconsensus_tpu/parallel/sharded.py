@@ -1,0 +1,161 @@
+"""shard_map'd round driver (SURVEY.md §2 P1-P3, §7 step 8) — the multi-chip path.
+
+Sharding layout per chunk of B instances on a ``(data, model)`` mesh:
+
+- instance axis → ``data``: each data shard simulates B/|data| instances with no
+  communication at all (independent Monte-Carlo trials);
+- replica axis → ``model``: replica *state* arrays carry only n/|model| receiver
+  rows. Each broadcast step ``all_gather``s the (B_local, n_local) per-sender wire
+  values to full (B_local, n) width — the only per-step collective, O(B·n) bytes,
+  vs the O(B·n²) message matrix which never leaves its shard. Termination counts
+  ride a ``psum``. Both collectives run over ICI when the model axis is laid out
+  within a pod slice (parallel/mesh.py).
+
+Bit-matching: the PRF addresses randomness by *global* coordinates (ops/prf.py), so
+a replica shard computes exactly the oracle's draws for its rows; tallies are exact
+integer sums over the full sender axis. The sharded backend therefore bit-matches
+the CPU oracle for every mesh shape — asserted in tests/test_sharded.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from byzantinerandomizedconsensus_tpu.backends.base import SimResult, SimulatorBackend
+from byzantinerandomizedconsensus_tpu.config import SimConfig
+from byzantinerandomizedconsensus_tpu.models import benor, bracha, state as state_mod
+from byzantinerandomizedconsensus_tpu.models.adversaries import AdversaryModel
+from byzantinerandomizedconsensus_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh
+
+
+def _run_chunk_sharded(cfg: SimConfig, mesh: Mesh, inst_ids: jnp.ndarray):
+    """Simulate one padded chunk on the mesh; returns (rounds (B,), decision (B,))."""
+    n_model = mesh.shape[MODEL_AXIS]
+    n_local = cfg.n // n_model
+    round_body = benor.round_body if cfg.protocol == "benor" else bracha.round_body
+
+    def mapped(ids_local):
+        midx = jax.lax.axis_index(MODEL_AXIS)
+        recv_ids = (midx * n_local + jnp.arange(n_local, dtype=jnp.uint32)).astype(
+            jnp.uint32
+        )
+
+        def gather(v):
+            return jax.lax.all_gather(v, MODEL_AXIS, axis=v.ndim - 1, tiled=True)
+
+        adv = AdversaryModel(cfg)
+        setup = adv.setup(cfg.seed, ids_local, xp=jnp)   # sender-width: full (B, n)
+        faulty = setup["faulty"]
+        faulty_local = jax.lax.dynamic_slice_in_dim(faulty, midx * n_local, n_local, 1)
+        st = state_mod.init_state(cfg, cfg.seed, ids_local, xp=jnp, recv_ids=recv_ids)
+        done_at = jnp.full(ids_local.shape[0], -1, dtype=jnp.int32)
+        # Constant-initialized carry components are typed unvarying; the loop body
+        # makes state (data, model)-varying and done_at data-varying (it only ever
+        # derives from psum/all_gather results, which are model-invariant) — align
+        # the carry's vma types up front.
+        def varying(axes):
+            def cast(x):
+                need = tuple(a for a in axes if a not in jax.typeof(x).vma)
+                return jax.lax.pcast(x, need, to="varying") if need else x
+            return cast
+        st = jax.tree.map(varying((DATA_AXIS, MODEL_AXIS)), st)
+        done_at = varying((DATA_AXIS,))(done_at)
+
+        def cond(carry):
+            r, _, done_at = carry
+            return (r < cfg.round_cap) & ~jnp.all(done_at >= 0)
+
+        def body(carry):
+            r, st, done_at = carry
+            st = round_body(cfg, cfg.seed, ids_local, r, st, adv, setup, xp=jnp,
+                            recv_ids=recv_ids, gather=gather)
+            cnt = jax.lax.psum(
+                (st["decided"] | faulty_local).sum(axis=-1, dtype=jnp.int32),
+                MODEL_AXIS,
+            )
+            done_at = jnp.where((done_at < 0) & (cnt == cfg.n), r + 1, done_at)
+            return r + 1, st, done_at
+
+        _, st, done_at = jax.lax.while_loop(cond, body, (jnp.int32(0), st, done_at))
+        done = done_at >= 0
+        rounds = jnp.where(done, done_at, cfg.round_cap).astype(jnp.int32)
+        # Decision = decided_val of the lowest-indexed correct replica (spec §1).
+        # The owning model shard contributes it through a psum, which keeps the
+        # output provably model-invariant for the out_specs replication check.
+        first_correct = jnp.argmax(~faulty, axis=-1).astype(jnp.int32)
+        local_pos = first_correct - midx.astype(jnp.int32) * n_local
+        owns = (local_pos >= 0) & (local_pos < n_local)
+        safe = jnp.clip(local_pos, 0, n_local - 1)
+        v_local = jnp.take_along_axis(st["decided_val"], safe[:, None], axis=-1)[:, 0]
+        val = jax.lax.psum(
+            jnp.where(owns, v_local.astype(jnp.int32), 0), MODEL_AXIS
+        )
+        decision = jnp.where(done, val, 2).astype(jnp.uint8)
+        return rounds, decision
+
+    return jax.shard_map(
+        mapped,
+        mesh=mesh,
+        in_specs=P(DATA_AXIS),
+        out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+    )(inst_ids)
+
+
+class JaxShardedBackend(SimulatorBackend):
+    """Mesh-parallel backend: instances over ``data``, replicas over ``model``.
+
+    ``mesh=None`` builds a default mesh of all visible devices with the requested
+    ``n_model`` (replica-shard count; must divide cfg.n).
+    """
+
+    name = "jax_sharded"
+
+    def __init__(self, mesh: Optional[Mesh] = None, n_model: int = 1,
+                 chunk_bytes: int = 1 << 30, max_chunk: int = 1 << 16):
+        self._mesh = mesh
+        self._n_model = n_model
+        self.chunk_bytes = chunk_bytes
+        self.max_chunk = max_chunk
+        self._compiled = {}
+
+    @property
+    def mesh(self) -> Mesh:
+        if self._mesh is None:
+            self._mesh = make_mesh(n_model=self._n_model)
+        return self._mesh
+
+    def _chunk_size(self, cfg: SimConfig) -> int:
+        """Total chunk B across the mesh; per-device transients are (B/|data|, n/|model|, n)."""
+        mesh = self.mesh
+        per_inst = cfg.n * (cfg.n // mesh.shape[MODEL_AXIS]) * 4 * 4
+        per_dev = max(1, self.chunk_bytes // max(per_inst, 1))
+        b = min(self.max_chunk, per_dev * mesh.shape[DATA_AXIS])
+        # Round down to a data-axis multiple (≥ one instance per data shard).
+        return max(mesh.shape[DATA_AXIS], b - b % mesh.shape[DATA_AXIS])
+
+    def _fn(self, cfg: SimConfig):
+        if cfg not in self._compiled:
+            self._compiled[cfg] = jax.jit(partial(_run_chunk_sharded, cfg, self.mesh))
+        return self._compiled[cfg]
+
+    def run(self, cfg: SimConfig, inst_ids: Optional[np.ndarray] = None) -> SimResult:
+        cfg = cfg.validate()
+        mesh = self.mesh
+        if cfg.n % mesh.shape[MODEL_AXIS]:
+            raise ValueError(
+                f"n={cfg.n} not divisible by model-axis size {mesh.shape[MODEL_AXIS]}"
+            )
+        ids = self._resolve_inst_ids(cfg, inst_ids)
+        chunk = min(self._chunk_size(cfg), len(ids))
+        chunk = max(mesh.shape[DATA_AXIS], chunk - chunk % mesh.shape[DATA_AXIS])
+        fn = self._fn(cfg)
+
+        rounds_out, decision_out = self._run_chunked(fn, ids, chunk)
+        return SimResult(config=cfg, inst_ids=ids, rounds=rounds_out, decision=decision_out)

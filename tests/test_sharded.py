@@ -1,0 +1,70 @@
+"""Mesh-sharded backend vs CPU oracle — bit-match on every mesh shape (SURVEY.md §4.3).
+
+Runs on the 8 virtual CPU devices from conftest.py. The sharded backend must produce
+bit-identical (rounds, decision) to the CPU oracle for every (data, model) mesh split,
+for every protocol/adversary/coin pairing — this is the multi-chip analog of
+tests/test_bitmatch.py and the [B:5] acceptance criterion.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from byzantinerandomizedconsensus_tpu import SimConfig, Simulator
+from byzantinerandomizedconsensus_tpu.parallel.mesh import make_mesh
+from byzantinerandomizedconsensus_tpu.parallel.sharded import JaxShardedBackend
+
+CONFIGS = [
+    SimConfig(protocol="benor", n=8, f=2, instances=24, adversary="crash",
+              coin="local", seed=11, round_cap=64),
+    SimConfig(protocol="bracha", n=8, f=2, instances=24, adversary="byzantine",
+              coin="shared", seed=12, round_cap=64),
+    SimConfig(protocol="bracha", n=16, f=5, instances=12, adversary="adaptive",
+              coin="shared", seed=13, round_cap=64),
+    SimConfig(protocol="benor", n=16, f=3, instances=12, adversary="byzantine",
+              coin="local", seed=14, round_cap=64),
+]
+
+MESHES = [(8, 1), (4, 2), (2, 4), (1, 8)]
+
+
+def _cpu_devices(count):
+    devs = jax.devices("cpu")
+    if len(devs) < count:
+        pytest.skip(f"needs {count} cpu devices")
+    return devs[:count]
+
+
+@pytest.fixture(scope="module")
+def oracle_results():
+    return {cfg: Simulator(cfg, "cpu").run() for cfg in CONFIGS}
+
+
+@pytest.mark.parametrize("n_data,n_model", MESHES)
+@pytest.mark.parametrize("cfg", CONFIGS, ids=lambda c: f"{c.protocol}-{c.adversary}-n{c.n}")
+def test_sharded_bitmatch(cfg, n_data, n_model, oracle_results):
+    mesh = make_mesh(n_data=n_data, n_model=n_model,
+                     devices=_cpu_devices(n_data * n_model))
+    backend = JaxShardedBackend(mesh=mesh)
+    got = backend.run(cfg)
+    ref = oracle_results[cfg]
+    np.testing.assert_array_equal(got.rounds, ref.rounds)
+    np.testing.assert_array_equal(got.decision, ref.decision)
+
+
+def test_sharded_chunking_matches_unchunked():
+    """Chunk boundaries (with padding) must not affect results."""
+    cfg = SimConfig(protocol="bracha", n=8, f=2, instances=30, adversary="byzantine",
+                    coin="shared", seed=7, round_cap=64)
+    mesh = make_mesh(n_data=4, n_model=2, devices=_cpu_devices(8))
+    big = JaxShardedBackend(mesh=mesh).run(cfg)
+    small = JaxShardedBackend(mesh=mesh, max_chunk=8).run(cfg)
+    np.testing.assert_array_equal(big.rounds, small.rounds)
+    np.testing.assert_array_equal(big.decision, small.decision)
+
+
+def test_registry_exposes_sharded():
+    from byzantinerandomizedconsensus_tpu.backends import available_backends
+
+    assert "jax_sharded" in available_backends()
