@@ -1,5 +1,16 @@
 """Test env: force JAX onto 8 virtual CPU devices (SURVEY.md §4.3) before jax imports.
 
+Two layers of defense, because the environment may carry an `axon` TPU-tunnel PJRT
+plugin that a sitecustomize registers in every interpreter and that pins
+``jax.config.jax_platforms = "axon,cpu"`` (overriding the JAX_PLATFORMS env var).
+Initializing that backend dials a tunnel and can block for minutes when the tunnel
+is down — tests must never touch it:
+
+1. env vars (JAX_PLATFORMS / XLA_FLAGS) — effective in clean environments;
+2. drop the ``axon`` backend factory from jax's registry and reset the
+   ``jax_platforms`` config to ``cpu`` — effective when the plugin already
+   registered itself at interpreter start. Safe no-op when no plugin exists.
+
 Real-TPU runs (bench.py, CLI) are unaffected — this applies to the test process only.
 """
 
@@ -9,3 +20,24 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def _force_cpu_backend() -> None:
+    try:
+        from jax._src import xla_bridge as xb
+
+        xb._backend_factories.pop("axon", None)
+        import jax
+
+        if xb.backends_are_initialized():  # nothing should have touched a device yet
+            from jax.extend.backend import clear_backends
+
+            clear_backends()
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        # Private-API shims for the pinned jax; if they drift, fall back to the
+        # env vars above rather than killing collection for the whole suite.
+        return
+
+
+_force_cpu_backend()
