@@ -78,11 +78,57 @@ class SimulatorBackend(abc.ABC):
         return res
 
 
-_REGISTRY: dict[str, Callable[[], SimulatorBackend]] = {}
+class JitChunkedBackend(SimulatorBackend):
+    """Shared scaffolding for jit-compiled chunked backends (jax, jax_sharded):
+    per-config compiled-function cache, chunk sizing/clamping, chunked execution,
+    and SimResult assembly. Subclasses provide ``_make_fn`` / ``_chunk_size`` and
+    may override ``_check_config`` / ``_clamp_chunk`` / ``_device_ctx``."""
+
+    def __init__(self, chunk_bytes: int, max_chunk: int):
+        self.chunk_bytes = chunk_bytes
+        self.max_chunk = max_chunk
+        self._compiled: dict = {}
+
+    def _make_fn(self, cfg: SimConfig):
+        raise NotImplementedError
+
+    def _chunk_size(self, cfg: SimConfig) -> int:
+        raise NotImplementedError
+
+    def _check_config(self, cfg: SimConfig) -> None:
+        pass
+
+    def _clamp_chunk(self, cfg: SimConfig, chunk: int) -> int:
+        return chunk
+
+    def _device_ctx(self):
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    def _fn(self, cfg: SimConfig):
+        if cfg not in self._compiled:
+            self._compiled[cfg] = self._make_fn(cfg)
+        return self._compiled[cfg]
+
+    def run(self, cfg: SimConfig, inst_ids: Optional[np.ndarray] = None) -> SimResult:
+        cfg = cfg.validate()
+        self._check_config(cfg)
+        ids = self._resolve_inst_ids(cfg, inst_ids)
+        chunk = self._clamp_chunk(cfg, min(self._chunk_size(cfg), max(1, len(ids))))
+        fn = self._fn(cfg)
+        with self._device_ctx():
+            rounds_out, decision_out = self._run_chunked(fn, ids, chunk)
+        return SimResult(config=cfg, inst_ids=ids, rounds=rounds_out, decision=decision_out)
+
+
+_REGISTRY: dict[str, Callable[..., SimulatorBackend]] = {}
 _INSTANCES: dict[str, SimulatorBackend] = {}
 
 
-def register_backend(name: str, factory: Callable[[], SimulatorBackend]) -> None:
+def register_backend(name: str, factory: Callable[..., SimulatorBackend]) -> None:
+    """``factory`` takes no arguments, or one string argument if the backend
+    accepts a ``name:param`` suffix (see :func:`get_backend`)."""
     _REGISTRY[name] = factory
 
 
@@ -94,7 +140,16 @@ def get_backend(name: str) -> SimulatorBackend:
         if base not in _REGISTRY:
             raise KeyError(f"unknown backend {name!r}; known: {sorted(_REGISTRY)}")
         factory = _REGISTRY[base]
-        _INSTANCES[name] = factory(param) if param else factory()
+        if param:
+            try:
+                _INSTANCES[name] = factory(param)
+            except TypeError as e:
+                raise ValueError(
+                    f"backend {base!r} does not take a {name.partition(':')[2]!r} "
+                    f"parameter ({e})"
+                ) from None
+        else:
+            _INSTANCES[name] = factory()
     return _INSTANCES[name]
 
 

@@ -22,7 +22,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from byzantinerandomizedconsensus_tpu.backends.base import SimResult, SimulatorBackend
+from byzantinerandomizedconsensus_tpu.backends.base import JitChunkedBackend
 from byzantinerandomizedconsensus_tpu.config import SimConfig
 from byzantinerandomizedconsensus_tpu.models import benor, bracha, state as state_mod
 from byzantinerandomizedconsensus_tpu.models.adversaries import AdversaryModel
@@ -55,39 +55,23 @@ def _run_chunk(cfg: SimConfig, inst_ids: jnp.ndarray):
     return rounds, decision
 
 
-class JaxBackend(SimulatorBackend):
+class JaxBackend(JitChunkedBackend):
     """``device='tpu'|'cpu'|None`` pins the computation; None = JAX default device."""
 
     name = "jax"
 
     def __init__(self, chunk_bytes: int = 1 << 30, max_chunk: int = 1 << 14, device=None):
-        self.chunk_bytes = chunk_bytes
-        self.max_chunk = max_chunk
+        super().__init__(chunk_bytes, max_chunk)
         self.device = device
-        self._compiled = {}
 
     def _chunk_size(self, cfg: SimConfig) -> int:
         per_inst = cfg.n * cfg.n * 4 * 4  # ~4 live (B,n,n) u32-sized transients
         return max(1, min(self.max_chunk, self.chunk_bytes // per_inst))
 
-    def _fn(self, cfg: SimConfig):
-        if cfg not in self._compiled:
-            self._compiled[cfg] = jax.jit(partial(_run_chunk, cfg))
-        return self._compiled[cfg]
+    def _make_fn(self, cfg: SimConfig):
+        return jax.jit(partial(_run_chunk, cfg))
 
     def _device_ctx(self):
         if self.device is None:
-            import contextlib
-
-            return contextlib.nullcontext()
+            return super()._device_ctx()
         return jax.default_device(jax.devices(self.device)[0])
-
-    def run(self, cfg: SimConfig, inst_ids: Optional[np.ndarray] = None) -> SimResult:
-        cfg = cfg.validate()
-        ids = self._resolve_inst_ids(cfg, inst_ids)
-        chunk = min(self._chunk_size(cfg), max(1, len(ids)))
-        fn = self._fn(cfg)
-
-        with self._device_ctx():
-            rounds_out, decision_out = self._run_chunked(fn, ids, chunk)
-        return SimResult(config=cfg, inst_ids=ids, rounds=rounds_out, decision=decision_out)

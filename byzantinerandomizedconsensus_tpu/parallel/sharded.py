@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from byzantinerandomizedconsensus_tpu.backends.base import SimResult, SimulatorBackend
+from byzantinerandomizedconsensus_tpu.backends.base import JitChunkedBackend
 from byzantinerandomizedconsensus_tpu.config import SimConfig
 from byzantinerandomizedconsensus_tpu.models import benor, bracha, state as state_mod
 from byzantinerandomizedconsensus_tpu.models.adversaries import AdversaryModel
@@ -108,7 +108,7 @@ def _run_chunk_sharded(cfg: SimConfig, mesh: Mesh, inst_ids: jnp.ndarray):
     )(inst_ids)
 
 
-class JaxShardedBackend(SimulatorBackend):
+class JaxShardedBackend(JitChunkedBackend):
     """Mesh-parallel backend: instances over ``data``, replicas over ``model``.
 
     ``mesh=None`` builds a default mesh of all visible devices with the requested
@@ -119,11 +119,9 @@ class JaxShardedBackend(SimulatorBackend):
 
     def __init__(self, mesh: Optional[Mesh] = None, n_model: int = 1,
                  chunk_bytes: int = 1 << 30, max_chunk: int = 1 << 16):
+        super().__init__(chunk_bytes, max_chunk)
         self._mesh = mesh
         self._n_model = n_model
-        self.chunk_bytes = chunk_bytes
-        self.max_chunk = max_chunk
-        self._compiled = {}
 
     @property
     def mesh(self) -> Mesh:
@@ -140,22 +138,15 @@ class JaxShardedBackend(SimulatorBackend):
         # Round down to a data-axis multiple (≥ one instance per data shard).
         return max(mesh.shape[DATA_AXIS], b - b % mesh.shape[DATA_AXIS])
 
-    def _fn(self, cfg: SimConfig):
-        if cfg not in self._compiled:
-            self._compiled[cfg] = jax.jit(partial(_run_chunk_sharded, cfg, self.mesh))
-        return self._compiled[cfg]
-
-    def run(self, cfg: SimConfig, inst_ids: Optional[np.ndarray] = None) -> SimResult:
-        cfg = cfg.validate()
-        mesh = self.mesh
-        if cfg.n % mesh.shape[MODEL_AXIS]:
+    def _check_config(self, cfg: SimConfig) -> None:
+        if cfg.n % self.mesh.shape[MODEL_AXIS]:
             raise ValueError(
-                f"n={cfg.n} not divisible by model-axis size {mesh.shape[MODEL_AXIS]}"
+                f"n={cfg.n} not divisible by model-axis size {self.mesh.shape[MODEL_AXIS]}"
             )
-        ids = self._resolve_inst_ids(cfg, inst_ids)
-        chunk = min(self._chunk_size(cfg), len(ids))
-        chunk = max(mesh.shape[DATA_AXIS], chunk - chunk % mesh.shape[DATA_AXIS])
-        fn = self._fn(cfg)
 
-        rounds_out, decision_out = self._run_chunked(fn, ids, chunk)
-        return SimResult(config=cfg, inst_ids=ids, rounds=rounds_out, decision=decision_out)
+    def _clamp_chunk(self, cfg: SimConfig, chunk: int) -> int:
+        n_data = self.mesh.shape[DATA_AXIS]
+        return max(n_data, chunk - chunk % n_data)
+
+    def _make_fn(self, cfg: SimConfig):
+        return jax.jit(partial(_run_chunk_sharded, cfg, self.mesh))
