@@ -1,5 +1,6 @@
 """Backend registry: ``cpu`` (oracle, default), ``numpy`` (vectorized host),
-``jax`` (jit/TPU), ``jax_cpu`` (jit pinned to host devices, for CI bit-matching)."""
+``native`` (multithreaded C++ core), ``jax`` (jit/TPU), ``jax_cpu`` (jit pinned to
+host devices, for CI bit-matching), ``jax_sharded`` (mesh-parallel)."""
 
 from byzantinerandomizedconsensus_tpu.backends.base import (
     SimResult,
@@ -34,6 +35,13 @@ def _jax_cpu():
     return JaxBackend(device="cpu")
 
 
+def _native(n_threads: str = "0"):
+    """``native`` or ``native:<threads>`` — the C++ core (native/simcore.cpp)."""
+    from byzantinerandomizedconsensus_tpu.backends.native_backend import NativeBackend
+
+    return NativeBackend(n_threads=int(n_threads))
+
+
 def _jax_sharded(n_model: str = "1"):
     """``jax_sharded`` or ``jax_sharded:<n_model>`` — replica-shard count over the
     mesh's model axis (must divide the device count and cfg.n)."""
@@ -47,6 +55,7 @@ register_backend("numpy", _numpy)
 register_backend("jax", _jax)
 register_backend("jax_cpu", _jax_cpu)
 register_backend("jax_sharded", _jax_sharded)
+register_backend("native", _native)
 
 __all__ = [
     "SimResult",

@@ -1,0 +1,106 @@
+"""Native C++ backend — the multithreaded host runtime (native/simcore.cpp).
+
+Compiles the C++ core with g++ on first use (cached in ``native/build/`` keyed by
+a source hash + ABI version) and drives it through ctypes — no pybind11 needed.
+Bit-matches the CPU oracle (tests/test_native.py); its role is fast host-side
+validation and baselines at sizes where the Python object loop is impractical
+(SURVEY.md §2 component inventory: native runtime leg).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import pathlib
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu.backends.base import SimResult, SimulatorBackend
+from byzantinerandomizedconsensus_tpu.config import SimConfig
+
+_PROTO = {"benor": 0, "bracha": 1}
+_ADV = {"none": 0, "crash": 1, "byzantine": 2, "adaptive": 3}
+_COIN = {"local": 0, "shared": 1}
+_INIT = {"random": 0, "all0": 1, "all1": 2, "split": 3}
+
+_ABI_VERSION = 1
+
+_lib = None
+
+
+def _source_path() -> pathlib.Path:
+    return pathlib.Path(__file__).resolve().parents[2] / "native" / "simcore.cpp"
+
+
+def build_library(force: bool = False) -> pathlib.Path:
+    """Compile native/simcore.cpp to a cached shared library; returns its path."""
+    src = _source_path()
+    if not src.exists():
+        raise FileNotFoundError(f"native source not found: {src}")
+    digest = hashlib.sha256(src.read_bytes()).hexdigest()[:16]
+    build_dir = src.parent / "build"
+    build_dir.mkdir(exist_ok=True)
+    so = build_dir / f"simcore-v{_ABI_VERSION}-{digest}.so"
+    if so.exists() and not force:
+        return so
+    base = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+            str(src), "-o", str(so)]
+    # -march=native when the toolchain supports it; plain -O3 otherwise.
+    for cmd in ([*base[:2], "-march=native", *base[2:]], base):
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+            return so
+        except FileNotFoundError:
+            raise RuntimeError("g++ not found; the native backend needs a C++ toolchain")
+        except subprocess.CalledProcessError as e:
+            err = e.stderr
+    raise RuntimeError(f"native build failed:\n{err}")
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(str(build_library()))
+        lib.sim_abi_version.restype = ctypes.c_int
+        if lib.sim_abi_version() != _ABI_VERSION:
+            raise RuntimeError("native library ABI mismatch; rebuild")
+        lib.sim_run.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
+            ctypes.c_int,
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            ctypes.c_int64, ctypes.c_int,
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+        ]
+        lib.sim_run.restype = None
+        _lib = lib
+    return _lib
+
+
+class NativeBackend(SimulatorBackend):
+    """``n_threads=0`` (default) uses all CPUs."""
+
+    name = "native"
+
+    def __init__(self, n_threads: int = 0):
+        self.n_threads = n_threads or (os.cpu_count() or 1)
+
+    def run(self, cfg: SimConfig, inst_ids: Optional[np.ndarray] = None) -> SimResult:
+        cfg = cfg.validate()
+        lib = _load()
+        ids = np.ascontiguousarray(self._resolve_inst_ids(cfg, inst_ids))
+        rounds = np.empty(len(ids), dtype=np.int32)
+        decision = np.empty(len(ids), dtype=np.uint8)
+        if len(ids):
+            lib.sim_run(
+                _PROTO[cfg.protocol], cfg.n, cfg.f, _ADV[cfg.adversary],
+                _COIN[cfg.coin], _INIT[cfg.init],
+                ctypes.c_uint64(cfg.seed & 0xFFFFFFFFFFFFFFFF),
+                cfg.round_cap, cfg.crash_window,
+                ids, len(ids), self.n_threads, rounds, decision,
+            )
+        return SimResult(config=cfg, inst_ids=ids, rounds=rounds, decision=decision)

@@ -35,7 +35,8 @@ def _add_config_args(p: argparse.ArgumentParser, default_backend: str = "cpu") -
     p.add_argument("--round-cap", type=int, default=None)
     p.add_argument("--init", choices=["random", "all0", "all1", "split"], default=None)
     p.add_argument("--backend", default=default_backend,
-                   help="cpu (oracle) | numpy | jax | jax_cpu")
+                   help="cpu (oracle) | numpy | native[:threads] | jax | jax_cpu "
+                        "| jax_sharded[:n_model]")
 
 
 def _config_from(args) -> SimConfig:
@@ -69,7 +70,7 @@ def cmd_bitmatch(args) -> int:
     """Sampled CPU-oracle vs accelerated-backend bit-match check."""
     if args.backend == "cpu":
         print("bitmatch compares the cpu oracle against an accelerated backend; "
-              "pass --backend numpy|jax|jax_cpu", file=sys.stderr)
+              "pass --backend numpy|native|jax|jax_cpu|jax_sharded", file=sys.stderr)
         return 2
     cfg = _config_from(args)
     rng = np.random.default_rng(cfg.seed)

@@ -1,0 +1,461 @@
+// Native simulation core — C++ implementation of the full protocol semantics
+// (spec/PROTOCOL.md §§2-6), exposed through a C ABI and loaded via ctypes by
+// byzantinerandomizedconsensus_tpu/backends/native_backend.py.
+//
+// Role in the framework (SURVEY.md §2): the reference's performance core is a
+// CPU loop; ours is the JAX/TPU backend. This file is the *native runtime* leg:
+// a multithreaded, allocation-free-per-round oracle accelerator that bit-matches
+// the Python CPU oracle (tests/test_native.py) and makes large-n bit-match
+// validation and host-side baselines cheap. It is deliberately a third,
+// independent implementation of the spec (object oracle / vectorized-array /
+// scalar C++): a semantic bug must now survive three codebases to go unnoticed.
+//
+// Randomness: the same Threefry-2x32 counter PRF as ops/prf.py, addressed by
+// (seed, instance, round, step, recv, send, purpose) coordinates — draw order
+// never matters, which is what makes cross-implementation bit-matching possible.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- PRF (spec §2)
+
+constexpr uint32_t kParity = 0x1BD11BDA;
+constexpr int kRot[8] = {13, 15, 26, 6, 17, 29, 16, 24};
+
+inline uint32_t rotl32(uint32_t x, int r) { return (x << r) | (x >> (32 - r)); }
+
+// Threefry-2x32, 20 rounds; returns the first output word (matches
+// jax._src.prng.threefry_2x32 word 0 — validated transitively through
+// ops/prf.py in tests/test_native.py).
+inline uint32_t threefry2x32(uint32_t k0, uint32_t k1, uint32_t x0, uint32_t x1) {
+  const uint32_t ks[3] = {k0, k1, k0 ^ k1 ^ kParity};
+  x0 += ks[0];
+  x1 += ks[1];
+  const uint32_t inj0[5] = {ks[1], ks[2], ks[0], ks[1], ks[2]};
+  const uint32_t inj1[5] = {ks[2], ks[0], ks[1], ks[2], ks[0]};
+  for (int g = 0; g < 5; ++g) {
+    const int* rots = &kRot[(g % 2) * 4];
+    for (int i = 0; i < 4; ++i) {
+      x0 += x1;
+      x1 = rotl32(x1, rots[i]);
+      x1 ^= x0;
+    }
+    x0 += inj0[g];
+    x1 += inj1[g] + static_cast<uint32_t>(g + 1);
+  }
+  return x0;
+}
+
+enum Purpose : uint32_t {
+  kInitEst = 0,
+  kLocalCoin = 1,
+  kSharedCoin = 2,
+  kFaultyRank = 3,
+  kCrashRound = 4,
+  kByzValue = 5,
+  kSched = 6,
+};
+
+constexpr uint32_t kCoinStep = 3;
+
+struct Key {
+  uint32_t k0, k1;
+};
+
+// Field packing per spec §2: x0 = (send << 17) | instance,
+// x1 = (rnd << 16) | (recv << 6) | (step << 4) | purpose.
+inline uint32_t prf_u32(Key k, uint32_t instance, uint32_t rnd, uint32_t step,
+                        uint32_t recv, uint32_t send, uint32_t purpose) {
+  const uint32_t x0 = (send << 17) | instance;
+  const uint32_t x1 = (rnd << 16) | (recv << 6) | (step << 4) | purpose;
+  return threefry2x32(k.k0, k.k1, x0, x1);
+}
+
+inline uint32_t prf_bit(Key k, uint32_t instance, uint32_t rnd, uint32_t step,
+                        uint32_t recv, uint32_t send, uint32_t purpose) {
+  return prf_u32(k, instance, rnd, step, recv, send, purpose) & 1u;
+}
+
+// ------------------------------------------------------------------- config
+
+enum Protocol { kBenor = 0, kBracha = 1 };
+enum AdversaryKind { kNone = 0, kCrash = 1, kByzantine = 2, kAdaptive = 3 };
+enum CoinKind { kLocal = 0, kShared = 1 };
+enum InitKind { kRandom = 0, kAll0 = 1, kAll1 = 2, kSplit = 3 };
+
+struct Cfg {
+  int protocol;
+  int n;
+  int f;
+  int adversary;
+  int coin;
+  int init;
+  uint64_t seed;
+  int round_cap;
+  int crash_window;
+};
+
+inline bool lying_adversary(const Cfg& c) {
+  return c.adversary == kByzantine || c.adversary == kAdaptive;
+}
+
+// ------------------------------------------------------------ per-thread state
+
+// All scratch sized once per thread; the per-round hot path does no allocation.
+struct Scratch {
+  std::vector<uint8_t> est, decided, decided_val, prop, m, d, w_tmp;
+  std::vector<uint8_t> honest, values, silent;           // per-sender (n)
+  std::vector<uint8_t> vmat;                             // per-(recv,send) (n*n)
+  std::vector<uint8_t> bias;                             // per-(recv,send) (n*n)
+  std::vector<uint8_t> faulty;
+  std::vector<int32_t> crash_round;
+  std::vector<uint32_t> combined, keys;                  // selection buffers (n)
+  std::vector<int32_t> c0, c1;                           // per-receiver counts
+  std::vector<uint8_t> decide_now, adopt;
+  std::vector<uint8_t> coin;
+  bool values_per_recv = false;  // vmat active (plain-Ben-Or Byzantine, spec §6.3)
+  bool bias_per_recv = false;    // bias matrix active (adaptive, spec §6.4)
+
+  explicit Scratch(int n)
+      : est(n), decided(n), decided_val(n), prop(n), m(n), d(n), w_tmp(n),
+        honest(n), values(n), silent(n), vmat(size_t(n) * n), bias(size_t(n) * n),
+        faulty(n), crash_round(n), combined(n), keys(n), c0(n), c1(n),
+        decide_now(n), adopt(n), coin(n) {}
+};
+
+// ------------------------------------------------------- setup (spec §3)
+
+void setup_instance(const Cfg& cfg, Key k, uint32_t inst, Scratch& s) {
+  const int n = cfg.n;
+  // Initial estimates (spec §3.1).
+  for (int j = 0; j < n; ++j) {
+    switch (cfg.init) {
+      case kAll0: s.est[j] = 0; break;
+      case kAll1: s.est[j] = 1; break;
+      case kSplit: s.est[j] = uint8_t(j & 1); break;
+      default:
+        s.est[j] = uint8_t(prf_bit(k, inst, 0, 0, uint32_t(j), 0, kInitEst));
+    }
+    s.decided[j] = 0;
+    s.decided_val[j] = 0;
+    s.prop[j] = 2;
+    s.m[j] = 0;
+    s.d[j] = 2;
+    s.decide_now[j] = 0;
+    s.adopt[j] = 0;
+  }
+  // Faulty set: the f smallest (rank | replica) keys (spec §3.2).
+  if (cfg.adversary == kNone || cfg.f == 0) {
+    std::fill(s.faulty.begin(), s.faulty.end(), uint8_t(0));
+  } else {
+    for (int j = 0; j < n; ++j) {
+      const uint32_t rank =
+          prf_u32(k, inst, 0, 0, uint32_t(j), 0, kFaultyRank);
+      s.keys[j] = (rank & 0xFFFFFC00u) | uint32_t(j);
+    }
+    s.combined = s.keys;  // scratch copy for nth_element
+    std::nth_element(s.combined.begin(), s.combined.begin() + (cfg.f - 1),
+                     s.combined.end());
+    const uint32_t kth = s.combined[cfg.f - 1];
+    for (int j = 0; j < n; ++j) s.faulty[j] = uint8_t(s.keys[j] <= kth);
+  }
+  // Crash rounds (spec §3.3).
+  if (cfg.adversary == kCrash) {
+    for (int j = 0; j < n; ++j) {
+      const uint32_t c = prf_u32(k, inst, 0, 0, uint32_t(j), 0, kCrashRound);
+      s.crash_round[j] = int32_t(c % uint32_t(cfg.crash_window));
+    }
+  }
+}
+
+// ------------------------------------------------- adversary inject (spec §6)
+
+void inject(const Cfg& cfg, Key k, uint32_t inst, uint32_t rnd, uint32_t t,
+            Scratch& s) {
+  const int n = cfg.n;
+  s.values_per_recv = false;
+  s.bias_per_recv = false;
+  std::fill(s.silent.begin(), s.silent.end(), uint8_t(0));
+  std::memcpy(s.values.data(), s.honest.data(), size_t(n));
+
+  switch (cfg.adversary) {
+    case kNone:
+      return;
+    case kCrash:
+      for (int j = 0; j < n; ++j)
+        s.silent[j] = uint8_t(s.faulty[j] && int32_t(rnd) >= s.crash_round[j]);
+      return;
+    case kByzantine:
+      if (cfg.protocol == kBracha) {
+        // RBC count-level outcome, common to all receivers (spec §6.3).
+        for (int j = 0; j < n; ++j) {
+          if (!s.faulty[j]) continue;
+          const uint32_t b =
+              prf_u32(k, inst, rnd, t, 0, uint32_t(j), kByzValue) & 3u;
+          s.silent[j] = uint8_t(b == 0);
+          if (b == 1) s.values[j] = 0;
+          else if (b == 2) s.values[j] = 1;
+          // b == 0 or 3: honest value retained.
+        }
+      } else {
+        // Plain Ben-Or pairing: per-receiver equivocation matrix (spec §6.3).
+        s.values_per_recv = true;
+        for (int v = 0; v < n; ++v) {
+          uint8_t* row = &s.vmat[size_t(v) * n];
+          for (int j = 0; j < n; ++j) {
+            if (s.faulty[j]) {
+              const uint32_t e = prf_u32(k, inst, rnd, t, uint32_t(v),
+                                         uint32_t(j), kByzValue);
+              row[j] = uint8_t(e % 3u);  // {0, 1, 2 = silent-to-this-recv}
+            } else {
+              row[j] = s.honest[j];
+            }
+          }
+        }
+      }
+      return;
+    case kAdaptive: {
+      // spec §6.4 — observe honest votes, push the minority value, bias delivery.
+      int h0 = 0, h1 = 0;
+      for (int j = 0; j < n; ++j) {
+        if (s.faulty[j] || s.honest[j] == 2) continue;
+        if (s.honest[j] == 1) ++h1;
+        else ++h0;
+      }
+      const uint8_t minority = (h1 <= h0) ? 1 : 0;
+      for (int j = 0; j < n; ++j)
+        if (s.faulty[j]) s.values[j] = minority;
+      s.bias_per_recv = true;
+      for (int v = 0; v < n; ++v) {
+        const uint8_t pref = (v >= (n + 1) / 2) ? 1 : 0;
+        uint8_t* row = &s.bias[size_t(v) * n];
+        for (int j = 0; j < n; ++j) {
+          const uint8_t vv = s.values[j];
+          row[j] = uint8_t(vv == 2 || vv != pref);
+        }
+      }
+      return;
+    }
+  }
+}
+
+// --------------------------------- Bracha count-level validation (spec §5.1b)
+
+// Per-sender invalidity from the previous step's global live-valid counts;
+// merged into the silent set before the delivery mask is drawn.
+void silence_invalid(const Cfg& cfg, uint32_t t, int g0, int g1, Scratch& s) {
+  const int n = cfg.n, f = cfg.f, q = n - f;
+  bool ok[3];
+  if (t == 1) {
+    ok[1] = g1 >= (q + 1) / 2;
+    ok[0] = g0 >= q / 2 + 1;
+    ok[2] = true;
+  } else {
+    const int lo = std::max({0, q - g0, q - n / 2});
+    const int hi = std::min({g1, q, n / 2});
+    ok[1] = g1 >= n / 2 + 1;
+    ok[0] = g0 >= n / 2 + 1;
+    ok[2] = lo <= hi;
+  }
+  for (int j = 0; j < n; ++j)
+    if (!ok[s.values[j]]) s.silent[j] = 1;
+}
+
+// --------------------------------------- delivery mask + tallies (spec §4)
+
+// Per receiver: deliver the n-f live senders with the smallest combined key
+// silent(1)|bias(1)|prf_top20(20)|sender(10); own message always delivered.
+// Fused with the tally: c0/c1 per receiver, bot (=2) never counted.
+void deliver_and_tally(const Cfg& cfg, Key k, uint32_t inst, uint32_t rnd,
+                       uint32_t t, Scratch& s) {
+  const int n = cfg.n, f = cfg.f;
+  const int n_deliver = n - f;
+  for (int v = 0; v < n; ++v) {
+    const uint8_t* bias_row = s.bias_per_recv ? &s.bias[size_t(v) * n] : nullptr;
+    for (int j = 0; j < n; ++j) {
+      const uint32_t sched =
+          prf_u32(k, inst, rnd, t, uint32_t(v), uint32_t(j), kSched);
+      const uint32_t b = bias_row ? bias_row[j] : 0u;
+      s.combined[j] = (uint32_t(s.silent[j]) << 31) | (b << 30) |
+                      (((sched >> 12) & 0xFFFFFu) << 10) | uint32_t(j);
+    }
+    s.combined[v] = uint32_t(v);  // own message always delivered (spec §4)
+    s.keys = s.combined;          // keep original keys; nth_element permutes
+    std::nth_element(s.keys.begin(), s.keys.begin() + (n_deliver - 1),
+                     s.keys.end());
+    const uint32_t kth = s.keys[n_deliver - 1];
+    const uint8_t* vals = s.values_per_recv ? &s.vmat[size_t(v) * n] : s.values.data();
+    int c0 = 0, c1 = 0;
+    for (int j = 0; j < n; ++j) {
+      const bool own = (j == v);
+      const bool delivered = own || (s.combined[j] <= kth && !s.silent[j]);
+      if (!delivered) continue;
+      if (vals[j] == 0) ++c0;
+      else if (vals[j] == 1) ++c1;
+    }
+    s.c0[v] = c0;
+    s.c1[v] = c1;
+  }
+}
+
+// ----------------------------------------------- protocol round (spec §5)
+
+// One full round for one instance; updates Scratch state in place.
+void run_round(const Cfg& cfg, Key k, uint32_t inst, uint32_t rnd, Scratch& s) {
+  const int n = cfg.n, f = cfg.f;
+  const bool lying = lying_adversary(cfg);
+  const int steps = (cfg.protocol == kBenor) ? 2 : 3;
+  int g0 = 0, g1 = 0;  // previous step's global live-valid counts (bracha)
+
+  for (int t = 0; t < steps; ++t) {
+    // Honest wire values (decided replicas keep participating — spec §1).
+    for (int j = 0; j < n; ++j) {
+      if (t == 0) s.honest[j] = s.est[j];
+      else if (cfg.protocol == kBenor) s.honest[j] = s.prop[j];
+      else s.honest[j] = (t == 1) ? s.m[j] : s.d[j];
+    }
+    inject(cfg, k, inst, rnd, uint32_t(t), s);
+    if (cfg.protocol == kBracha) {
+      if (t > 0) silence_invalid(cfg, uint32_t(t), g0, g1, s);
+      g0 = g1 = 0;
+      for (int j = 0; j < n; ++j) {
+        if (s.silent[j]) continue;
+        if (s.values[j] == 0) ++g0;
+        else if (s.values[j] == 1) ++g1;
+      }
+    }
+    deliver_and_tally(cfg, k, inst, rnd, uint32_t(t), s);
+
+    // Per-replica state-machine step (mirrors core/replica.py::on_deliver).
+    for (int v = 0; v < n; ++v) {
+      const int c0 = s.c0[v], c1 = s.c1[v];
+      if (cfg.protocol == kBenor) {
+        const int qrhs = lying ? n + f : n;
+        if (t == 0) {
+          s.prop[v] = (2 * c1 > qrhs) ? 1 : ((2 * c0 > qrhs) ? 0 : 2);
+        } else {
+          const uint8_t w = (c1 >= c0) ? 1 : 0;
+          const int c = w ? c1 : c0;
+          s.w_tmp[v] = w;
+          s.decide_now[v] = lying ? uint8_t(2 * c > n + f) : uint8_t(c >= f + 1);
+          s.adopt[v] = uint8_t(c >= (lying ? f + 1 : 1));
+        }
+      } else {
+        if (t == 0) {
+          s.m[v] = (c1 >= c0) ? 1 : 0;
+        } else if (t == 1) {
+          s.d[v] = (2 * c1 > n) ? 1 : ((2 * c0 > n) ? 0 : 2);
+        } else {
+          const uint8_t w = (c1 >= c0) ? 1 : 0;
+          const int c = w ? c1 : c0;
+          s.w_tmp[v] = w;
+          s.decide_now[v] = uint8_t(c >= 2 * f + 1);
+          s.adopt[v] = uint8_t(c >= f + 1);
+        }
+      }
+    }
+  }
+
+  // Coin + end-of-round update (spec §5.3, §6.3 eligibility).
+  if (cfg.coin == kShared) {
+    const uint8_t bit =
+        uint8_t(prf_bit(k, inst, rnd, kCoinStep, 0, 0, kSharedCoin));
+    std::fill(s.coin.begin(), s.coin.end(), bit);
+  } else {
+    for (int j = 0; j < n; ++j)
+      s.coin[j] =
+          uint8_t(prf_bit(k, inst, rnd, kCoinStep, uint32_t(j), 0, kLocalCoin));
+  }
+  for (int j = 0; j < n; ++j) {
+    if (s.decided[j]) continue;
+    if (s.decide_now[j]) {
+      s.decided[j] = 1;
+      s.decided_val[j] = s.w_tmp[j];
+      s.est[j] = s.w_tmp[j];
+    } else if (s.adopt[j]) {
+      s.est[j] = s.w_tmp[j];
+    } else {
+      s.est[j] = s.coin[j];
+    }
+  }
+}
+
+// --------------------------------------------------------------- instance
+
+void run_instance(const Cfg& cfg, Key k, uint32_t inst, Scratch& s,
+                  int32_t* rounds_out, uint8_t* decision_out) {
+  setup_instance(cfg, k, inst, s);
+  const int n = cfg.n;
+  int first_correct = 0;
+  while (first_correct < n && s.faulty[first_correct]) ++first_correct;
+
+  for (int r = 0; r < cfg.round_cap; ++r) {
+    run_round(cfg, k, inst, uint32_t(r), s);
+    bool all_done = true;
+    for (int j = 0; j < n; ++j) {
+      if (!s.faulty[j] && !s.decided[j]) {
+        all_done = false;
+        break;
+      }
+    }
+    if (all_done) {
+      *rounds_out = r + 1;
+      *decision_out = s.decided_val[first_correct];
+      return;
+    }
+  }
+  *rounds_out = cfg.round_cap;
+  *decision_out = 2;  // overflow bucket (spec §1)
+}
+
+}  // namespace
+
+// ------------------------------------------------------------------- C ABI
+
+extern "C" {
+
+// Simulate `count` instances (ids given explicitly — any subset, same contract
+// as SimulatorBackend.run) across `n_threads` OS threads. Outputs are
+// rounds_out (int32) and decision_out (uint8), both length `count`.
+void sim_run(int protocol, int n, int f, int adversary, int coin, int init,
+             uint64_t seed, int round_cap, int crash_window,
+             const int64_t* ids, int64_t count, int n_threads,
+             int32_t* rounds_out, uint8_t* decision_out) {
+  const Cfg cfg{protocol, n,    f,         adversary,   coin,
+                init,     seed, round_cap, crash_window};
+  const Key k{uint32_t(seed & 0xFFFFFFFFu), uint32_t((seed >> 32) & 0xFFFFFFFFu)};
+
+  if (n_threads < 1) n_threads = 1;
+  if (int64_t(n_threads) > count) n_threads = int(count);
+
+  auto worker = [&](int64_t lo, int64_t hi) {
+    Scratch s(cfg.n);
+    for (int64_t i = lo; i < hi; ++i)
+      run_instance(cfg, k, uint32_t(ids[i]), s, &rounds_out[i], &decision_out[i]);
+  };
+
+  if (n_threads == 1) {
+    worker(0, count);
+    return;
+  }
+  std::vector<std::thread> threads;
+  threads.reserve(n_threads);
+  const int64_t per = (count + n_threads - 1) / n_threads;
+  for (int tix = 0; tix < n_threads; ++tix) {
+    const int64_t lo = tix * per;
+    const int64_t hi = std::min(count, lo + per);
+    if (lo >= hi) break;
+    threads.emplace_back(worker, lo, hi);
+  }
+  for (auto& th : threads) th.join();
+}
+
+// ABI version stamp so the Python loader can detect stale cached builds.
+int sim_abi_version() { return 1; }
+
+}  // extern "C"

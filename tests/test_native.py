@@ -1,0 +1,84 @@
+"""Native C++ backend (native/simcore.cpp): bit-match vs the Python oracle and the
+vectorized backends, thread-count invariance, and subset/overflow contracts.
+
+The native core is an independent third implementation of spec/PROTOCOL.md (scalar
+C++ vs the object oracle vs the vectorized arrays); these tests are what make it an
+oracle-grade accelerator rather than just a fast approximation.
+"""
+
+import itertools
+import shutil
+
+import numpy as np
+import pytest
+
+from byzantinerandomizedconsensus_tpu.backends import get_backend
+from byzantinerandomizedconsensus_tpu.config import SimConfig
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
+
+
+def _sizes(proto, adv):
+    if proto == "benor" and adv in ("byzantine", "adaptive"):
+        return 11, 2  # n > 5f (Protocol B)
+    if proto == "bracha":
+        return 10, 3  # n > 3f
+    return 7, 3       # n > 2f
+
+
+@pytest.mark.parametrize("proto", ["benor", "bracha"])
+@pytest.mark.parametrize("adv", ["none", "crash", "byzantine", "adaptive"])
+@pytest.mark.parametrize("coin", ["local", "shared"])
+def test_bitmatch_vs_oracle_grid(proto, adv, coin):
+    n, f = _sizes(proto, adv)
+    cfg = SimConfig(protocol=proto, n=n, f=f, instances=30, adversary=adv,
+                    coin=coin, seed=11, round_cap=64).validate()
+    a = get_backend("native").run(cfg)
+    b = get_backend("cpu").run(cfg)
+    np.testing.assert_array_equal(a.rounds, b.rounds)
+    np.testing.assert_array_equal(a.decision, b.decision)
+
+
+@pytest.mark.parametrize("init", ["random", "all0", "all1", "split"])
+def test_bitmatch_init_modes(init):
+    cfg = SimConfig(protocol="bracha", n=13, f=4, instances=25, adversary="byzantine",
+                    coin="shared", init=init, seed=3, round_cap=64).validate()
+    a = get_backend("native").run(cfg)
+    b = get_backend("cpu").run(cfg)
+    np.testing.assert_array_equal(a.rounds, b.rounds)
+    np.testing.assert_array_equal(a.decision, b.decision)
+
+
+def test_bitmatch_vs_numpy_larger():
+    """At n=64 the object oracle is slow; the vectorized numpy backend (itself
+    oracle-matched in test_bitmatch.py) is the cross-check."""
+    cfg = SimConfig(protocol="bracha", n=64, f=21, instances=200, adversary="byzantine",
+                    coin="shared", seed=5, round_cap=64).validate()
+    a = get_backend("native").run(cfg)
+    b = get_backend("numpy").run(cfg)
+    np.testing.assert_array_equal(a.rounds, b.rounds)
+    np.testing.assert_array_equal(a.decision, b.decision)
+
+
+def test_thread_count_invariance():
+    """Results are addressed by instance id, so the thread split cannot matter."""
+    from byzantinerandomizedconsensus_tpu.backends.native_backend import NativeBackend
+
+    cfg = SimConfig(protocol="bracha", n=16, f=5, instances=101, adversary="adaptive",
+                    coin="shared", seed=9, round_cap=64).validate()
+    one = NativeBackend(n_threads=1).run(cfg)
+    four = NativeBackend(n_threads=4).run(cfg)
+    np.testing.assert_array_equal(one.rounds, four.rounds)
+    np.testing.assert_array_equal(one.decision, four.decision)
+
+
+def test_subset_ids_and_overflow():
+    cfg = SimConfig(protocol="benor", n=64, f=21, instances=1000, adversary="crash",
+                    coin="local", seed=1, round_cap=2).validate()
+    ids = np.array([3, 500, 999], dtype=np.int64)
+    a = get_backend("native").run(cfg, ids)
+    b = get_backend("numpy").run(cfg, ids)
+    np.testing.assert_array_equal(a.rounds, b.rounds)
+    np.testing.assert_array_equal(a.decision, b.decision)
+    # round_cap=2 at f=Theta(n) with a local coin: overflow bucket, identically.
+    assert (a.rounds == 2).all() and (a.decision == 2).all()
