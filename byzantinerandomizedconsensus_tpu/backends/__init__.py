@@ -35,6 +35,13 @@ def _jax_cpu():
     return JaxBackend(device="cpu")
 
 
+def _jax_pallas():
+    """JAX backend with the fused Pallas delivery+tally kernel (ops/pallas_tally.py)."""
+    from byzantinerandomizedconsensus_tpu.backends.jax_backend import JaxBackend
+
+    return JaxBackend(kernel="pallas")
+
+
 def _native(n_threads: str = "0"):
     """``native`` or ``native:<threads>`` — the C++ core (native/simcore.cpp)."""
     from byzantinerandomizedconsensus_tpu.backends.native_backend import NativeBackend
@@ -55,6 +62,7 @@ register_backend("numpy", _numpy)
 register_backend("jax", _jax)
 register_backend("jax_cpu", _jax_cpu)
 register_backend("jax_sharded", _jax_sharded)
+register_backend("jax_pallas", _jax_pallas)
 register_backend("native", _native)
 
 __all__ = [

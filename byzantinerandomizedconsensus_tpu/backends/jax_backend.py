@@ -28,8 +28,12 @@ from byzantinerandomizedconsensus_tpu.models import benor, bracha, state as stat
 from byzantinerandomizedconsensus_tpu.models.adversaries import AdversaryModel
 
 
-def _run_chunk(cfg: SimConfig, inst_ids: jnp.ndarray):
-    """Simulate one padded chunk; returns (rounds (B,), decision (B,))."""
+def _run_chunk(cfg: SimConfig, inst_ids: jnp.ndarray, counts_fn=None):
+    """Simulate one padded chunk; returns (rounds (B,), decision (B,)).
+
+    ``counts_fn`` selects the delivery+tally implementation: None = the XLA
+    masks+tally path; ops/pallas_tally.counts_fn = the fused Pallas kernel.
+    """
     round_body = benor.round_body if cfg.protocol == "benor" else bracha.round_body
     adv = AdversaryModel(cfg)
     setup = adv.setup(cfg.seed, inst_ids, xp=jnp)
@@ -43,7 +47,8 @@ def _run_chunk(cfg: SimConfig, inst_ids: jnp.ndarray):
 
     def body(carry):
         r, st, done_at = carry
-        st = round_body(cfg, cfg.seed, inst_ids, r, st, adv, setup, xp=jnp)
+        st = round_body(cfg, cfg.seed, inst_ids, r, st, adv, setup, xp=jnp,
+                        counts_fn=counts_fn)
         done_now = state_mod.all_correct_decided(st, faulty, xp=jnp)
         done_at = jnp.where((done_at < 0) & done_now, r + 1, done_at)
         return r + 1, st, done_at
@@ -56,20 +61,32 @@ def _run_chunk(cfg: SimConfig, inst_ids: jnp.ndarray):
 
 
 class JaxBackend(JitChunkedBackend):
-    """``device='tpu'|'cpu'|None`` pins the computation; None = JAX default device."""
+    """``device='tpu'|'cpu'|None`` pins the computation; None = JAX default device.
+    ``kernel='xla'`` (masks+tally) or ``'pallas'`` (fused kernel; interpret mode
+    is selected automatically on non-TPU platforms so CI can bit-match it)."""
 
     name = "jax"
 
-    def __init__(self, chunk_bytes: int = 1 << 30, max_chunk: int = 1 << 14, device=None):
+    def __init__(self, chunk_bytes: int = 1 << 30, max_chunk: int = 1 << 14,
+                 device=None, kernel: str = "xla"):
         super().__init__(chunk_bytes, max_chunk)
         self.device = device
+        if kernel not in ("xla", "pallas"):
+            raise ValueError(f"unknown kernel {kernel!r}; use 'xla' or 'pallas'")
+        self.kernel = kernel
 
     def _chunk_size(self, cfg: SimConfig) -> int:
         per_inst = cfg.n * cfg.n * 4 * 4  # ~4 live (B,n,n) u32-sized transients
         return max(1, min(self.max_chunk, self.chunk_bytes // per_inst))
 
     def _make_fn(self, cfg: SimConfig):
-        return jax.jit(partial(_run_chunk, cfg))
+        counts_fn = None
+        if self.kernel == "pallas":
+            from byzantinerandomizedconsensus_tpu.ops import pallas_tally
+
+            interpret = jax.default_backend() != "tpu"
+            counts_fn = partial(pallas_tally.counts_fn, interpret=interpret)
+        return jax.jit(partial(_run_chunk, cfg, counts_fn=counts_fn))
 
     def _device_ctx(self):
         if self.device is None:

@@ -21,33 +21,46 @@ def _step_counts(cfg, seed, inst_ids, rnd, t, values, silent, bias, xp, recv_ids
 
 
 def round_body(cfg, seed, inst_ids, rnd, state, adv, setup, xp=np,
-               recv_ids=None, gather=None):
+               recv_ids=None, gather=None, counts_fn=None):
     """Execute one Ben-Or round; returns the new state dict.
 
     ``recv_ids``/``gather`` support the replica-sharded path (parallel/sharded.py):
     state arrays carry only the local receiver shard; ``gather`` all-gathers a
     (B, R) per-sender value array to full (B, n) width before broadcast.
+
+    ``counts_fn`` swaps the delivery+tally implementation (the fused Pallas
+    kernel, ops/pallas_tally.py) for the default masks+tally path; it receives
+    the pre-inject honest vector so equivocation matrices can be recomputed
+    in-kernel (the unused inject output is dead-code-eliminated under jit).
     """
     n, f = cfg.n, cfg.f
     if gather is None:
         gather = lambda v: v
     est, decided = state["est"], state["decided"]
 
+    def counts(t, honest, v, s, b):
+        if counts_fn is not None:
+            return counts_fn(cfg, seed, inst_ids, rnd, t, v, s,
+                             setup["faulty"], honest)
+        return _step_counts(cfg, seed, inst_ids, rnd, t, v, s, b, xp, recv_ids)
+
     # Protocol A (benign) vs Protocol B (lying) thresholds — spec §5.1.
     quorum_rhs = n + f if cfg.lying_adversary else n
     adopt_min = f + 1 if cfg.lying_adversary else 1
 
     # Step 0 — report: broadcast est.
-    v0, silent0, bias0 = adv.inject(seed, inst_ids, rnd, 0, gather(est), setup,
+    h0 = gather(est)
+    v0, silent0, bias0 = adv.inject(seed, inst_ids, rnd, 0, h0, setup,
                                     xp=xp, recv_ids=recv_ids)
-    r0, r1 = _step_counts(cfg, seed, inst_ids, rnd, 0, v0, silent0, bias0, xp, recv_ids)
+    r0, r1 = counts(0, h0, v0, silent0, bias0)
     prop = xp.where(2 * r1 > quorum_rhs, xp.uint8(1),
                     xp.where(2 * r0 > quorum_rhs, xp.uint8(0), xp.uint8(2)))
 
     # Step 1 — propose: broadcast prop (bot = 2 excluded from counts).
-    v1, silent1, bias1 = adv.inject(seed, inst_ids, rnd, 1, gather(prop), setup,
+    h1 = gather(prop)
+    v1, silent1, bias1 = adv.inject(seed, inst_ids, rnd, 1, h1, setup,
                                     xp=xp, recv_ids=recv_ids)
-    p0, p1 = _step_counts(cfg, seed, inst_ids, rnd, 1, v1, silent1, bias1, xp, recv_ids)
+    p0, p1 = counts(1, h1, v1, silent1, bias1)
     w = (p1 >= p0).astype(xp.uint8)
     c = xp.where(w == 1, p1, p0)
 

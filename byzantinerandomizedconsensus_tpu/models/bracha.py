@@ -24,41 +24,53 @@ def _step_counts(cfg, seed, inst_ids, rnd, t, values, silent, bias, xp, recv_ids
 
 
 def round_body(cfg, seed, inst_ids, rnd, state, adv, setup, xp=np,
-               recv_ids=None, gather=None):
+               recv_ids=None, gather=None, counts_fn=None):
     """Execute one Bracha round; returns the new state dict.
 
     ``recv_ids``/``gather`` support the replica-sharded path (parallel/sharded.py):
     state arrays carry only the local receiver shard; ``gather`` all-gathers a
     (B, R) per-sender value array to full (B, n) width before broadcast. Validation
     and live counts operate on full sender width and need no changes.
+
+    ``counts_fn`` swaps the delivery+tally implementation (the fused Pallas
+    kernel, ops/pallas_tally.py) for the default masks+tally path.
     """
     n, f = cfg.n, cfg.f
     if gather is None:
         gather = lambda v: v
     est, decided = state["est"], state["decided"]
 
+    def counts(t, honest, v, s, b):
+        if counts_fn is not None:
+            return counts_fn(cfg, seed, inst_ids, rnd, t, v, s,
+                             setup["faulty"], honest)
+        return _step_counts(cfg, seed, inst_ids, rnd, t, v, s, b, xp, recv_ids)
+
     # Step 0 — broadcast est; majority of delivered (ties -> 1).
-    v0, s0, b0 = adv.inject(seed, inst_ids, rnd, 0, gather(est), setup, xp=xp,
+    h0 = gather(est)
+    v0, s0, b0 = adv.inject(seed, inst_ids, rnd, 0, h0, setup, xp=xp,
                             recv_ids=recv_ids)
     g0_0, g0_1 = validation.live_counts(v0, s0, xp=xp)
-    c0_0, c0_1 = _step_counts(cfg, seed, inst_ids, rnd, 0, v0, s0, b0, xp, recv_ids)
+    c0_0, c0_1 = counts(0, h0, v0, s0, b0)
     m = (c0_1 >= c0_0).astype(xp.uint8)
 
     # Step 1 — broadcast m; invalid messages silenced pre-delivery (spec §5.1b);
     # decide-proposal needs an absolute > n/2 quorum.
-    v1, s1, b1 = adv.inject(seed, inst_ids, rnd, 1, gather(m), setup, xp=xp,
+    h1 = gather(m)
+    v1, s1, b1 = adv.inject(seed, inst_ids, rnd, 1, h1, setup, xp=xp,
                             recv_ids=recv_ids)
     s1 = s1 | validation.validate_step1(cfg, v1, g0_0, g0_1, xp=xp)
     g1_0, g1_1 = validation.live_counts(v1, s1, xp=xp)
-    c1_0, c1_1 = _step_counts(cfg, seed, inst_ids, rnd, 1, v1, s1, b1, xp, recv_ids)
+    c1_0, c1_1 = counts(1, h1, v1, s1, b1)
     d = xp.where(2 * c1_1 > n, xp.uint8(1),
                  xp.where(2 * c1_0 > n, xp.uint8(0), xp.uint8(2)))
 
     # Step 2 — broadcast d (bot = 2 excluded from counts); validated against G1.
-    v2, s2, b2 = adv.inject(seed, inst_ids, rnd, 2, gather(d), setup, xp=xp,
+    h2 = gather(d)
+    v2, s2, b2 = adv.inject(seed, inst_ids, rnd, 2, h2, setup, xp=xp,
                             recv_ids=recv_ids)
     s2 = s2 | validation.validate_step2(cfg, v2, g1_0, g1_1, xp=xp)
-    c2_0, c2_1 = _step_counts(cfg, seed, inst_ids, rnd, 2, v2, s2, b2, xp, recv_ids)
+    c2_0, c2_1 = counts(2, h2, v2, s2, b2)
     w = (c2_1 >= c2_0).astype(xp.uint8)
     c = xp.where(w == 1, c2_1, c2_0)
 
