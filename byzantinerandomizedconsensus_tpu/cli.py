@@ -56,8 +56,11 @@ def _config_from(args) -> SimConfig:
 
 
 def cmd_run(args) -> int:
+    from byzantinerandomizedconsensus_tpu.utils import profiling
+
     cfg = _config_from(args)
-    res = Simulator(cfg, args.backend).run()
+    with profiling.trace(args.profile):
+        res = Simulator(cfg, args.backend).run()
     out = metrics.summary(res)
     out["backend"] = args.backend
     if args.hist:
@@ -108,6 +111,8 @@ def main(argv=None) -> int:
     p_run = sub.add_parser("run", help="run one config to termination")
     _add_config_args(p_run)
     p_run.add_argument("--hist", action="store_true", help="include the round histogram")
+    p_run.add_argument("--profile", default=None, metavar="DIR",
+                       help="write a jax.profiler trace (TensorBoard/Perfetto) to DIR")
     p_run.set_defaults(fn=cmd_run)
 
     p_bm = sub.add_parser("bitmatch", help="sampled oracle-vs-backend bit-match")

@@ -1,0 +1,35 @@
+"""Tracing/profiling (SURVEY.md §5): jax.profiler traces around the jit'd round
+kernel, viewable in TensorBoard/Perfetto, plus a no-op fallback when profiling is
+unavailable (e.g. interpret-mode CI). The headline instances/sec counter itself is
+part of SimResult/metrics (timed_run), not of this module.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import pathlib
+
+
+@contextlib.contextmanager
+def trace(out_dir=None):
+    """Context manager: profile the enclosed device work into ``out_dir``.
+
+    ``None`` disables profiling (no-op), so call sites can thread a CLI flag
+    straight through. Trace directories are TensorBoard-/Perfetto-loadable.
+    """
+    if out_dir is None:
+        yield
+        return
+    import jax
+
+    out = pathlib.Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    with jax.profiler.trace(str(out)):
+        yield
+
+
+def annotate(name: str):
+    """Named sub-span inside a trace (shows up on the TraceMe timeline)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

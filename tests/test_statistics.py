@@ -1,0 +1,83 @@
+"""Statistical tests (SURVEY.md §4.4): chi-square on decision-bit frequencies under
+symmetric inputs, coin fairness, and cross-seed stability of mean rounds-to-decision
+for the small Ben-Or reference point."""
+
+import dataclasses
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu import SimConfig, Simulator
+
+# chi-square critical value, 1 dof, p = 0.001 — loose enough for CI determinism.
+CHI2_1DOF_P001 = 10.83
+
+
+def _chi2_fair(counts0: int, counts1: int) -> float:
+    tot = counts0 + counts1
+    e = tot / 2.0
+    return (counts0 - e) ** 2 / e + (counts1 - e) ** 2 / e
+
+
+def test_decision_bit_symmetry_benor():
+    """Random symmetric inputs + fair coin: decisions 0/1 occur equally often."""
+    cfg = SimConfig(protocol="benor", n=4, f=1, instances=4000, adversary="none",
+                    coin="local", round_cap=128, seed=41)
+    res = Simulator(cfg, "numpy").run()
+    d = res.decision
+    assert (d != 2).all(), "n=4 f=1 local coin must terminate within the cap"
+    assert _chi2_fair(int((d == 0).sum()), int((d == 1).sum())) < CHI2_1DOF_P001
+
+
+def test_decision_bit_symmetry_bracha_shared():
+    cfg = SimConfig(protocol="bracha", n=16, f=5, instances=3000, adversary="byzantine",
+                    coin="shared", round_cap=64, seed=42)
+    res = Simulator(cfg, "numpy").run()
+    d = res.decision[res.decision != 2]
+    assert len(d) >= 2900
+    assert _chi2_fair(int((d == 0).sum()), int((d == 1).sum())) < CHI2_1DOF_P001
+
+
+def test_shared_coin_fairness_and_commonality():
+    """The shared-coin stub is fair across (instance, round) and identical across
+    replicas (the threshold-signature property being stubbed — spec §5.3)."""
+    from byzantinerandomizedconsensus_tpu.models import coins
+
+    cfg = SimConfig(protocol="bracha", n=10, f=3, coin="shared").validate()
+    ids = np.arange(3000, dtype=np.int64)
+    allbits = []
+    for rnd in range(4):
+        bits = coins.coin_bits(cfg, cfg.seed, ids, rnd, xp=np)
+        assert (bits == bits[:, :1]).all(), "shared coin differs across replicas"
+        allbits.append(bits[:, 0])
+    b = np.concatenate(allbits)
+    assert _chi2_fair(int((b == 0).sum()), int((b == 1).sum())) < CHI2_1DOF_P001
+
+
+def test_mean_rounds_stability_across_seeds():
+    """Mean rounds-to-decision for Ben-Or n=4 f=1 is a physical constant of the
+    protocol; independent seeds must agree within Monte-Carlo error (4 sigma)."""
+    means, sems = [], []
+    for seed in (1, 2, 3):
+        cfg = SimConfig(protocol="benor", n=4, f=1, instances=2500, adversary="none",
+                        coin="local", round_cap=128, seed=seed)
+        r = Simulator(cfg, "numpy").run().rounds.astype(np.float64)
+        means.append(r.mean())
+        sems.append(r.std(ddof=1) / np.sqrt(len(r)))
+    for i in range(1, 3):
+        diff = abs(means[i] - means[0])
+        bound = 4 * np.hypot(sems[i], sems[0])
+        assert diff < bound, f"seed {i}: mean {means[i]:.3f} vs {means[0]:.3f}"
+    # and the constant itself is small: unanimity-or-coin converges fast at n=4.
+    assert 1.0 <= means[0] <= 4.0
+
+
+def test_shared_coin_expected_constant_rounds():
+    """With the shared coin the adversary cannot stall: mean rounds is O(1) and
+    nearly independent of n (spec §5.3) — the reason config 4 exists."""
+    means = {}
+    for n in (16, 64):
+        cfg = SimConfig(protocol="bracha", n=n, f=(n - 1) // 3, instances=400,
+                        adversary="byzantine", coin="shared", round_cap=64, seed=43)
+        means[n] = float(Simulator(cfg, "numpy").run().rounds.mean())
+    assert means[16] < 6 and means[64] < 6
+    assert abs(means[64] - means[16]) < 2.0

@@ -1,0 +1,37 @@
+"""Retrace/compile-hygiene guards (SURVEY.md §5 — the TPU analog of race/sanitizer
+CI): the round kernel must compile exactly once per (config, chunk-shape), and the
+profiling hook must wrap device work without disturbing results."""
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu import SimConfig
+from byzantinerandomizedconsensus_tpu.backends.jax_backend import JaxBackend
+from byzantinerandomizedconsensus_tpu.utils import profiling
+
+
+def test_single_trace_per_config_shape():
+    be = JaxBackend()
+    cfg = SimConfig(protocol="benor", n=8, f=3, instances=64, adversary="crash",
+                    coin="local", round_cap=32, seed=1).validate()
+    be.run(cfg, np.arange(16, dtype=np.int64))
+    fn = be._fn(cfg)
+    n0 = fn._cache_size()
+    assert n0 == 1, "first run should compile exactly one program"
+    # same shape, different ids -> no retrace; chunk padding keeps the tail shape
+    be.run(cfg, np.arange(16, 32, dtype=np.int64))
+    be.run(cfg, np.arange(5, dtype=np.int64))  # padded to cached chunk? (new shape ok)
+    assert fn._cache_size() <= 2, f"retracing per call: {fn._cache_size()} traces"
+
+
+def test_profiling_noop_and_annotate():
+    with profiling.trace(None):
+        x = np.arange(4).sum()
+    assert x == 6
+
+
+def test_profiling_trace_writes(tmp_path):
+    import jax.numpy as jnp
+
+    with profiling.trace(tmp_path / "tr"):
+        jnp.arange(8).sum().block_until_ready()
+    assert any((tmp_path / "tr").rglob("*")), "no trace artifacts written"
