@@ -43,3 +43,38 @@ def make_mesh(
         )
     grid = np.asarray(devs).reshape(n_data, n_model)
     return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Multi-host initialisation (the NCCL/MPI-equivalent bootstrap, SURVEY.md §5).
+
+    Wraps ``jax.distributed.initialize``; afterwards ``jax.devices()`` is global
+    across hosts, so ``make_mesh`` lays the instance (``data``) axis over DCN while
+    the replica (``model``) axis stays within each host's ICI domain. On cloud TPU
+    pods all three arguments auto-detect from the environment.
+    """
+    jax.distributed.initialize(coordinator_address, num_processes, process_id)
+
+
+def make_hybrid_mesh(n_model: int = 1) -> Mesh:
+    """(data, model) mesh with DCN-aware placement for multi-host runs: the data
+    axis spans hosts (no collectives cross DCN — instances are independent), the
+    model axis stays within each host's ICI slice. Falls back to :func:`make_mesh`
+    ordering on single-host or when the hybrid helper is unavailable."""
+    devs = jax.devices()
+    n_hosts = max(d.process_index for d in devs) + 1
+    if n_hosts == 1:
+        return make_mesh(n_model=n_model)
+    from jax.experimental import mesh_utils
+
+    per_host = len(devs) // n_hosts
+    if per_host % n_model:
+        raise ValueError(f"n_model={n_model} must divide per-host device count {per_host}")
+    grid = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=(per_host // n_model, n_model),
+        dcn_mesh_shape=(n_hosts, 1),
+        devices=devs,
+    )
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
