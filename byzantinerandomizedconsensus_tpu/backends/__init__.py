@@ -49,12 +49,14 @@ def _native(n_threads: str = "0"):
     return NativeBackend(n_threads=int(n_threads))
 
 
-def _jax_sharded(n_model: str = "1"):
-    """``jax_sharded`` or ``jax_sharded:<n_model>`` — replica-shard count over the
-    mesh's model axis (must divide the device count and cfg.n)."""
+def _jax_sharded(param: str = "1"):
+    """``jax_sharded[:<n_model>[,pallas]]`` — replica-shard count over the mesh's
+    model axis (must divide the device count and cfg.n), optionally with the
+    fused Pallas kernel."""
     from byzantinerandomizedconsensus_tpu.parallel.sharded import JaxShardedBackend
 
-    return JaxShardedBackend(n_model=int(n_model))
+    n_model, _, kernel = param.partition(",")
+    return JaxShardedBackend(n_model=int(n_model or "1"), kernel=kernel or "xla")
 
 
 register_backend("cpu", _cpu)

@@ -41,7 +41,7 @@ def round_body(cfg, seed, inst_ids, rnd, state, adv, setup, xp=np,
     def counts(t, honest, v, s, b):
         if counts_fn is not None:
             return counts_fn(cfg, seed, inst_ids, rnd, t, v, s,
-                             setup["faulty"], honest)
+                             setup["faulty"], honest, recv_ids=recv_ids)
         return _step_counts(cfg, seed, inst_ids, rnd, t, v, s, b, xp, recv_ids)
 
     # Protocol A (benign) vs Protocol B (lying) thresholds — spec §5.1.

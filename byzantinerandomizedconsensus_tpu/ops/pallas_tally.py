@@ -95,10 +95,12 @@ def _step_kernel(params_ref, ids_ref, values_ref, silent_ref, faulty_ref,
                  c0_ref, c1_ref, *, seed, step, n, n_deliver, tile_r,
                  byz_equiv, adaptive, adv_bracha_byz):
     """One (instance, receiver-tile) block. Shapes (padded sender axis S):
-    values/silent/faulty (1, S) i32; outputs c0/c1 (1, TR) i32."""
+    values/silent/faulty (1, S) i32; outputs c0/c1 (1, TR) i32. Receiver
+    indices are global: params[1] carries the shard offset (0 unsharded)."""
     k0, k1 = prf.seed_key(seed)
     k0, k1 = int(k0), int(k1)
     rnd = params_ref[0].astype(jnp.uint32)
+    recv_offset = params_ref[1].astype(jnp.uint32)
     inst = ids_ref[0].astype(jnp.uint32)
     r_tile = pl.program_id(1)
 
@@ -106,7 +108,7 @@ def _step_kernel(params_ref, ids_ref, values_ref, silent_ref, faulty_ref,
     u = jnp.uint32
     send = jax.lax.broadcasted_iota(jnp.uint32, (tile_r, S), 1)
     recv = (jax.lax.broadcasted_iota(jnp.uint32, (tile_r, S), 0)
-            + r_tile.astype(jnp.uint32) * u(tile_r))
+            + r_tile.astype(jnp.uint32) * u(tile_r) + recv_offset)
 
     values = values_ref[0, :].astype(jnp.int32)[None, :]
     silent = silent_ref[0, :].astype(jnp.int32)[None, :]
@@ -155,36 +157,46 @@ def _pad_senders(x, n_pad: int, fill):
 
 
 def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
-              interpret: bool = False):
+              recv_ids=None, interpret: bool = False):
     """Adapter matching the round-body ``counts_fn`` hook (models/benor.py).
 
     For the per-receiver equivocation case (values.ndim == 3) the kernel
     recomputes the matrix from ``honest`` + ``faulty``; the inject-produced
-    matrix is then dead code and XLA eliminates it.
+    matrix is then dead code and XLA eliminates it. ``recv_ids`` (a contiguous
+    replica shard, parallel/sharded.py) maps to the kernel's receiver offset.
     """
     del seed  # step_counts draws it from cfg (identical by construction)
     vals = honest if values.ndim == 3 else values
+    if recv_ids is None:
+        n_recv, recv_offset = cfg.n, 0
+    else:
+        n_recv, recv_offset = recv_ids.shape[0], recv_ids[0]
     return step_counts(cfg, inst_ids, rnd, t, vals, silent, faulty,
+                       n_recv=n_recv, recv_offset=recv_offset,
                        interpret=interpret)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "step", "interpret"),
+    static_argnames=("cfg", "step", "n_recv", "interpret"),
 )
 def step_counts(cfg, inst_ids, rnd, step, values, silent, faulty,
-                interpret: bool = False):
+                n_recv=None, recv_offset=0, interpret: bool = False):
     """Fused (c0, c1) for one broadcast step; drop-in for the masks+tally path.
 
     ``values`` (B, n) int-like wire values ({0,1,2}); for the plain-Ben-Or
     Byzantine pairing the per-receiver matrix is recomputed in-kernel from
-    ``faulty`` (B, n). ``silent`` (B, n) bool-like. Returns two (B, n) int32.
+    ``faulty`` (B, n). ``silent`` (B, n) bool-like. ``n_recv``/``recv_offset``
+    select a contiguous receiver shard (the replica-sharded path); the sender
+    axis is always full width. Returns two (B, n_recv) int32.
     """
     n = cfg.n
+    if n_recv is None:
+        n_recv = n
     B = inst_ids.shape[0]
-    tile_r = min(128, max(8, n))
+    tile_r = min(128, max(8, n_recv))
     n_pad = -(-n // 128) * 128 if n > 8 else 8
-    r_tiles = -(-n // tile_r)
+    r_tiles = -(-n_recv // tile_r)
     r_pad = r_tiles * tile_r
 
     byz_equiv = cfg.adversary == "byzantine" and cfg.protocol != "bracha"
@@ -193,7 +205,23 @@ def step_counts(cfg, inst_ids, rnd, step, values, silent, faulty,
     values = _pad_senders(values.astype(jnp.int32), n_pad, 2)
     silent = _pad_senders(silent.astype(jnp.int32), n_pad, 1)
     faulty = _pad_senders(faulty.astype(jnp.int32), n_pad, 0)
-    params = jnp.asarray(rnd, dtype=jnp.int32).reshape(1)
+    params = jnp.stack([jnp.asarray(rnd, dtype=jnp.int32).reshape(()),
+                        jnp.asarray(recv_offset, dtype=jnp.int32).reshape(())])
+
+    # Under shard_map's vma checking the outputs vary over every mesh axis any
+    # input varies over (counts are per (instance-shard, receiver-shard)), and
+    # every input must carry the same vma for the interpreter's internal slices.
+    _vma = frozenset()
+    for x in (params, inst_ids, values, silent, faulty):
+        _vma |= getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
+
+    def _align(x):
+        need = tuple(a for a in _vma
+                     if a not in (getattr(jax.typeof(x), "vma", frozenset()) or ()))
+        return jax.lax.pcast(x, need, to="varying") if need else x
+
+    params, inst_ids, values, silent, faulty = map(
+        _align, (params, inst_ids, values, silent, faulty))
 
     kernel = functools.partial(
         _step_kernel, seed=cfg.seed, step=step, n=n,
@@ -204,7 +232,7 @@ def step_counts(cfg, inst_ids, rnd, step, values, silent, faulty,
         kernel,
         grid=(B, r_tiles),
         in_specs=[
-            pl.BlockSpec((1,), lambda b, r: (0,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((2,), lambda b, r: (0,), memory_space=pltpu.SMEM),
             pl.BlockSpec((1,), lambda b, r: (b,), memory_space=pltpu.SMEM),
             pl.BlockSpec((1, n_pad), lambda b, r: (b, 0)),
             pl.BlockSpec((1, n_pad), lambda b, r: (b, 0)),
@@ -215,9 +243,9 @@ def step_counts(cfg, inst_ids, rnd, step, values, silent, faulty,
             pl.BlockSpec((1, tile_r), lambda b, r: (b, r)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, r_pad), jnp.int32),
-            jax.ShapeDtypeStruct((B, r_pad), jnp.int32),
+            jax.ShapeDtypeStruct((B, r_pad), jnp.int32, vma=_vma),
+            jax.ShapeDtypeStruct((B, r_pad), jnp.int32, vma=_vma),
         ],
         interpret=interpret,
     )(params, inst_ids.astype(jnp.int32), values, silent, faulty)
-    return c0[:, :n], c1[:, :n]
+    return c0[:, :n_recv], c1[:, :n_recv]

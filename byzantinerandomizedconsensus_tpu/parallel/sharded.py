@@ -35,7 +35,8 @@ from byzantinerandomizedconsensus_tpu.models.adversaries import AdversaryModel
 from byzantinerandomizedconsensus_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh
 
 
-def _run_chunk_sharded(cfg: SimConfig, mesh: Mesh, inst_ids: jnp.ndarray):
+def _run_chunk_sharded(cfg: SimConfig, mesh: Mesh, inst_ids: jnp.ndarray,
+                       counts_fn=None):
     """Simulate one padded chunk on the mesh; returns (rounds (B,), decision (B,))."""
     n_model = mesh.shape[MODEL_AXIS]
     n_local = cfg.n // n_model
@@ -75,7 +76,7 @@ def _run_chunk_sharded(cfg: SimConfig, mesh: Mesh, inst_ids: jnp.ndarray):
         def body(carry):
             r, st, done_at = carry
             st = round_body(cfg, cfg.seed, ids_local, r, st, adv, setup, xp=jnp,
-                            recv_ids=recv_ids, gather=gather)
+                            recv_ids=recv_ids, gather=gather, counts_fn=counts_fn)
             cnt = jax.lax.psum(
                 (st["decided"] | faulty_local).sum(axis=-1, dtype=jnp.int32),
                 MODEL_AXIS,
@@ -100,11 +101,15 @@ def _run_chunk_sharded(cfg: SimConfig, mesh: Mesh, inst_ids: jnp.ndarray):
         decision = jnp.where(done, val, 2).astype(jnp.uint8)
         return rounds, decision
 
+    # vma checking cannot see through pallas_call's interpreter (its internal
+    # block slices mix varying operands with invariant loop indices), so it is
+    # disabled when the fused kernel is active; pcast degrades to a no-op then.
     return jax.shard_map(
         mapped,
         mesh=mesh,
         in_specs=P(DATA_AXIS),
         out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+        check_vma=counts_fn is None,
     )(inst_ids)
 
 
@@ -118,10 +123,14 @@ class JaxShardedBackend(JitChunkedBackend):
     name = "jax_sharded"
 
     def __init__(self, mesh: Optional[Mesh] = None, n_model: int = 1,
-                 chunk_bytes: int = 1 << 30, max_chunk: int = 1 << 16):
+                 chunk_bytes: int = 1 << 30, max_chunk: int = 1 << 16,
+                 kernel: str = "xla"):
         super().__init__(chunk_bytes, max_chunk)
         self._mesh = mesh
         self._n_model = n_model
+        if kernel not in ("xla", "pallas"):
+            raise ValueError(f"unknown kernel {kernel!r}; use 'xla' or 'pallas'")
+        self.kernel = kernel
 
     @property
     def mesh(self) -> Mesh:
@@ -149,4 +158,11 @@ class JaxShardedBackend(JitChunkedBackend):
         return max(n_data, chunk - chunk % n_data)
 
     def _make_fn(self, cfg: SimConfig):
-        return jax.jit(partial(_run_chunk_sharded, cfg, self.mesh))
+        counts_fn = None
+        if self.kernel == "pallas":
+            from byzantinerandomizedconsensus_tpu.ops import pallas_tally
+
+            interpret = jax.default_backend() != "tpu"
+            counts_fn = partial(pallas_tally.counts_fn, interpret=interpret)
+        return jax.jit(partial(_run_chunk_sharded, cfg, self.mesh,
+                               counts_fn=counts_fn))

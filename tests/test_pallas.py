@@ -54,6 +54,23 @@ def test_bitmatch_tile_boundaries(n, f, adv):
     np.testing.assert_array_equal(a.decision, b.decision)
 
 
+@pytest.mark.parametrize("n_data,n_model", [(4, 2), (2, 4)])
+def test_bitmatch_sharded_composition(n_data, n_model):
+    """Fused kernel inside shard_map: receiver-shard offsets keep PRF addressing
+    global, so every mesh shape bit-matches the reference path."""
+    from byzantinerandomizedconsensus_tpu.parallel.mesh import make_mesh
+    from byzantinerandomizedconsensus_tpu.parallel.sharded import JaxShardedBackend
+
+    mesh = make_mesh(n_data=n_data, n_model=n_model)
+    be = JaxShardedBackend(mesh=mesh, kernel="pallas")
+    cfg = SimConfig(protocol="bracha", n=16, f=5, instances=16, adversary="adaptive",
+                    coin="shared", seed=17, round_cap=48).validate()
+    a = be.run(cfg)
+    b = get_backend("numpy").run(cfg)
+    np.testing.assert_array_equal(a.rounds, b.rounds)
+    np.testing.assert_array_equal(a.decision, b.decision)
+
+
 def test_kth_smallest_matches_sort():
     """The bitwise threshold search equals sorted[k-1] on distinct keys."""
     import jax.numpy as jnp
