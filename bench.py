@@ -23,18 +23,23 @@ TARGET_INST_PER_SEC = 100_000 / 60.0  # north-star: 100k instances < 60 s
 
 
 def main() -> int:
+    import os
+
     from byzantinerandomizedconsensus_tpu import Simulator, preset
 
     from byzantinerandomizedconsensus_tpu.backends import get_backend
 
     instances = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    # BENCH_BACKEND selects the backend (jax | jax_pallas | jax_sharded[:p] ...)
+    # for kernel A/B runs; the headline default stays the plain jax backend.
+    backend = sys.argv[2] if len(sys.argv) > 2 else os.environ.get("BENCH_BACKEND", "jax")
     cfg = preset("config4", instances=instances)
-    sim = Simulator(cfg, "jax")
+    sim = Simulator(cfg, backend)
 
     # Warm-up: compile the round kernel at the exact chunk shape the timed run uses
     # (a smaller warm-up batch would compile a different program and leave the real
     # compile inside the timed window).
-    chunk = min(get_backend("jax")._chunk_size(cfg), instances)
+    chunk = min(get_backend(backend)._chunk_size(cfg), instances)
     sim.run(np.arange(chunk, dtype=np.int64))
 
     t0 = time.perf_counter()

@@ -23,10 +23,11 @@ def _numpy():
     return NumpyBackend()
 
 
-def _jax():
+def _jax(kernel: str = "xla"):
+    """``jax`` or ``jax:<kernel>`` with kernel in xla | xla_nosort | pallas."""
     from byzantinerandomizedconsensus_tpu.backends.jax_backend import JaxBackend
 
-    return JaxBackend()
+    return JaxBackend(kernel=kernel or "xla")
 
 
 def _jax_cpu():

@@ -71,8 +71,9 @@ class JaxBackend(JitChunkedBackend):
                  device=None, kernel: str = "xla"):
         super().__init__(chunk_bytes, max_chunk)
         self.device = device
-        if kernel not in ("xla", "pallas"):
-            raise ValueError(f"unknown kernel {kernel!r}; use 'xla' or 'pallas'")
+        if kernel not in ("xla", "xla_nosort", "pallas"):
+            raise ValueError(
+                f"unknown kernel {kernel!r}; use 'xla', 'xla_nosort' or 'pallas'")
         self.kernel = kernel
 
     def _chunk_size(self, cfg: SimConfig) -> int:
@@ -86,6 +87,10 @@ class JaxBackend(JitChunkedBackend):
 
             interpret = jax.default_backend() != "tpu"
             counts_fn = partial(pallas_tally.counts_fn, interpret=interpret)
+        elif self.kernel == "xla_nosort":
+            from byzantinerandomizedconsensus_tpu.ops import masks
+
+            counts_fn = masks.counts_nosort
         return jax.jit(partial(_run_chunk, cfg, counts_fn=counts_fn))
 
     def _device_ctx(self):

@@ -74,3 +74,61 @@ def delivery_mask(cfg, seed, inst_ids, rnd, t, silent, bias, xp=np, recv_ids=Non
     combined = combined_keys(cfg, seed, inst_ids, rnd, t, silent, bias, xp=xp,
                              recv_ids=recv_ids)
     return mask_from_keys(combined, cfg.n - cfg.f, silent, xp=xp, recv_ids=recv_ids)
+
+
+def _kth_bitwise(combined, k: int):
+    """jax-only: the k-th smallest key per receiver row without a sort — 32-step
+    MSB-first threshold construction (keys distinct by packing). Same recurrence
+    as ops/pallas_tally._kth_smallest, here over the full (B, R, n) tensor so it
+    can be A/B'd against the XLA sort on TPU without Pallas in the loop."""
+    import jax
+    import jax.numpy as jnp
+
+    flip = jnp.uint32(0x80000000)
+    signed = lambda x: jax.lax.bitcast_convert_type(x ^ flip, jnp.int32)
+    fk = signed(combined)
+
+    def bit_step(i, acc):
+        b = 31 - i
+        cand = acc | jnp.uint32((1 << b) - 1)
+        cnt = jnp.sum((fk <= signed(cand)).astype(jnp.int32), axis=-1,
+                      keepdims=True)
+        return jnp.where(cnt >= k, acc, acc | jnp.uint32(1 << b))
+
+    acc = jnp.zeros(combined.shape[:-1] + (1,), dtype=jnp.uint32)
+    return jax.lax.fori_loop(0, 32, bit_step, acc)
+
+
+def counts_nosort(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
+                  recv_ids=None):
+    """Sort-free (c0, c1) for one step — the counts_fn hook's pure-XLA variant.
+
+    Same key tensor as the default path, but the n-f'th key comes from
+    :func:`_kth_bitwise` and the mask is consumed immediately by the tally, so
+    XLA can fuse keygen -> threshold -> count without the sort. Bias bits are
+    recomputed exactly as models/adversaries.py emits them (the hook does not
+    carry the bias output).
+    """
+    import jax.numpy as jnp
+
+    from byzantinerandomizedconsensus_tpu.ops import tally
+
+    del faulty, honest  # dense-path semantics take inject's outputs verbatim
+    n = cfg.n
+    B = values.shape[0]
+    if recv_ids is None:
+        recv = jnp.arange(n, dtype=jnp.uint32)
+    else:
+        recv = jnp.asarray(recv_ids, dtype=jnp.uint32)
+    if cfg.adversary == "adaptive":
+        pref = (recv.astype(jnp.int32) >= (n + 1) // 2)[None, :, None].astype(jnp.uint8)
+        vv = values[:, None, :] if values.ndim == 2 else values
+        bias = ((vv == 2) | (vv != pref)).astype(jnp.uint32)
+    else:
+        bias = jnp.zeros((B, 1, n), dtype=jnp.uint32)
+    combined = combined_keys(cfg, seed, inst_ids, rnd, t, silent, bias, xp=jnp,
+                             recv_ids=recv)
+    kth = _kth_bitwise(combined, n - cfg.f)
+    own = (recv[:, None] == jnp.arange(n, dtype=jnp.uint32)[None, :])[None]
+    mask = ((combined <= kth) & ~jnp.asarray(silent, dtype=bool)[:, None, :]) | own
+    return tally.tally01(mask, values, xp=jnp)

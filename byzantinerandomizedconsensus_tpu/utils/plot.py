@@ -1,0 +1,43 @@
+"""Round-distribution plots (SURVEY.md §5 metrics artifacts; config-5 deliverable).
+
+Renders the sweep's per-n round histograms (the reported artifact of BASELINE.json
+config 5) to a PNG/SVG. matplotlib is imported lazily and the functions degrade to a
+clear error when it is absent.
+"""
+
+from __future__ import annotations
+
+import pathlib
+from typing import Mapping
+
+
+def plot_sweep(sweep_out: Mapping, path, log_y: bool = True, max_round=None) -> None:
+    """``sweep_out``: {n: summary-with-round_histogram} as produced by
+    utils/sweep.run_sweep (keys may be int or str). Writes the figure to ``path``."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(8, 5))
+    for n_key in sorted(sweep_out, key=int):
+        s = sweep_out[n_key]
+        hist = s["round_histogram"]
+        hi = max_round or max(i for i, c in enumerate(hist) if c) + 1
+        xs = range(1, hi + 1)
+        ys = hist[1:hi + 1]
+        ax.plot(xs, ys, marker="o", markersize=3,
+                label=f"n={n_key} (f={s['f']})")
+    if log_y:
+        ax.set_yscale("symlog")
+    ax.set_xlabel("rounds to decision")
+    ax.set_ylabel("instances")
+    ax.set_title(f"round distribution — {s['protocol']}, {s['adversary']} adversary, "
+                 f"{s['coin']} coin")
+    ax.legend(fontsize=8)
+    ax.grid(True, alpha=0.3)
+    fig.tight_layout()
+    path = pathlib.Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fig.savefig(path, dpi=150)
+    plt.close(fig)

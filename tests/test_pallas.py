@@ -54,6 +54,22 @@ def test_bitmatch_tile_boundaries(n, f, adv):
     np.testing.assert_array_equal(a.decision, b.decision)
 
 
+@pytest.mark.parametrize(
+    "proto,adv",
+    list(itertools.product(["benor", "bracha"],
+                           ["none", "crash", "byzantine", "adaptive"])),
+)
+def test_bitmatch_xla_nosort_grid(proto, adv):
+    """The sort-free pure-XLA selection (ops/masks.counts_nosort) bit-matches."""
+    n, f = _sizes(proto, adv)
+    cfg = SimConfig(protocol=proto, n=n, f=f, instances=24, adversary=adv,
+                    coin="shared", seed=13, round_cap=48).validate()
+    a = get_backend("jax:xla_nosort").run(cfg)
+    b = get_backend("numpy").run(cfg)
+    np.testing.assert_array_equal(a.rounds, b.rounds)
+    np.testing.assert_array_equal(a.decision, b.decision)
+
+
 @pytest.mark.parametrize("n_data,n_model", [(4, 2), (2, 4)])
 def test_bitmatch_sharded_composition(n_data, n_model):
     """Fused kernel inside shard_map: receiver-shard offsets keep PRF addressing
