@@ -47,17 +47,24 @@ class SimulatorBackend(abc.ABC):
 
         The tail chunk is padded (with a repeated last id) to the compiled shape so
         exactly one program per config is compiled; padded rows are discarded.
+        All chunks are dispatched before any result is fetched — JAX's async
+        dispatch then queues them back-to-back on the device instead of
+        round-tripping through the host after every chunk (per-chunk outputs are
+        only O(B) scalars, so holding them all is free).
         """
         import jax.numpy as jnp
 
-        rounds_out = np.empty(len(ids), dtype=np.int32)
-        decision_out = np.empty(len(ids), dtype=np.uint8)
+        pending = []
         for lo in range(0, len(ids), chunk):
             hi = min(lo + chunk, len(ids))
             cids = ids[lo:hi]
             if len(cids) < chunk:
                 cids = np.concatenate([cids, np.full(chunk - len(cids), cids[-1])])
-            r, d = fn(jnp.asarray(cids, dtype=jnp.uint32))
+            pending.append((lo, hi, fn(jnp.asarray(cids, dtype=jnp.uint32))))
+
+        rounds_out = np.empty(len(ids), dtype=np.int32)
+        decision_out = np.empty(len(ids), dtype=np.uint8)
+        for lo, hi, (r, d) in pending:
             rounds_out[lo:hi] = np.asarray(r)[: hi - lo]
             decision_out[lo:hi] = np.asarray(d)[: hi - lo]
         return rounds_out, decision_out

@@ -101,6 +101,11 @@ def cmd_sweep(args) -> int:
         progress=lambda msg: print(msg, file=sys.stderr),
     )
     print(json.dumps(out))
+    if args.plot:
+        from byzantinerandomizedconsensus_tpu.utils import plot
+
+        plot.plot_sweep(out, args.plot)
+        print(f"wrote {args.plot}", file=sys.stderr)
     return 0
 
 
@@ -128,6 +133,8 @@ def main(argv=None) -> int:
     p_sw.add_argument("--shard-instances", type=int, default=500)
     p_sw.add_argument("--seed", type=int, default=0)
     p_sw.add_argument("--coin", choices=["local", "shared"], default="shared")
+    p_sw.add_argument("--plot", default=None, metavar="FILE",
+                      help="render the round-distribution figure (png/svg)")
     p_sw.set_defaults(fn=cmd_sweep)
 
     args = ap.parse_args(argv)
