@@ -93,6 +93,14 @@ def cmd_bitmatch(args) -> int:
 
 
 def cmd_sweep(args) -> int:
+    if args.plot:
+        # Fail before the (potentially hours-long) sweep, not after it.
+        try:
+            import matplotlib  # noqa: F401
+        except ImportError:
+            print("--plot requires matplotlib, which is not installed",
+                  file=sys.stderr)
+            return 2
     out = sweep.run_sweep(
         pathlib.Path(args.out), backend=args.backend,
         ns=tuple(int(x) for x in args.ns) if args.ns else sweep.SWEEP_NS,

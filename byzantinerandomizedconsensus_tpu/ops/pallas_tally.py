@@ -92,16 +92,19 @@ def _kth_smallest(keys_u32, k: int):
 
 
 def _step_kernel(params_ref, ids_ref, values_ref, silent_ref, faulty_ref,
-                 c0_ref, c1_ref, *, seed, step, n, n_deliver, tile_r,
+                 c0_ref, c1_ref, *, seed, step, n, n_deliver, tile_r, block_b,
                  byz_equiv, adaptive, adv_bracha_byz):
-    """One (instance, receiver-tile) block. Shapes (padded sender axis S):
-    values/silent/faulty (1, S) i32; outputs c0/c1 (1, TR) i32. Receiver
-    indices are global: params[1] carries the shard offset (0 unsharded)."""
+    """One (instance-block, receiver-tile) grid cell. Shapes (padded sender
+    axis S): values/silent/faulty (block_b, S) i32; outputs c0/c1
+    (block_b, TR) i32. The ``block_b`` instance rows are processed by an
+    unrolled loop of 2-D (tile_r, S) computations (Mosaic requires >= (8, 128)
+    blocks on the last two dims, so single-instance rows can't be blocks).
+    Receiver indices are global: params[1] carries the shard offset
+    (0 unsharded)."""
     k0, k1 = prf.seed_key(seed)
     k0, k1 = int(k0), int(k1)
     rnd = params_ref[0].astype(jnp.uint32)
     recv_offset = params_ref[1].astype(jnp.uint32)
-    inst = ids_ref[0].astype(jnp.uint32)
     r_tile = pl.program_id(1)
 
     S = values_ref.shape[1]
@@ -109,51 +112,55 @@ def _step_kernel(params_ref, ids_ref, values_ref, silent_ref, faulty_ref,
     send = jax.lax.broadcasted_iota(jnp.uint32, (tile_r, S), 1)
     recv = (jax.lax.broadcasted_iota(jnp.uint32, (tile_r, S), 0)
             + r_tile.astype(jnp.uint32) * u(tile_r) + recv_offset)
-
-    values = values_ref[0, :].astype(jnp.int32)[None, :]
-    silent = silent_ref[0, :].astype(jnp.int32)[None, :]
     x1_base = (rnd << u(16)) | (recv << u(6)) | u(step << 4)
+    own = send == recv
 
-    if byz_equiv:
-        # Plain-Ben-Or Byzantine: per-(recv, send) value e % 3 for faulty
-        # senders (spec §6.3), recomputed in-register.
-        faulty = faulty_ref[0, :].astype(jnp.int32)[None, :]
-        e = _threefry2x32(k0, k1, (send << u(17)) | inst,
-                          x1_base | u(prf.BYZ_VALUE))
-        vmat = (e % u(3)).astype(jnp.int32)
-        vals = jnp.where(faulty > 0, vmat, values)
-    else:
-        vals = jnp.broadcast_to(values, (tile_r, S))
+    for i in range(block_b):
+        inst = ids_ref[pl.program_id(0) * block_b + i].astype(jnp.uint32)
+        values = values_ref[i, :].astype(jnp.int32)[None, :]
+        silent = silent_ref[i, :].astype(jnp.int32)[None, :]
 
-    if adaptive:
-        # spec §6.4 delivery bias, recomputed in-register from the wire values.
-        pref = (recv >= u((n + 1) // 2)).astype(jnp.int32)
-        bias = ((vals == 2) | (vals != pref)).astype(jnp.uint32)
-    else:
-        bias = jnp.zeros((tile_r, S), dtype=jnp.uint32)
+        if byz_equiv:
+            # Plain-Ben-Or Byzantine: per-(recv, send) value e % 3 for faulty
+            # senders (spec §6.3), recomputed in-register.
+            faulty = faulty_ref[i, :].astype(jnp.int32)[None, :]
+            e = _threefry2x32(k0, k1, (send << u(17)) | inst,
+                              x1_base | u(prf.BYZ_VALUE))
+            vmat = (e % u(3)).astype(jnp.int32)
+            vals = jnp.where(faulty > 0, vmat, values)
+        else:
+            vals = jnp.broadcast_to(values, (tile_r, S))
+
+        if adaptive:
+            # spec §6.4 delivery bias, recomputed in-register from wire values.
+            pref = (recv >= u((n + 1) // 2)).astype(jnp.int32)
+            bias = ((vals == 2) | (vals != pref)).astype(jnp.uint32)
+        else:
+            bias = jnp.zeros((tile_r, S), dtype=jnp.uint32)
+
+        sched = _threefry2x32(k0, k1, (send << u(17)) | inst,
+                              x1_base | u(prf.SCHED))
+        combined = ((silent.astype(jnp.uint32) << u(31)) | (bias << u(30))
+                    | (((sched >> u(12)) & u(0xFFFFF)) << u(10)) | send)
+        # Padded senders (send >= n) sort last; silenced by the caller.
+        combined = jnp.where(send >= u(n), u(0xFFFFFFFF), combined)
+        combined = jnp.where(own, recv, combined)
+
+        kth = _kth_smallest(combined, n_deliver)
+        delivered = own | ((_signed(combined) <= _signed(kth)) & (silent == 0))
+        c0_ref[i, :] = jnp.sum(delivered & (vals == 0), axis=-1).astype(jnp.int32)
+        c1_ref[i, :] = jnp.sum(delivered & (vals == 1), axis=-1).astype(jnp.int32)
     del adv_bracha_byz  # silence handled upstream; key layout identical
 
-    sched = _threefry2x32(k0, k1, (send << u(17)) | inst,
-                          x1_base | u(prf.SCHED))
-    combined = ((silent.astype(jnp.uint32) << u(31)) | (bias << u(30))
-                | (((sched >> u(12)) & u(0xFFFFF)) << u(10)) | send)
-    # Padded senders (send >= n) sort last and are silenced by the caller.
-    combined = jnp.where(send >= u(n), u(0xFFFFFFFF), combined)
-    own = send == recv
-    combined = jnp.where(own, recv, combined)
 
-    kth = _kth_smallest(combined, n_deliver)
-    delivered = own | ((_signed(combined) <= _signed(kth)) & (silent == 0))
-    c0_ref[0, :] = jnp.sum(delivered & (vals == 0), axis=-1).astype(jnp.int32)
-    c1_ref[0, :] = jnp.sum(delivered & (vals == 1), axis=-1).astype(jnp.int32)
-
-
-def _pad_senders(x, n_pad: int, fill):
-    n = x.shape[-1]
-    if n == n_pad:
+def _pad_axis(x, axis: int, size: int, fill):
+    """Pad ``x`` along ``axis`` (0 = instances, -1 = senders) up to ``size``."""
+    have = x.shape[axis]
+    if have == size:
         return x
-    return jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, n_pad - n)],
-                   constant_values=fill)
+    pads = [(0, 0)] * x.ndim
+    pads[axis] = (0, size - have)
+    return jnp.pad(x, pads, constant_values=fill)
 
 
 def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
@@ -198,13 +205,20 @@ def step_counts(cfg, inst_ids, rnd, step, values, silent, faulty,
     n_pad = -(-n // 128) * 128 if n > 8 else 8
     r_tiles = -(-n_recv // tile_r)
     r_pad = r_tiles * tile_r
+    block_b = 8  # Mosaic minimum sublane block; unrolled inside the kernel
+    b_blocks = -(-B // block_b)
+    B_pad = b_blocks * block_b
 
     byz_equiv = cfg.adversary == "byzantine" and cfg.protocol != "bracha"
     adaptive = cfg.adversary == "adaptive"
 
-    values = _pad_senders(values.astype(jnp.int32), n_pad, 2)
-    silent = _pad_senders(silent.astype(jnp.int32), n_pad, 1)
-    faulty = _pad_senders(faulty.astype(jnp.int32), n_pad, 0)
+    def _pad(x, fill):
+        return _pad_axis(_pad_axis(x, -1, n_pad, fill), 0, B_pad, fill)
+
+    inst_ids = _pad_axis(inst_ids, 0, B_pad, 0)
+    values = _pad(values.astype(jnp.int32), 2)
+    silent = _pad(silent.astype(jnp.int32), 1)
+    faulty = _pad(faulty.astype(jnp.int32), 0)
     params = jnp.stack([jnp.asarray(rnd, dtype=jnp.int32).reshape(()),
                         jnp.asarray(recv_offset, dtype=jnp.int32).reshape(())])
 
@@ -225,27 +239,28 @@ def step_counts(cfg, inst_ids, rnd, step, values, silent, faulty,
 
     kernel = functools.partial(
         _step_kernel, seed=cfg.seed, step=step, n=n,
-        n_deliver=n - cfg.f, tile_r=tile_r, byz_equiv=byz_equiv,
-        adaptive=adaptive, adv_bracha_byz=False,
+        n_deliver=n - cfg.f, tile_r=tile_r, block_b=block_b,
+        byz_equiv=byz_equiv, adaptive=adaptive, adv_bracha_byz=False,
     )
     c0, c1 = pl.pallas_call(
         kernel,
-        grid=(B, r_tiles),
+        grid=(b_blocks, r_tiles),
         in_specs=[
             pl.BlockSpec((2,), lambda b, r: (0,), memory_space=pltpu.SMEM),
-            pl.BlockSpec((1,), lambda b, r: (b,), memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, n_pad), lambda b, r: (b, 0)),
-            pl.BlockSpec((1, n_pad), lambda b, r: (b, 0)),
-            pl.BlockSpec((1, n_pad), lambda b, r: (b, 0)),
+            pl.BlockSpec((B_pad,), lambda b, r: (0,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((block_b, n_pad), lambda b, r: (b, 0)),
+            pl.BlockSpec((block_b, n_pad), lambda b, r: (b, 0)),
+            pl.BlockSpec((block_b, n_pad), lambda b, r: (b, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, tile_r), lambda b, r: (b, r)),
-            pl.BlockSpec((1, tile_r), lambda b, r: (b, r)),
+            pl.BlockSpec((block_b, tile_r), lambda b, r: (b, r)),
+            pl.BlockSpec((block_b, tile_r), lambda b, r: (b, r)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, r_pad), jnp.int32, vma=_vma),
-            jax.ShapeDtypeStruct((B, r_pad), jnp.int32, vma=_vma),
+            jax.ShapeDtypeStruct((B_pad, r_pad), jnp.int32, vma=_vma),
+            jax.ShapeDtypeStruct((B_pad, r_pad), jnp.int32, vma=_vma),
         ],
         interpret=interpret,
     )(params, inst_ids.astype(jnp.int32), values, silent, faulty)
-    return c0[:, :n_recv], c1[:, :n_recv]
+    return c0[:B, :n_recv], c1[:B, :n_recv]

@@ -141,8 +141,13 @@ class JaxShardedBackend(JitChunkedBackend):
     def _chunk_size(self, cfg: SimConfig) -> int:
         """Total chunk B across the mesh; per-device transients are (B/|data|, n/|model|, n)."""
         mesh = self.mesh
-        per_inst = cfg.n * (cfg.n // mesh.shape[MODEL_AXIS]) * 4 * 4
-        per_dev = max(1, self.chunk_bytes // max(per_inst, 1))
+        if self.kernel == "pallas":
+            # Fused kernel: no (B,n,n) HBM transient — per-device chunk is the
+            # dispatch-amortisation optimum (see JaxBackend._chunk_size).
+            per_dev = 4096
+        else:
+            per_inst = cfg.n * (cfg.n // mesh.shape[MODEL_AXIS]) * 4 * 4
+            per_dev = max(1, self.chunk_bytes // max(per_inst, 1))
         b = min(self.max_chunk, per_dev * mesh.shape[DATA_AXIS])
         # Round down to a data-axis multiple (≥ one instance per data shard).
         return max(mesh.shape[DATA_AXIS], b - b % mesh.shape[DATA_AXIS])
