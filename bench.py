@@ -31,14 +31,21 @@ def main() -> int:
 
     instances = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
     # BENCH_BACKEND selects the backend (jax | jax_pallas | jax_sharded[:p] ...)
-    # for kernel A/B runs; the headline default is the fused Pallas kernel on
-    # TPU (~4x the XLA masks+sort path there) and the XLA path elsewhere.
+    # and BENCH_DELIVERY the scheduling model, for A/B runs. The headline
+    # default is the urn delivery model (spec §4b — count-level scheduling,
+    # O(n·f) per instance-step) on the plain jax backend; the keys model
+    # (O(n²) mask, spec §4) remains available via BENCH_DELIVERY=keys, where
+    # the fused Pallas kernel (jax_pallas) is the fast path on TPU.
     backend = sys.argv[2] if len(sys.argv) > 2 else os.environ.get("BENCH_BACKEND", "")
+    delivery = os.environ.get("BENCH_DELIVERY", "urn")
     if not backend:
         import jax
 
-        backend = "jax_pallas" if jax.default_backend() == "tpu" else "jax"
-    cfg = preset("config4", instances=instances)
+        if delivery == "keys":
+            backend = "jax_pallas" if jax.default_backend() == "tpu" else "jax"
+        else:
+            backend = "jax"
+    cfg = preset("config4", instances=instances, delivery=delivery)
     sim = Simulator(cfg, backend)
 
     # Warm-up: compile the round kernel at the exact chunk shape the timed run uses

@@ -65,6 +65,9 @@ class CpuBackend(SimulatorBackend):
         adv = make_adversary(cfg, cfg.seed, instance)
         correct = [j for j in range(cfg.n) if not adv.faulty[j]]
 
+        two_faced = cfg.delivery == "urn" and cfg.adversary == "byzantine" \
+            and cfg.protocol != "bracha"
+
         for r in range(cfg.round_cap):
             g_prev = None  # global live-valid counts of the previous step (bracha)
             for t in range(cfg.steps_per_round):
@@ -77,9 +80,26 @@ class CpuBackend(SimulatorBackend):
                     live = ~silent
                     g_prev = (int(np.count_nonzero(live & (values == 0))),
                               int(np.count_nonzero(live & (values == 1))))
-                vmat, mask = net.deliver(r, t, values, silent, bias)
-                for rep in replicas:
-                    rep.on_deliver(t, vmat[rep.index], mask[rep.index])
+                if cfg.delivery == "urn":
+                    if two_faced:
+                        # §4b two-faced equivocation, independent of ops/urn.py.
+                        send = np.arange(cfg.n, dtype=np.uint32)
+                        vbc = []
+                        for h in (0, 1):
+                            e = prf.prf_u32(cfg.seed, instance, r, t, h, send,
+                                            prf.BYZ_VALUE, xp=np)
+                            vh = (e % np.uint32(3)).astype(np.uint8)
+                            vbc.append(np.where(adv.faulty, vh, honest).astype(np.uint8))
+                    else:
+                        vbc = [values, values]
+                    c0, c1 = net.urn_counts(r, t, vbc, silent,
+                                            adaptive=cfg.adversary == "adaptive")
+                    for rep in replicas:
+                        rep.on_counts(t, int(c0[rep.index]), int(c1[rep.index]))
+                else:
+                    vmat, mask = net.deliver(r, t, values, silent, bias)
+                    for rep in replicas:
+                        rep.on_deliver(t, vmat[rep.index], mask[rep.index])
             if cfg.coin == "shared":
                 shared = int(prf.prf_bit(cfg.seed, instance, r, prf.COIN_STEP, 0, 0,
                                          prf.SHARED_COIN, xp=np))

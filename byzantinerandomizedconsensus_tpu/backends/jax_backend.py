@@ -77,6 +77,12 @@ class JaxBackend(JitChunkedBackend):
         self.kernel = kernel
 
     def _chunk_size(self, cfg: SimConfig) -> int:
+        if cfg.delivery == "urn":
+            # No O(B·n²) transient at all — state is O(B·n). Measured optimum
+            # at n=512 on v5e is ~4k instances/chunk: beyond that the
+            # while-loop straggler cost (whole chunk pays max rounds) outweighs
+            # dispatch amortisation.
+            return max(1, min(self.max_chunk, (1 << 21) // max(1, cfg.n)))
         if self.kernel == "pallas":
             # The fused kernel keeps the (B,n,n) key tensor VMEM-resident per
             # block — HBM holds only O(B·n) state, so the chunk is sized for
@@ -88,6 +94,12 @@ class JaxBackend(JitChunkedBackend):
 
     def _make_fn(self, cfg: SimConfig):
         counts_fn = None
+        if cfg.delivery == "urn":
+            # The round bodies route to ops/urn.py themselves; the keys-model
+            # kernels below do not apply. kernel='pallas' currently falls back
+            # to the XLA urn path (the unrolled fori_loop already keeps the
+            # urn carry in registers — see ops/urn.py).
+            return jax.jit(partial(_run_chunk, cfg, counts_fn=None))
         if self.kernel == "pallas":
             from byzantinerandomizedconsensus_tpu.ops import pallas_tally
 

@@ -25,8 +25,9 @@ _PROTO = {"benor": 0, "bracha": 1}
 _ADV = {"none": 0, "crash": 1, "byzantine": 2, "adaptive": 3}
 _COIN = {"local": 0, "shared": 1}
 _INIT = {"random": 0, "all0": 1, "all1": 2, "split": 3}
+_DELIVERY = {"keys": 0, "urn": 1}
 
-_ABI_VERSION = 1
+_ABI_VERSION = 2
 
 _lib = None
 
@@ -70,7 +71,7 @@ def _load():
         lib.sim_run.argtypes = [
             ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
-            ctypes.c_int,
+            ctypes.c_int, ctypes.c_int,
             np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
             ctypes.c_int64, ctypes.c_int,
             np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
@@ -100,7 +101,7 @@ class NativeBackend(SimulatorBackend):
                 _PROTO[cfg.protocol], cfg.n, cfg.f, _ADV[cfg.adversary],
                 _COIN[cfg.coin], _INIT[cfg.init],
                 ctypes.c_uint64(cfg.seed & 0xFFFFFFFFFFFFFFFF),
-                cfg.round_cap, cfg.crash_window,
+                cfg.round_cap, cfg.crash_window, _DELIVERY[cfg.delivery],
                 ids, len(ids), self.n_threads, rounds, decision,
             )
         return SimResult(config=cfg, inst_ids=ids, rounds=rounds, decision=decision)

@@ -34,6 +34,9 @@ def _add_config_args(p: argparse.ArgumentParser, default_backend: str = "cpu") -
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--round-cap", type=int, default=None)
     p.add_argument("--init", choices=["random", "all0", "all1", "split"], default=None)
+    p.add_argument("--delivery", choices=["keys", "urn"], default=None,
+                   help="scheduling model: keys (spec §4, O(n²) mask) | urn "
+                        "(spec §4b, count-level — the TPU fast path)")
     p.add_argument("--backend", default=default_backend,
                    help="cpu (oracle) | numpy | native[:threads] | jax | jax_cpu "
                         "| jax_sharded[:n_model]")
@@ -45,7 +48,7 @@ def _config_from(args) -> SimConfig:
         ("protocol", args.protocol), ("n", args.n), ("f", args.f),
         ("instances", args.instances), ("adversary", args.adversary),
         ("coin", args.coin), ("seed", args.seed), ("round_cap", args.round_cap),
-        ("init", args.init),
+        ("init", args.init), ("delivery", args.delivery),
     ] if v is not None}
     if args.preset:
         return preset(args.preset, **overrides)

@@ -11,6 +11,7 @@ Protocol = Literal["benor", "bracha"]
 AdversaryKind = Literal["none", "crash", "byzantine", "adaptive"]
 CoinKind = Literal["local", "shared"]
 InitKind = Literal["random", "all0", "all1", "split"]
+DeliveryKind = Literal["keys", "urn"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -25,6 +26,7 @@ class SimConfig:
     round_cap: int = 256
     crash_window: int = 4
     init: InitKind = "random"
+    delivery: DeliveryKind = "keys"
 
     @property
     def steps_per_round(self) -> int:
@@ -36,6 +38,8 @@ class SimConfig:
         return self.adversary in ("byzantine", "adaptive")
 
     def validate(self) -> "SimConfig":
+        if self.delivery not in ("keys", "urn"):
+            raise ValueError(f"unknown delivery {self.delivery!r}; use 'keys' or 'urn'")
         if not (0 < self.n <= prf.MAX_N):
             raise ValueError(f"n={self.n} out of range (1..{prf.MAX_N})")
         if not (0 <= self.f < self.n):

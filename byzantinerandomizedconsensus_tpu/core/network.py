@@ -48,3 +48,45 @@ class Network:
         values = np.asarray(values, dtype=np.uint8)
         vmat = np.broadcast_to(values, (n, n)) if values.ndim == 1 else values
         return vmat, self.delivery_mask(rnd, t, silent, bias)
+
+    def urn_counts(self, rnd: int, t: int, vals_by_class, silent: np.ndarray,
+                   adaptive: bool):
+        """Per-receiver delivered counts (c0, c1) via the §4b urn process.
+
+        ``vals_by_class``: pair of (n,) wire-value arrays, one per receiver class
+        (identical objects when the adversary doesn't equivocate). Scalar
+        python-int implementation, independent of ops/urn.py, per the spec's
+        D-iteration form (unused LCG draws are never generated, which is
+        equivalent to the vectorized f-iteration masked form).
+        """
+        n, f = self.cfg.n, self.cfg.f
+        half = (n + 1) // 2
+        k = n - f - 1
+        c0 = np.empty(n, dtype=np.int32)
+        c1 = np.empty(n, dtype=np.int32)
+        for v in range(n):
+            h = 0 if v < half else 1
+            vals = vals_by_class[h]
+            rem = [0, 0, 0]
+            for u in range(n):
+                if u != v and not silent[u]:
+                    rem[int(vals[u])] += 1
+            drops = max(0, sum(rem) - k)
+            # biased(w, h): only the adaptive adversary biases scheduling.
+            st = [h != 0, h != 1, True] if adaptive else [False, False, False]
+            s = int(prf.prf_u32(self.seed, self.instance, rnd, t,
+                                np.uint32(v), 0, prf.URN, xp=np))
+            for _ in range(drops):
+                s = (s * prf.URN_LCG_A + prf.URN_LCG_C) & 0xFFFFFFFF
+                u32 = s ^ (s >> 16)
+                b_rem = sum(rem[w] for w in range(3) if st[w])
+                in_biased = b_rem > 0
+                r_cur = b_rem if in_biased else sum(rem) - b_rem
+                d = ((u32 >> 10) * r_cur) >> 22
+                e = [rem[w] if st[w] == in_biased else 0 for w in range(3)]
+                w = 0 if d < e[0] else (1 if d < e[0] + e[1] else 2)
+                rem[w] -= 1
+            own = int(vals[v])
+            c0[v] = rem[0] + (1 if own == 0 else 0)
+            c1[v] = rem[1] + (1 if own == 1 else 0)
+        return c0, c1

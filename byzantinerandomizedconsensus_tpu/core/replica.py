@@ -42,6 +42,10 @@ class Replica:
         """Process one step's delivered messages (values row + delivery mask row)."""
         c0 = int(np.count_nonzero(delivered & (values == 0)))
         c1 = int(np.count_nonzero(delivered & (values == 1)))
+        self.on_counts(t, c0, c1)
+
+    def on_counts(self, t: int, c0: int, c1: int) -> None:
+        """Process one step from delivered-value counts (urn delivery, spec §4b)."""
         n, f = self.cfg.n, self.cfg.f
         if self.cfg.protocol == "benor":
             # Protocol A (benign) vs Protocol B (lying) thresholds — spec §5.1.

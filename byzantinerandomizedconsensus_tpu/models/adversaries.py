@@ -98,6 +98,10 @@ class AdversaryModel:
                              xp.where(b == 2, xp.uint8(1), honest_values.astype(xp.uint8)))
                 values = xp.where(faulty, v, honest_values).astype(xp.uint8)
                 return values, silent, no_bias
+            if cfg.delivery == "urn":
+                # §4b: urn counts recompute the two-faced class values from
+                # (honest, faulty) themselves — never build the O(B,n,n) matrix.
+                return honest_values, zero_silent, no_bias
             # Plain Ben-Or pairing: full per-receiver equivocation matrix (spec §6.3).
             R = recv_ids.shape[0]
             recv3 = recv_ids[None, :, None]
@@ -117,6 +121,10 @@ class AdversaryModel:
             h0 = (honest_live & nonbot & (honest_values == 0)).sum(-1, dtype=xp.int32)
             minority = xp.where(h1 <= h0, xp.uint8(1), xp.uint8(0))
             values = xp.where(faulty, minority[:, None], honest_values).astype(xp.uint8)
+            if cfg.delivery == "urn":
+                # §4b: scheduling strata are derived inside the urn from the
+                # wire values — the (B, R, n) bias matrix is never needed.
+                return values, zero_silent, no_bias
             # Receiver v prefers value 0 iff v < n/2; senders whose wire value matches
             # the receiver's preference get bias 0 (delivered first), others bias 1.
             pref = (recv_ids.astype(xp.int32) >= (n + 1) // 2)[None, :, None].astype(xp.uint8)

@@ -29,6 +29,11 @@ FAULTY_RANK = 3
 CRASH_ROUND = 4
 BYZ_VALUE = 5
 SCHED = 6
+URN = 7
+
+# Urn-delivery LCG (spec §4b): full period mod 2^32 (A ≡ 1 mod 4, C odd).
+URN_LCG_A = 0x915F77F5
+URN_LCG_C = 0x6A09E667
 
 # The step index used for coin draws (outside the protocol's message steps).
 COIN_STEP = 3

@@ -141,7 +141,11 @@ class JaxShardedBackend(JitChunkedBackend):
     def _chunk_size(self, cfg: SimConfig) -> int:
         """Total chunk B across the mesh; per-device transients are (B/|data|, n/|model|, n)."""
         mesh = self.mesh
-        if self.kernel == "pallas":
+        if cfg.delivery == "urn":
+            # No O(B·n²) transient (spec §4b) — per-device chunk mirrors
+            # JaxBackend._chunk_size's dispatch-amortisation optimum.
+            per_dev = max(1, (1 << 21) // max(1, cfg.n))
+        elif self.kernel == "pallas":
             # Fused kernel: no (B,n,n) HBM transient — per-device chunk is the
             # dispatch-amortisation optimum (see JaxBackend._chunk_size).
             per_dev = 4096
@@ -164,7 +168,10 @@ class JaxShardedBackend(JitChunkedBackend):
 
     def _make_fn(self, cfg: SimConfig):
         counts_fn = None
-        if self.kernel == "pallas":
+        if self.kernel == "pallas" and cfg.delivery != "urn":
+            # Urn delivery routes through the round bodies' ops/urn.py path
+            # (already mesh-compatible: lanes are local receiver shards); the
+            # keys-model pallas kernel must not shadow it.
             from byzantinerandomizedconsensus_tpu.ops import pallas_tally
 
             interpret = jax.default_backend() != "tpu"

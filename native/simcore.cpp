@@ -59,9 +59,14 @@ enum Purpose : uint32_t {
   kCrashRound = 4,
   kByzValue = 5,
   kSched = 6,
+  kUrn = 7,
 };
 
 constexpr uint32_t kCoinStep = 3;
+
+// Urn-delivery LCG (spec §4b): full period mod 2^32.
+constexpr uint32_t kUrnLcgA = 0x915F77F5u;
+constexpr uint32_t kUrnLcgC = 0x6A09E667u;
 
 struct Key {
   uint32_t k0, k1;
@@ -87,6 +92,7 @@ enum Protocol { kBenor = 0, kBracha = 1 };
 enum AdversaryKind { kNone = 0, kCrash = 1, kByzantine = 2, kAdaptive = 3 };
 enum CoinKind { kLocal = 0, kShared = 1 };
 enum InitKind { kRandom = 0, kAll0 = 1, kAll1 = 2, kSplit = 3 };
+enum DeliveryKind { kKeys = 0, kUrnDelivery = 1 };
 
 struct Cfg {
   int protocol;
@@ -98,6 +104,7 @@ struct Cfg {
   uint64_t seed;
   int round_cap;
   int crash_window;
+  int delivery;
 };
 
 inline bool lying_adversary(const Cfg& c) {
@@ -110,6 +117,7 @@ inline bool lying_adversary(const Cfg& c) {
 struct Scratch {
   std::vector<uint8_t> est, decided, decided_val, prop, m, d, w_tmp;
   std::vector<uint8_t> honest, values, silent;           // per-sender (n)
+  std::vector<uint8_t> vclass0, vclass1;                 // per-class values (§4b)
   std::vector<uint8_t> vmat;                             // per-(recv,send) (n*n)
   std::vector<uint8_t> bias;                             // per-(recv,send) (n*n)
   std::vector<uint8_t> faulty;
@@ -120,10 +128,12 @@ struct Scratch {
   std::vector<uint8_t> coin;
   bool values_per_recv = false;  // vmat active (plain-Ben-Or Byzantine, spec §6.3)
   bool bias_per_recv = false;    // bias matrix active (adaptive, spec §6.4)
+  bool two_faced = false;        // vclass0/1 active (urn Byzantine, spec §4b)
 
   explicit Scratch(int n)
       : est(n), decided(n), decided_val(n), prop(n), m(n), d(n), w_tmp(n),
-        honest(n), values(n), silent(n), vmat(size_t(n) * n), bias(size_t(n) * n),
+        honest(n), values(n), silent(n), vclass0(n), vclass1(n),
+        vmat(size_t(n) * n), bias(size_t(n) * n),
         faulty(n), crash_round(n), combined(n), keys(n), c0(n), c1(n),
         decide_now(n), adopt(n), coin(n) {}
 };
@@ -180,6 +190,7 @@ void inject(const Cfg& cfg, Key k, uint32_t inst, uint32_t rnd, uint32_t t,
   const int n = cfg.n;
   s.values_per_recv = false;
   s.bias_per_recv = false;
+  s.two_faced = false;
   std::fill(s.silent.begin(), s.silent.end(), uint8_t(0));
   std::memcpy(s.values.data(), s.honest.data(), size_t(n));
 
@@ -201,6 +212,21 @@ void inject(const Cfg& cfg, Key k, uint32_t inst, uint32_t rnd, uint32_t t,
           if (b == 1) s.values[j] = 0;
           else if (b == 2) s.values[j] = 1;
           // b == 0 or 3: honest value retained.
+        }
+      } else if (cfg.delivery == kUrnDelivery) {
+        // §4b two-faced equivocation: one value per receiver class.
+        s.two_faced = true;
+        for (int h = 0; h < 2; ++h) {
+          uint8_t* vc = h ? s.vclass1.data() : s.vclass0.data();
+          for (int j = 0; j < n; ++j) {
+            if (s.faulty[j]) {
+              const uint32_t e = prf_u32(k, inst, rnd, t, uint32_t(h),
+                                         uint32_t(j), kByzValue);
+              vc[j] = uint8_t(e % 3u);
+            } else {
+              vc[j] = s.honest[j];
+            }
+          }
         }
       } else {
         // Plain Ben-Or pairing: per-receiver equivocation matrix (spec §6.3).
@@ -230,6 +256,7 @@ void inject(const Cfg& cfg, Key k, uint32_t inst, uint32_t rnd, uint32_t t,
       const uint8_t minority = (h1 <= h0) ? 1 : 0;
       for (int j = 0; j < n; ++j)
         if (s.faulty[j]) s.values[j] = minority;
+      if (cfg.delivery == kUrnDelivery) return;  // strata derived in-urn (§4b)
       s.bias_per_recv = true;
       for (int v = 0; v < n; ++v) {
         const uint8_t pref = (v >= (n + 1) / 2) ? 1 : 0;
@@ -303,6 +330,47 @@ void deliver_and_tally(const Cfg& cfg, Key k, uint32_t inst, uint32_t rnd,
   }
 }
 
+// ------------------------------------- urn delivery + tallies (spec §4b)
+
+// Count-level scheduling: the D = L-(n-f-1) dropped messages are drawn from a
+// per-receiver urn of (stratum, value)-classed live messages, biased stratum
+// first. Mirrors ops/urn.py draw-for-draw (the spec's D-iteration form).
+void urn_deliver_and_tally(const Cfg& cfg, Key k, uint32_t inst, uint32_t rnd,
+                           uint32_t t, Scratch& s) {
+  const int n = cfg.n, f = cfg.f;
+  const int half = (n + 1) / 2;
+  const int quota = n - f - 1;
+  const bool adaptive = cfg.adversary == kAdaptive;
+  for (int v = 0; v < n; ++v) {
+    const int h = (v >= half) ? 1 : 0;
+    const uint8_t* vals =
+        s.two_faced ? (h ? s.vclass1.data() : s.vclass0.data()) : s.values.data();
+    int rem[3] = {0, 0, 0};
+    for (int j = 0; j < n; ++j)
+      if (j != v && !s.silent[j]) ++rem[vals[j]];
+    const int total = rem[0] + rem[1] + rem[2];
+    const int drops = std::max(0, total - quota);
+    const bool st[3] = {adaptive && h != 0, adaptive && h != 1, adaptive};
+    uint32_t state = prf_u32(k, inst, rnd, t, uint32_t(v), 0, kUrn);
+    for (int dr = 0; dr < drops; ++dr) {
+      state = state * kUrnLcgA + kUrnLcgC;
+      const uint32_t u = state ^ (state >> 16);
+      const int b_rem = (st[0] ? rem[0] : 0) + (st[1] ? rem[1] : 0) +
+                        (st[2] ? rem[2] : 0);
+      const bool in_biased = b_rem > 0;
+      const int r_cur = in_biased ? b_rem : (rem[0] + rem[1] + rem[2]) - b_rem;
+      const uint32_t d = ((u >> 10) * uint32_t(r_cur)) >> 22;
+      const uint32_t e0 = (st[0] == in_biased) ? uint32_t(rem[0]) : 0u;
+      const uint32_t e1 = (st[1] == in_biased) ? uint32_t(rem[1]) : 0u;
+      const int w = (d < e0) ? 0 : ((d < e0 + e1) ? 1 : 2);
+      --rem[w];
+    }
+    const uint8_t own = vals[v];
+    s.c0[v] = rem[0] + (own == 0 ? 1 : 0);
+    s.c1[v] = rem[1] + (own == 1 ? 1 : 0);
+  }
+}
+
 // ----------------------------------------------- protocol round (spec §5)
 
 // One full round for one instance; updates Scratch state in place.
@@ -329,7 +397,10 @@ void run_round(const Cfg& cfg, Key k, uint32_t inst, uint32_t rnd, Scratch& s) {
         else if (s.values[j] == 1) ++g1;
       }
     }
-    deliver_and_tally(cfg, k, inst, rnd, uint32_t(t), s);
+    if (cfg.delivery == kUrnDelivery)
+      urn_deliver_and_tally(cfg, k, inst, rnd, uint32_t(t), s);
+    else
+      deliver_and_tally(cfg, k, inst, rnd, uint32_t(t), s);
 
     // Per-replica state-machine step (mirrors core/replica.py::on_deliver).
     for (int v = 0; v < n; ++v) {
@@ -423,11 +494,11 @@ extern "C" {
 // as SimulatorBackend.run) across `n_threads` OS threads. Outputs are
 // rounds_out (int32) and decision_out (uint8), both length `count`.
 void sim_run(int protocol, int n, int f, int adversary, int coin, int init,
-             uint64_t seed, int round_cap, int crash_window,
+             uint64_t seed, int round_cap, int crash_window, int delivery,
              const int64_t* ids, int64_t count, int n_threads,
              int32_t* rounds_out, uint8_t* decision_out) {
   const Cfg cfg{protocol, n,    f,         adversary,   coin,
-                init,     seed, round_cap, crash_window};
+                init,     seed, round_cap, crash_window, delivery};
   const Key k{uint32_t(seed & 0xFFFFFFFFu), uint32_t((seed >> 32) & 0xFFFFFFFFu)};
 
   if (n_threads < 1) n_threads = 1;
@@ -456,6 +527,6 @@ void sim_run(int protocol, int n, int f, int adversary, int coin, int init,
 }
 
 // ABI version stamp so the Python loader can detect stale cached builds.
-int sim_abi_version() { return 1; }
+int sim_abi_version() { return 2; }
 
 }  // extern "C"

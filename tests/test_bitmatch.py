@@ -45,8 +45,10 @@ def test_small_exhaustive(cfg):
 def test_benchmark_configs_sampled(name, n_sample):
     """Sampled bit-match at benchmark scale: instance i depends only on (cfg, seed, i),
     so the oracle simulates a pseudo-random subset and must match the batched run."""
+    import zlib
+
     cfg = preset(name, round_cap=64)
-    rng = np.random.default_rng(hash(name) % 2**32)
+    rng = np.random.default_rng(zlib.crc32(name.encode()))
     ids = np.unique(rng.integers(0, cfg.instances, size=n_sample))
     ref = Simulator(cfg, "cpu").run(ids)
     for backend in ("numpy", "jax"):

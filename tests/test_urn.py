@@ -1,0 +1,121 @@
+"""Urn delivery (spec §4b): bit-match across backends, protocol properties, and
+statistical agreement with the keys model.
+
+The urn model is a *different exact sampler of the same delivery distribution
+family* (spec §4b): bit-matching is within delivery="urn", and the cross-model
+check is statistical (same mean rounds / decision frequencies, not same bits).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from byzantinerandomizedconsensus_tpu import SimConfig, Simulator, preset
+
+URN_SMALL = [
+    SimConfig(protocol="benor", n=4, f=1, instances=60, adversary="none", coin="local",
+              round_cap=64, seed=0, delivery="urn"),
+    SimConfig(protocol="benor", n=9, f=4, instances=40, adversary="crash", coin="local",
+              round_cap=96, seed=1, delivery="urn"),
+    SimConfig(protocol="benor", n=16, f=3, instances=40, adversary="byzantine",
+              coin="local", round_cap=64, seed=2, delivery="urn"),
+    SimConfig(protocol="benor", n=11, f=2, instances=40, adversary="adaptive",
+              coin="shared", round_cap=64, seed=3, delivery="urn"),
+    SimConfig(protocol="bracha", n=10, f=3, instances=40, adversary="byzantine",
+              coin="shared", round_cap=64, seed=4, delivery="urn"),
+    SimConfig(protocol="bracha", n=16, f=5, instances=40, adversary="adaptive",
+              coin="shared", round_cap=64, seed=5, delivery="urn"),
+    SimConfig(protocol="bracha", n=13, f=4, instances=40, adversary="crash",
+              coin="local", round_cap=64, seed=6, delivery="urn"),
+    SimConfig(protocol="bracha", n=7, f=2, instances=40, adversary="none",
+              coin="shared", round_cap=64, seed=7, delivery="urn"),
+]
+
+
+@pytest.mark.parametrize(
+    "cfg", URN_SMALL,
+    ids=lambda c: f"{c.protocol}-n{c.n}f{c.f}-{c.adversary}-{c.coin}")
+def test_urn_bitmatch_small(cfg):
+    ref = Simulator(cfg, "cpu").run()
+    for backend in ("numpy", "jax", "native"):
+        got = Simulator(cfg, backend).run()
+        np.testing.assert_array_equal(ref.rounds, got.rounds, err_msg=f"rounds {backend}")
+        np.testing.assert_array_equal(ref.decision, got.decision,
+                                      err_msg=f"decision {backend}")
+
+
+@pytest.mark.parametrize("name,n_sample", [("config2", 4), ("config3", 3), ("config4", 2)])
+def test_urn_bitmatch_benchmark_sampled(name, n_sample):
+    import zlib
+
+    cfg = preset(name, round_cap=64, delivery="urn")
+    rng = np.random.default_rng(zlib.crc32(name.encode()))
+    ids = np.unique(rng.integers(0, cfg.instances, size=n_sample))
+    ref = Simulator(cfg, "cpu").run(ids)
+    for backend in ("numpy", "jax"):
+        got = Simulator(cfg, backend).run(ids)
+        np.testing.assert_array_equal(ref.rounds, got.rounds, err_msg=f"rounds {backend}")
+        np.testing.assert_array_equal(ref.decision, got.decision,
+                                      err_msg=f"decision {backend}")
+
+
+@pytest.mark.parametrize("cfg", URN_SMALL[:6],
+                         ids=lambda c: f"{c.protocol}-{c.adversary}")
+def test_urn_agreement_and_validity(cfg):
+    """Agreement: every decided instance decides a single value; validity via
+    unanimous starts (decision == the common initial value)."""
+    res = Simulator(cfg, "numpy").run()
+    assert set(np.unique(res.decision)) <= {0, 1, 2}
+    for init, expect in (("all0", 0), ("all1", 1)):
+        c = dataclasses.replace(cfg, init=init, instances=30)
+        r = Simulator(c, "numpy").run()
+        decided = r.decision != 2
+        assert np.all(r.decision[decided] == expect), f"validity broken for {init}"
+
+
+def test_urn_matches_keys_statistically():
+    """Same delivery distribution family ⇒ close round/decision statistics."""
+    base = SimConfig(protocol="bracha", n=16, f=5, instances=4000,
+                     adversary="none", coin="shared", round_cap=64, seed=11)
+    keys = Simulator(base, "numpy").run()
+    urn = Simulator(dataclasses.replace(base, delivery="urn"), "numpy").run()
+    assert abs(float(keys.rounds.mean()) - float(urn.rounds.mean())) < 0.1
+    assert abs(float((keys.decision == 1).mean())
+               - float((urn.decision == 1).mean())) < 0.05
+
+
+@pytest.mark.parametrize("n_data,n_model", [(8, 1), (4, 2), (2, 4)])
+def test_urn_sharded_bitmatch(n_data, n_model):
+    """Urn delivery under shard_map (instance + replica sharding) bit-matches
+    the single-device jax backend on every mesh shape."""
+    from byzantinerandomizedconsensus_tpu.parallel.mesh import make_mesh
+    from byzantinerandomizedconsensus_tpu.parallel.sharded import JaxShardedBackend
+
+    cfg = SimConfig(protocol="bracha", n=16, f=5, instances=48,
+                    adversary="adaptive", coin="shared", round_cap=64, seed=21,
+                    delivery="urn")
+    ref = Simulator(cfg, "jax").run()
+    got = JaxShardedBackend(mesh=make_mesh(n_data=n_data, n_model=n_model)).run(cfg)
+    np.testing.assert_array_equal(ref.rounds, got.rounds)
+    np.testing.assert_array_equal(ref.decision, got.decision)
+
+
+def test_urn_counts_conservation():
+    """Spec §4b: c0+c1+c2 = min(L, n-f-1)+1; with no faults and no bot values
+    the delivered total is exactly n-f for every receiver."""
+    from byzantinerandomizedconsensus_tpu.ops import urn
+
+    cfg = SimConfig(protocol="bracha", n=32, f=10, instances=8, adversary="none",
+                    coin="shared", delivery="urn")
+    B, n = 5, cfg.n
+    inst = np.arange(B, dtype=np.uint32)
+    values = (np.arange(n, dtype=np.uint8) % 2)[None, :].repeat(B, 0)
+    silent = np.zeros((B, n), dtype=bool)
+    faulty = np.zeros((B, n), dtype=bool)
+    c0, c1 = urn.counts_fn(cfg, cfg.seed, inst, 0, 0, values, silent, faulty,
+                           values, xp=np)
+    np.testing.assert_array_equal(c0 + c1, np.full((B, n), n - cfg.f))
+    # and the counts can't exceed what exists on the wire
+    assert (c0 <= (values == 0).sum(-1)[:, None] + 1).all()
+    assert (c1 <= (values == 1).sum(-1)[:, None] + 1).all()
