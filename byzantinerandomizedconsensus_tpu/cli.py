@@ -109,6 +109,7 @@ def cmd_sweep(args) -> int:
         ns=tuple(int(x) for x in args.ns) if args.ns else sweep.SWEEP_NS,
         instances=args.instances, seed=args.seed,
         shard_instances=args.shard_instances, coin=args.coin,
+        delivery=args.delivery,
         progress=lambda msg: print(msg, file=sys.stderr),
     )
     print(json.dumps(out))
@@ -144,6 +145,7 @@ def main(argv=None) -> int:
     p_sw.add_argument("--shard-instances", type=int, default=500)
     p_sw.add_argument("--seed", type=int, default=0)
     p_sw.add_argument("--coin", choices=["local", "shared"], default="shared")
+    p_sw.add_argument("--delivery", choices=["keys", "urn"], default="keys")
     p_sw.add_argument("--plot", default=None, metavar="FILE",
                       help="render the round-distribution figure (png/svg)")
     p_sw.set_defaults(fn=cmd_sweep)

@@ -18,8 +18,11 @@ from byzantinerandomizedconsensus_tpu.config import SimConfig
 
 
 def shard_name(cfg: SimConfig, lo: int, hi: int) -> str:
+    # delivery joined the config surface after the original naming scheme; keys
+    # keeps the legacy name so existing sweep checkpoints stay resumable.
+    deliv = "" if cfg.delivery == "keys" else f"_{cfg.delivery}"
     return (f"{cfg.protocol}_n{cfg.n}_f{cfg.f}_{cfg.adversary}_{cfg.coin}"
-            f"_s{cfg.seed}_i{lo}-{hi}.npz")
+            f"{deliv}_s{cfg.seed}_i{lo}-{hi}.npz")
 
 
 def save_shard(out_dir: pathlib.Path, cfg: SimConfig, res: SimResult) -> pathlib.Path:

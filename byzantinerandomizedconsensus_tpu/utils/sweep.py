@@ -23,6 +23,7 @@ def run_sweep(
     seed: int = 0,
     shard_instances: int = 500,
     coin: str = "shared",
+    delivery: str = "keys",
     progress=print,
 ) -> dict:
     """Run (or resume) the sweep; returns {n: summary-with-round-histogram}."""
@@ -30,10 +31,10 @@ def run_sweep(
     out = {}
     for n in ns:
         cfg = sweep_point(n, seed=seed, instances=instances)
-        if coin != cfg.coin:
+        if coin != cfg.coin or delivery != cfg.delivery:
             import dataclasses
 
-            cfg = dataclasses.replace(cfg, coin=coin).validate()
+            cfg = dataclasses.replace(cfg, coin=coin, delivery=delivery).validate()
         shards = []
         for lo in range(0, instances, shard_instances):
             hi = min(lo + shard_instances, instances)

@@ -81,3 +81,36 @@ def test_shared_coin_expected_constant_rounds():
         means[n] = float(Simulator(cfg, "numpy").run().rounds.mean())
     assert means[16] < 6 and means[64] < 6
     assert abs(means[64] - means[16]) < 2.0
+
+
+def test_urn_counts_match_exact_hypergeometric():
+    """The §4b urn sampler's delivered-ones count for a receiver must follow the
+    exact multivariate-hypergeometric law: drop D=f of the L=n-1 live others
+    uniformly, so c1_others ~ Hypergeom(L, m1, L-D). Chi-square against the
+    closed-form pmf over lanes whose own value is 0 (their m1 is common)."""
+    import math
+
+    from byzantinerandomizedconsensus_tpu.ops import urn
+
+    cfg = SimConfig(protocol="bracha", n=12, f=3, instances=1, adversary="none",
+                    coin="shared", delivery="urn").validate()
+    n, f = cfg.n, cfg.f
+    B = 6000
+    inst = np.arange(B, dtype=np.uint32)
+    values = (np.arange(n, dtype=np.uint8) % 2)[None, :].repeat(B, 0)  # 6 ones
+    silent = np.zeros((B, n), dtype=bool)
+    faulty = np.zeros((B, n), dtype=bool)
+    c0, c1 = urn.counts_fn(cfg, cfg.seed, inst, 0, 0, values, silent, faulty,
+                           values, xp=np)
+    own0 = values == 0                       # lanes whose own value is 0
+    sample = c1[own0].ravel()                # c1 = delivered ones among others
+    L, m1, k = n - 1, int(values[0].sum()), n - 1 - f
+    lo_s, hi_s = max(0, k - (L - m1)), min(m1, k)
+    pmf = np.array([math.comb(m1, j) * math.comb(L - m1, k - j) / math.comb(L, k)
+                    for j in range(lo_s, hi_s + 1)])
+    obs = np.array([(sample == j).sum() for j in range(lo_s, hi_s + 1)])
+    assert obs.sum() == sample.size, "counts outside the hypergeometric support"
+    exp = pmf * sample.size
+    chi2 = float((((obs - exp) ** 2) / exp).sum())
+    # dof = support-1 = 3; p=0.001 critical value 16.27
+    assert chi2 < 16.27, f"chi2={chi2:.2f} vs exact hypergeometric pmf"
