@@ -95,11 +95,14 @@ class JaxBackend(JitChunkedBackend):
     def _make_fn(self, cfg: SimConfig):
         counts_fn = None
         if cfg.delivery == "urn":
-            # The round bodies route to ops/urn.py themselves; the keys-model
-            # kernels below do not apply. kernel='pallas' currently falls back
-            # to the XLA urn path (the unrolled fori_loop already keeps the
-            # urn carry in registers — see ops/urn.py).
-            return jax.jit(partial(_run_chunk, cfg, counts_fn=None))
+            # counts_fn=None routes the round bodies to ops/urn.py (XLA);
+            # kernel='pallas' swaps in the VMEM-resident urn kernel.
+            if self.kernel == "pallas":
+                from byzantinerandomizedconsensus_tpu.ops import pallas_urn
+
+                interpret = jax.default_backend() != "tpu"
+                counts_fn = partial(pallas_urn.counts_fn, interpret=interpret)
+            return jax.jit(partial(_run_chunk, cfg, counts_fn=counts_fn))
         if self.kernel == "pallas":
             from byzantinerandomizedconsensus_tpu.ops import pallas_tally
 

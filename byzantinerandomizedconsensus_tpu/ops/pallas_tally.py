@@ -153,6 +153,25 @@ def _step_kernel(params_ref, ids_ref, values_ref, silent_ref, faulty_ref,
     del adv_bracha_byz  # silence handled upstream; key layout identical
 
 
+def align_vma(args):
+    """shard_map vma alignment, shared by the Pallas kernel adapters.
+
+    Under shard_map's vma checking the outputs vary over every mesh axis any
+    input varies over, and every input must carry the same vma for the
+    interpreter's internal slices. Returns (aligned_args, vma_set).
+    """
+    vma = frozenset()
+    for x in args:
+        vma |= getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
+
+    def _align(x):
+        need = tuple(a for a in vma
+                     if a not in (getattr(jax.typeof(x), "vma", frozenset()) or ()))
+        return jax.lax.pcast(x, need, to="varying") if need else x
+
+    return [_align(x) for x in args], vma
+
+
 def _pad_axis(x, axis: int, size: int, fill):
     """Pad ``x`` along ``axis`` (0 = instances, -1 = senders) up to ``size``."""
     have = x.shape[axis]
@@ -222,20 +241,8 @@ def step_counts(cfg, inst_ids, rnd, step, values, silent, faulty,
     params = jnp.stack([jnp.asarray(rnd, dtype=jnp.int32).reshape(()),
                         jnp.asarray(recv_offset, dtype=jnp.int32).reshape(())])
 
-    # Under shard_map's vma checking the outputs vary over every mesh axis any
-    # input varies over (counts are per (instance-shard, receiver-shard)), and
-    # every input must carry the same vma for the interpreter's internal slices.
-    _vma = frozenset()
-    for x in (params, inst_ids, values, silent, faulty):
-        _vma |= getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
-
-    def _align(x):
-        need = tuple(a for a in _vma
-                     if a not in (getattr(jax.typeof(x), "vma", frozenset()) or ()))
-        return jax.lax.pcast(x, need, to="varying") if need else x
-
-    params, inst_ids, values, silent, faulty = map(
-        _align, (params, inst_ids, values, silent, faulty))
+    (params, inst_ids, values, silent, faulty), _vma = align_vma(
+        (params, inst_ids, values, silent, faulty))
 
     kernel = functools.partial(
         _step_kernel, seed=cfg.seed, step=step, n=n,

@@ -168,13 +168,11 @@ class JaxShardedBackend(JitChunkedBackend):
 
     def _make_fn(self, cfg: SimConfig):
         counts_fn = None
-        if self.kernel == "pallas" and cfg.delivery != "urn":
-            # Urn delivery routes through the round bodies' ops/urn.py path
-            # (already mesh-compatible: lanes are local receiver shards); the
-            # keys-model pallas kernel must not shadow it.
-            from byzantinerandomizedconsensus_tpu.ops import pallas_tally
+        if self.kernel == "pallas":
+            from byzantinerandomizedconsensus_tpu.ops import pallas_tally, pallas_urn
 
             interpret = jax.default_backend() != "tpu"
-            counts_fn = partial(pallas_tally.counts_fn, interpret=interpret)
+            mod = pallas_urn if cfg.delivery == "urn" else pallas_tally
+            counts_fn = partial(mod.counts_fn, interpret=interpret)
         return jax.jit(partial(_run_chunk_sharded, cfg, self.mesh,
                                counts_fn=counts_fn))

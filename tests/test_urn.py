@@ -38,7 +38,7 @@ URN_SMALL = [
     ids=lambda c: f"{c.protocol}-n{c.n}f{c.f}-{c.adversary}-{c.coin}")
 def test_urn_bitmatch_small(cfg):
     ref = Simulator(cfg, "cpu").run()
-    for backend in ("numpy", "jax", "native"):
+    for backend in ("numpy", "jax", "native", "jax_pallas"):
         got = Simulator(cfg, backend).run()
         np.testing.assert_array_equal(ref.rounds, got.rounds, err_msg=f"rounds {backend}")
         np.testing.assert_array_equal(ref.decision, got.decision,
@@ -85,10 +85,12 @@ def test_urn_matches_keys_statistically():
                - float((urn.decision == 1).mean())) < 0.05
 
 
+@pytest.mark.parametrize("kernel", ["xla", "pallas"])
 @pytest.mark.parametrize("n_data,n_model", [(8, 1), (4, 2), (2, 4)])
-def test_urn_sharded_bitmatch(n_data, n_model):
+def test_urn_sharded_bitmatch(n_data, n_model, kernel):
     """Urn delivery under shard_map (instance + replica sharding) bit-matches
-    the single-device jax backend on every mesh shape."""
+    the single-device jax backend on every mesh shape, with both the XLA urn
+    and the Pallas urn kernel (which exercises its receiver-shard path)."""
     from byzantinerandomizedconsensus_tpu.parallel.mesh import make_mesh
     from byzantinerandomizedconsensus_tpu.parallel.sharded import JaxShardedBackend
 
@@ -96,7 +98,8 @@ def test_urn_sharded_bitmatch(n_data, n_model):
                     adversary="adaptive", coin="shared", round_cap=64, seed=21,
                     delivery="urn")
     ref = Simulator(cfg, "jax").run()
-    got = JaxShardedBackend(mesh=make_mesh(n_data=n_data, n_model=n_model)).run(cfg)
+    got = JaxShardedBackend(mesh=make_mesh(n_data=n_data, n_model=n_model),
+                            kernel=kernel).run(cfg)
     np.testing.assert_array_equal(ref.rounds, got.rounds)
     np.testing.assert_array_equal(ref.decision, got.decision)
 
