@@ -22,6 +22,16 @@ GOLDEN_CONFIGS = {
                             adversary="byzantine", coin="shared", round_cap=64, seed=1),
     "bracha_adaptive": SimConfig(protocol="bracha", n=13, f=4, instances=100,
                                  adversary="adaptive", coin="shared", round_cap=64, seed=3),
+    # Urn delivery (spec §4b) — one per adversary family, incl. two-faced byz.
+    "urn_benor_byz": SimConfig(protocol="benor", n=16, f=3, instances=100,
+                               adversary="byzantine", coin="local", round_cap=64,
+                               seed=4, delivery="urn"),
+    "urn_bracha_crash": SimConfig(protocol="bracha", n=10, f=3, instances=100,
+                                  adversary="crash", coin="shared", round_cap=64,
+                                  seed=5, delivery="urn"),
+    "urn_bracha_adaptive": SimConfig(protocol="bracha", n=13, f=4, instances=100,
+                                     adversary="adaptive", coin="shared",
+                                     round_cap=64, seed=6, delivery="urn"),
 }
 
 PATH = pathlib.Path(__file__).parent / "golden.npz"
