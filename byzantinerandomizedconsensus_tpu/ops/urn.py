@@ -103,7 +103,10 @@ def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
     s0 = prf.prf_u32(seed, inst, rnd, t, recv[None, :], 0, prf.URN, xp=xp)
     s0 = xp.broadcast_to(s0, (B, recv.shape[0])).astype(u32)
 
+    adaptive = cfg.adversary == "adaptive"
+
     def step(j, carry):
+        """General (two-stratum) draw — spec §4b verbatim."""
         s, r0, r1, r2 = carry
         s = (s * u32(prf.URN_LCG_A) + u32(prf.URN_LCG_C)).astype(u32)
         u = s ^ (s >> u32(16))
@@ -125,19 +128,41 @@ def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
         r2 = (r2 - (pick2 & active).astype(i32)).astype(i32)
         return s, r0, r1, r2
 
-    carry = (s0, m[0], m[1], m[2])
+    def step_single(j, carry):
+        """Single-stratum specialisation (every non-adaptive adversary).
+
+        Algebraically identical draws to :func:`step` with st ≡ False: the urn
+        size is deterministic (L − j: one live message leaves per active draw),
+        so no remaining-count sum is needed, and the bot class r2 is never read
+        by the outputs, so it is not tracked. ~1.7x fewer ops per draw.
+        """
+        s, r0, r1 = carry
+        s = (s * u32(prf.URN_LCG_A) + u32(prf.URN_LCG_C)).astype(u32)
+        u = s ^ (s >> u32(16))
+        active = xp.asarray(j, dtype=i32) < D
+        R_cur = (L - xp.asarray(j, dtype=i32)).astype(u32)  # garbage if inactive
+        d = ((u >> u32(10)) * R_cur) >> u32(22)
+        e0 = r0.astype(u32)
+        pick0 = d < e0
+        pick1 = ~pick0 & (d < e0 + r1.astype(u32))
+        r0 = (r0 - (pick0 & active).astype(i32)).astype(i32)
+        r1 = (r1 - (pick1 & active).astype(i32)).astype(i32)
+        return s, r0, r1
+
+    fn, carry = ((step, (s0, m[0], m[1], m[2])) if adaptive
+                 else (step_single, (s0, m[0], m[1])))
     if f > 0:
         if xp is np:
             for j in range(f):
-                carry = step(j, carry)
+                carry = fn(j, carry)
         else:
             import jax
 
-            # Unrolling lets XLA keep the (s, r0, r1, r2) carry in registers
-            # across unrolled iterations instead of round-tripping ~64 B/lane
-            # through HBM every draw — measured ~3x on TPU at unroll=10.
-            carry = jax.lax.fori_loop(0, f, step, carry, unroll=min(10, f))
-    _, r0, r1, _ = carry
+            # Unrolling lets XLA keep the carry in registers across unrolled
+            # iterations instead of round-tripping ~64 B/lane through HBM
+            # every draw — measured ~3x on TPU at unroll=10.
+            carry = jax.lax.fori_loop(0, f, fn, carry, unroll=min(10, f))
+    _, r0, r1 = carry[:3]
     c0 = (r0 + (own_val == 0).astype(i32)).astype(i32)
     c1 = (r1 + (own_val == 1).astype(i32)).astype(i32)
     return c0, c1
