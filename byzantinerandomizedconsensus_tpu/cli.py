@@ -63,8 +63,18 @@ def cmd_run(args) -> int:
 
     cfg = _config_from(args)
     with profiling.trace(args.profile):
-        res = Simulator(cfg, args.backend).run()
+        if args.total_instances:
+            from byzantinerandomizedconsensus_tpu.utils import multiseed
+
+            res, shards = multiseed.run_large(
+                cfg, args.total_instances, backend=args.backend,
+                progress=lambda msg: print(msg, file=sys.stderr))
+        else:
+            res = Simulator(cfg, args.backend).run()
     out = metrics.summary(res)
+    if args.total_instances:
+        out["instances"] = args.total_instances
+        out["seeds"] = [s.seed for s in shards]
     out["backend"] = args.backend
     if args.hist:
         out["round_histogram"] = metrics.round_histogram(res).tolist()
@@ -128,6 +138,9 @@ def main(argv=None) -> int:
     p_run = sub.add_parser("run", help="run one config to termination")
     _add_config_args(p_run)
     p_run.add_argument("--hist", action="store_true", help="include the round histogram")
+    p_run.add_argument("--total-instances", type=int, default=None,
+                       help="run this many instances via multi-seed sharding "
+                            "(beyond the 2^17 per-seed limit — spec §2)")
     p_run.add_argument("--profile", default=None, metavar="DIR",
                        help="write a jax.profiler trace (TensorBoard/Perfetto) to DIR")
     p_run.set_defaults(fn=cmd_run)

@@ -122,3 +122,24 @@ def test_urn_counts_conservation():
     # and the counts can't exceed what exists on the wire
     assert (c0 <= (values == 0).sum(-1)[:, None] + 1).all()
     assert (c1 <= (values == 1).sum(-1)[:, None] + 1).all()
+
+
+def test_multiseed_run_large():
+    """run_large shards across derived seeds; each shard reproduces exactly the
+    standalone run of its derived config (spec §2 multi-seed contract)."""
+    from byzantinerandomizedconsensus_tpu.utils import multiseed
+
+    cfg = SimConfig(protocol="bracha", n=10, f=3, instances=1, adversary="byzantine",
+                    coin="shared", round_cap=64, seed=7, delivery="urn")
+    merged, shards = multiseed.run_large(cfg, total_instances=70, backend="numpy",
+                                         shard_instances=32)
+    assert len(shards) == 3 and [s.instances for s in shards] == [32, 32, 6]
+    assert len(merged.rounds) == 70
+    assert len(set(s.seed for s in shards)) == 3
+    # shard 1 standalone == its slice of the merged result
+    solo = Simulator(shards[1], "numpy").run()
+    np.testing.assert_array_equal(solo.rounds, merged.rounds[32:64])
+    np.testing.assert_array_equal(solo.decision, merged.decision[32:64])
+    # and the oracle bit-matches a sampled shard (the whole point of the design)
+    oracle = Simulator(shards[2], "cpu").run()
+    np.testing.assert_array_equal(oracle.rounds, merged.rounds[64:])
