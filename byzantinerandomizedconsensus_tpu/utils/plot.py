@@ -24,9 +24,8 @@ def plot_sweep(sweep_out: Mapping, path, log_y: bool = True, max_round=None) -> 
         s = sweep_out[n_key]
         hist = s["round_histogram"]
         hi = max_round or max(i for i, c in enumerate(hist) if c) + 1
-        xs = range(1, hi + 1)
-        ys = hist[1:hi + 1]
-        ax.plot(xs, ys, marker="o", markersize=3,
+        ys = hist[1:hi + 1]  # may stop short of hi when the cap bucket is last
+        ax.plot(range(1, 1 + len(ys)), ys, marker="o", markersize=3,
                 label=f"n={n_key} (f={s['f']})")
     if log_y:
         ax.set_yscale("symlog")
@@ -36,6 +35,39 @@ def plot_sweep(sweep_out: Mapping, path, log_y: bool = True, max_round=None) -> 
                  f"{s['coin']} coin")
     ax.legend(fontsize=8)
     ax.grid(True, alpha=0.3)
+    fig.tight_layout()
+    path = pathlib.Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fig.savefig(path, dpi=150)
+    plt.close(fig)
+
+
+def plot_coin_contrast(shared_out: Mapping, local_out: Mapping, path,
+                       max_round=None) -> None:
+    """Side-by-side round distributions: shared coin (expected O(1) rounds)
+    vs local coin (round-cap saturation at f = Θ(n) — SURVEY.md §3.4, the
+    reason config 4's shared-coin variant exists)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, axes = plt.subplots(1, 2, figsize=(12, 5), sharey=True)
+    for ax, out, title in ((axes[0], shared_out, "shared coin"),
+                           (axes[1], local_out, "local coin")):
+        for n_key in sorted(out, key=int):
+            s = out[n_key]
+            hist = s["round_histogram"]
+            hi = max_round or max(i for i, c in enumerate(hist) if c) + 1
+            ys = hist[1:hi + 1]
+            ax.plot(range(1, 1 + len(ys)), ys, marker="o", markersize=3,
+                    label=f"n={n_key}")
+        ax.set_yscale("symlog")
+        ax.set_xlabel("rounds to decision")
+        ax.set_title(f"{s['protocol']}, {s['adversary']} — {title}")
+        ax.legend(fontsize=8)
+        ax.grid(True, alpha=0.3)
+    axes[0].set_ylabel("instances")
     fig.tight_layout()
     path = pathlib.Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)

@@ -74,15 +74,23 @@ def test_urn_agreement_and_validity(cfg):
         assert np.all(r.decision[decided] == expect), f"validity broken for {init}"
 
 
-def test_urn_matches_keys_statistically():
-    """Same delivery distribution family ⇒ close round/decision statistics."""
-    base = SimConfig(protocol="bracha", n=16, f=5, instances=4000,
-                     adversary="none", coin="shared", round_cap=64, seed=11)
+@pytest.mark.parametrize("adversary,coin,tol", [("none", "shared", 0.1),
+                                                ("adaptive", "local", 1.5)])
+def test_urn_matches_keys_statistically(adversary, coin, tol):
+    """Same delivery distribution family ⇒ close round/decision statistics.
+
+    The adaptive+local case is the sensitive one: the stratum-priority drops
+    must match the keys model's bias-bit ordering, or mean rounds diverge
+    wildly (observed: a priority inversion turns ~10 mean rounds into cap
+    saturation)."""
+    inst = 4000 if adversary == "none" else 400
+    base = SimConfig(protocol="bracha", n=16, f=5, instances=inst,
+                     adversary=adversary, coin=coin, round_cap=64, seed=11)
     keys = Simulator(base, "numpy").run()
     urn = Simulator(dataclasses.replace(base, delivery="urn"), "numpy").run()
-    assert abs(float(keys.rounds.mean()) - float(urn.rounds.mean())) < 0.1
+    assert abs(float(keys.rounds.mean()) - float(urn.rounds.mean())) < tol
     assert abs(float((keys.decision == 1).mean())
-               - float((urn.decision == 1).mean())) < 0.05
+               - float((urn.decision == 1).mean())) < 0.08
 
 
 @pytest.mark.parametrize("kernel", ["xla", "pallas"])
