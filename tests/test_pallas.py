@@ -99,3 +99,39 @@ def test_kth_smallest_matches_sort():
         got = np.asarray(_kth_smallest(jnp.asarray(keys), k))[:, 0]
         want = np.sort(keys, axis=-1)[:, k - 1]
         np.testing.assert_array_equal(got, want)
+
+
+def test_smallest_k_mask_vs_sort_with_tie_classes():
+    """_smallest_k_mask == argsort top-k on crafted keys with dense top-22
+    collisions (the tie-resolution path that full-key thresholding never
+    stresses at random: P[top22 collision] = 2^-20 per pair)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    from byzantinerandomizedconsensus_tpu.ops.pallas_tally import _smallest_k_mask
+
+    def call(keys, k):
+        # pltpu.roll evaluates only inside a pallas context
+        def kern(x_ref, o_ref):
+            o_ref[...] = _smallest_k_mask(x_ref[...], k).astype(jnp.int32)
+
+        out = pl.pallas_call(
+            kern, out_shape=jax.ShapeDtypeStruct(keys.shape, jnp.int32),
+            interpret=True)(jnp.asarray(keys))
+        return np.asarray(out).astype(bool)
+
+    rng = np.random.default_rng(99)
+    S = 96
+    for trial in range(20):
+        # few distinct top22 values -> large tie classes; low 10 bits = index
+        top = rng.integers(0, 5, size=(4, S)).astype(np.uint32)
+        keys = (top << np.uint32(10)) | np.arange(S, dtype=np.uint32)[None, :]
+        k = int(rng.integers(1, S))
+        got = call(keys, k)
+        want = np.zeros_like(got)
+        order = np.argsort(keys, axis=-1)
+        np.put_along_axis(want, order[:, :k], True, axis=-1)
+        np.testing.assert_array_equal(got, want, err_msg=f"trial {trial} k={k}")

@@ -1,0 +1,32 @@
+"""Plot-helper smoke tests: the figures render and the histogram slicing is
+robust when the cap bucket is the last nonzero bin (regression for the
+off-by-one found while rendering the coin-contrast artifact)."""
+
+import pytest
+
+matplotlib = pytest.importorskip("matplotlib")
+
+from byzantinerandomizedconsensus_tpu.utils import plot
+
+
+def _summary(cap_saturated: bool):
+    hist = [0] * 17
+    if cap_saturated:
+        hist[-1] = 40  # every instance in the overflow bucket at the cap
+    else:
+        hist[1], hist[2] = 25, 15
+    return {"protocol": "bracha", "adversary": "adaptive", "coin": "shared",
+            "f": 5, "round_histogram": hist}
+
+
+def test_plot_sweep_cap_bucket_last(tmp_path):
+    out = {16: _summary(cap_saturated=True), 32: _summary(cap_saturated=False)}
+    plot.plot_sweep(out, tmp_path / "sweep.png")
+    assert (tmp_path / "sweep.png").stat().st_size > 0
+
+
+def test_plot_coin_contrast(tmp_path):
+    shared = {16: _summary(False)}
+    local = {16: _summary(True)}
+    plot.plot_coin_contrast(shared, local, tmp_path / "c.png")
+    assert (tmp_path / "c.png").stat().st_size > 0
