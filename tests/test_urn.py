@@ -112,6 +112,23 @@ def test_urn_sharded_bitmatch(n_data, n_model, kernel):
     np.testing.assert_array_equal(ref.decision, got.decision)
 
 
+@pytest.mark.parametrize("kernel", ["xla", "pallas"])
+def test_urn_sharded_two_faced_byzantine(kernel):
+    """Two-faced equivocation (spec §4b) under replica sharding: the per-class
+    value recomputation must line up with global receiver indices."""
+    from byzantinerandomizedconsensus_tpu.parallel.mesh import make_mesh
+    from byzantinerandomizedconsensus_tpu.parallel.sharded import JaxShardedBackend
+
+    cfg = SimConfig(protocol="benor", n=16, f=3, instances=40,
+                    adversary="byzantine", coin="local", round_cap=64, seed=31,
+                    delivery="urn")
+    ref = Simulator(cfg, "cpu").run()
+    got = JaxShardedBackend(mesh=make_mesh(n_data=2, n_model=4),
+                            kernel=kernel).run(cfg)
+    np.testing.assert_array_equal(ref.rounds, got.rounds)
+    np.testing.assert_array_equal(ref.decision, got.decision)
+
+
 def test_urn_counts_conservation():
     """Spec §4b: c0+c1+c2 = min(L, n-f-1)+1; with no faults and no bot values
     the delivered total is exactly n-f for every receiver."""
