@@ -79,3 +79,17 @@ def test_jax_chunking_invariance():
     b = JaxBackend(max_chunk=64).run(cfg)
     np.testing.assert_array_equal(a.rounds, b.rounds)
     np.testing.assert_array_equal(a.decision, b.decision)
+
+
+@pytest.mark.parametrize("delivery", ["keys", "urn"])
+@pytest.mark.parametrize("n,f", [(1, 0), (2, 0), (3, 1)])
+def test_degenerate_sizes(n, f, delivery):
+    """n=1..3 exercise empty-others urns, zero-drop quotas, and single-replica
+    instant decision across all four backends."""
+    cfg = SimConfig(protocol="benor", n=n, f=f, instances=20, adversary="none",
+                    coin="local", round_cap=32, seed=3, delivery=delivery)
+    ref = Simulator(cfg, "cpu").run()
+    for b in ("numpy", "jax", "native"):
+        got = Simulator(cfg, b).run()
+        np.testing.assert_array_equal(ref.rounds, got.rounds, err_msg=f"{b}")
+        np.testing.assert_array_equal(ref.decision, got.decision, err_msg=f"{b}")
