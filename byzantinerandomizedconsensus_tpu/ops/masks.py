@@ -76,35 +76,40 @@ def delivery_mask(cfg, seed, inst_ids, rnd, t, silent, bias, xp=np, recv_ids=Non
     return mask_from_keys(combined, cfg.n - cfg.f, silent, xp=xp, recv_ids=recv_ids)
 
 
-def _kth_bitwise(combined, k: int):
-    """jax-only: the k-th smallest key per receiver row without a sort — 32-step
-    MSB-first threshold construction (keys distinct by packing). Same recurrence
-    as ops/pallas_tally._kth_smallest, here over the full (B, R, n) tensor so it
-    can be A/B'd against the XLA sort on TPU without Pallas in the loop."""
+def _smallest_k_mask_xla(combined, k: int):
+    """jax-only: membership mask of the k smallest keys per receiver row
+    without a sort. Same (top22, sender-order tie class) decomposition as
+    ops/pallas_tally._smallest_k_mask — 22 count passes + one cumsum — here
+    over the full (B, R, n) tensor so it can be A/B'd against the XLA sort on
+    TPU without Pallas in the loop. Bit-identical to thresholding against the
+    exact k-th smallest key (keys distinct: low 10 bits are the sender)."""
     import jax
     import jax.numpy as jnp
 
-    flip = jnp.uint32(0x80000000)
-    signed = lambda x: jax.lax.bitcast_convert_type(x ^ flip, jnp.int32)
-    fk = signed(combined)
+    top22 = jax.lax.bitcast_convert_type(combined >> jnp.uint32(10), jnp.int32)
 
     def bit_step(i, acc):
-        b = 31 - i
-        cand = acc | jnp.uint32((1 << b) - 1)
-        cnt = jnp.sum((fk <= signed(cand)).astype(jnp.int32), axis=-1,
+        b = 21 - i
+        cand = acc | jnp.int32((1 << b) - 1)
+        cnt = jnp.sum((top22 <= cand).astype(jnp.int32), axis=-1,
                       keepdims=True)
-        return jnp.where(cnt >= k, acc, acc | jnp.uint32(1 << b))
+        return jnp.where(cnt >= k, acc, acc | jnp.int32(1 << b))
 
-    acc = jnp.zeros(combined.shape[:-1] + (1,), dtype=jnp.uint32)
-    return jax.lax.fori_loop(0, 32, bit_step, acc)
+    T = jax.lax.fori_loop(
+        0, 22, bit_step, jnp.zeros(combined.shape[:-1] + (1,), jnp.int32))
+    lt = top22 < T
+    tie = top22 == T
+    m = jnp.sum(lt.astype(jnp.int32), axis=-1, keepdims=True)
+    rank = jnp.cumsum(tie.astype(jnp.int32), axis=-1) - tie.astype(jnp.int32)
+    return lt | (tie & (rank < k - m))
 
 
 def counts_nosort(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
                   recv_ids=None):
     """Sort-free (c0, c1) for one step — the counts_fn hook's pure-XLA variant.
 
-    Same key tensor as the default path, but the n-f'th key comes from
-    :func:`_kth_bitwise` and the mask is consumed immediately by the tally, so
+    Same key tensor as the default path, but the top-k membership comes from
+    :func:`_smallest_k_mask_xla` and is consumed immediately by the tally, so
     XLA can fuse keygen -> threshold -> count without the sort. Bias bits are
     recomputed exactly as models/adversaries.py emits them (the hook does not
     carry the bias output).
@@ -128,7 +133,7 @@ def counts_nosort(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
         bias = jnp.zeros((B, 1, n), dtype=jnp.uint32)
     combined = combined_keys(cfg, seed, inst_ids, rnd, t, silent, bias, xp=jnp,
                              recv_ids=recv)
-    kth = _kth_bitwise(combined, n - cfg.f)
+    topk = _smallest_k_mask_xla(combined, n - cfg.f)
     own = (recv[:, None] == jnp.arange(n, dtype=jnp.uint32)[None, :])[None]
-    mask = ((combined <= kth) & ~jnp.asarray(silent, dtype=bool)[:, None, :]) | own
+    mask = (topk & ~jnp.asarray(silent, dtype=bool)[:, None, :]) | own
     return tally.tally01(mask, values, xp=jnp)
