@@ -25,6 +25,8 @@ class NumpyBackend(SimulatorBackend):
         self.chunk_bytes = chunk_bytes
 
     def _chunk_size(self, cfg: SimConfig) -> int:
+        if cfg.delivery == "urn":
+            return 1 << 14  # O(B·n) state only (spec §4b)
         per_inst = cfg.n * cfg.n * 4 * 4  # ~4 live (B,n,n) u32-sized transients
         return max(1, min(1 << 14, self.chunk_bytes // per_inst))
 
