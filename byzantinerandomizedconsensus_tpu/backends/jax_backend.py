@@ -79,10 +79,10 @@ class JaxBackend(JitChunkedBackend):
     def _chunk_size(self, cfg: SimConfig) -> int:
         if cfg.delivery == "urn":
             # No O(B·n²) transient at all — state is O(B·n). Measured optimum
-            # at n=512 on v5e is ~4k instances/chunk: beyond that the
+            # at n=512 on v5e is ~2k instances/chunk: beyond that the
             # while-loop straggler cost (whole chunk pays max rounds) outweighs
             # dispatch amortisation.
-            return max(1, min(self.max_chunk, (1 << 21) // max(1, cfg.n)))
+            return max(1, min(self.max_chunk, (1 << 20) // max(1, cfg.n)))
         if self.kernel == "pallas":
             # The fused kernel keeps the (B,n,n) key tensor VMEM-resident per
             # block — HBM holds only O(B·n) state, so the chunk is sized for

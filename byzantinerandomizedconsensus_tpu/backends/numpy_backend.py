@@ -26,7 +26,9 @@ class NumpyBackend(SimulatorBackend):
 
     def _chunk_size(self, cfg: SimConfig) -> int:
         if cfg.delivery == "urn":
-            return 1 << 14  # O(B·n) state only (spec §4b)
+            # O(B·n) state only (spec §4b): ~16 live int32 per-lane planes
+            # (class counts, picks, carry) — keep honoring the memory cap.
+            return max(1, min(1 << 14, self.chunk_bytes // (cfg.n * 64)))
         per_inst = cfg.n * cfg.n * 4 * 4  # ~4 live (B,n,n) u32-sized transients
         return max(1, min(1 << 14, self.chunk_bytes // per_inst))
 
