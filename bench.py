@@ -30,14 +30,13 @@ def main() -> int:
     from byzantinerandomizedconsensus_tpu.backends import get_backend
 
     instances = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
-    # BENCH_BACKEND selects the backend (jax | jax_pallas | jax_sharded[:p] ...)
-    # and BENCH_DELIVERY the scheduling model, for A/B runs. The headline
-    # default is the urn delivery model (spec §4b — count-level scheduling,
-    # O(n·f) per instance-step) on the plain jax backend; the keys model
-    # (O(n²) mask, spec §4) remains available via BENCH_DELIVERY=keys, where
-    # the fused Pallas kernel (jax_pallas) is the fast path on TPU.
+    # The headline is the preset as shipped: config4 pins delivery="urn"
+    # (spec §4b — count-level scheduling, O(n·f) per instance-step) on the
+    # plain jax backend. BENCH_BACKEND (jax | jax_pallas | jax_sharded[:p])
+    # and BENCH_DELIVERY=keys (spec §4 O(n²)-mask validation model, where
+    # the fused Pallas kernel is the TPU fast path) remain for A/B runs.
     backend = sys.argv[2] if len(sys.argv) > 2 else os.environ.get("BENCH_BACKEND", "")
-    delivery = os.environ.get("BENCH_DELIVERY", "urn")
+    delivery = os.environ.get("BENCH_DELIVERY", None)
     if not backend:
         import jax
 
@@ -45,7 +44,10 @@ def main() -> int:
             backend = "jax_pallas" if jax.default_backend() == "tpu" else "jax"
         else:
             backend = "jax"
-    cfg = preset("config4", instances=instances, delivery=delivery)
+    overrides = {"instances": instances}
+    if delivery is not None:
+        overrides["delivery"] = delivery
+    cfg = preset("config4", **overrides)
     sim = Simulator(cfg, backend)
 
     # Warm-up: compile the round kernel at the exact chunk shape the timed run uses

@@ -96,7 +96,13 @@ class JaxBackend(JitChunkedBackend):
         counts_fn = None
         if cfg.delivery == "urn":
             # counts_fn=None routes the round bodies to ops/urn.py (XLA);
-            # kernel='pallas' swaps in the VMEM-resident urn kernel.
+            # kernel='pallas' swaps in the VMEM-resident urn kernel. Other
+            # kernels are keys-only — fail loudly so an A/B invocation can't
+            # silently measure the default path (ADVICE r1).
+            if self.kernel == "xla_nosort":
+                raise ValueError(
+                    "kernel='xla_nosort' applies to delivery='keys' only; "
+                    "delivery='urn' supports kernel='xla' or 'pallas'")
             if self.kernel == "pallas":
                 from byzantinerandomizedconsensus_tpu.ops import pallas_urn
 

@@ -35,8 +35,9 @@ def _add_config_args(p: argparse.ArgumentParser, default_backend: str = "cpu") -
     p.add_argument("--round-cap", type=int, default=None)
     p.add_argument("--init", choices=["random", "all0", "all1", "split"], default=None)
     p.add_argument("--delivery", choices=["keys", "urn"], default=None,
-                   help="scheduling model: keys (spec §4, O(n²) mask) | urn "
-                        "(spec §4b, count-level — the TPU fast path)")
+                   help="scheduling model: urn (spec §4b, count-level — the product "
+                        "path, pinned by all presets) | keys (spec §4, O(n²) mask — "
+                        "the validation model)")
     p.add_argument("--backend", default=default_backend,
                    help="cpu (oracle) | numpy | native[:threads] | jax | jax_cpu "
                         "| jax_sharded[:n_model]")
@@ -73,7 +74,9 @@ def cmd_run(args) -> int:
             res = Simulator(cfg, args.backend).run()
     out = metrics.summary(res)
     if args.total_instances:
-        out["instances"] = args.total_instances
+        # summary already reports the base seed and the grand total (the merged
+        # result carries the user's config); the derived per-shard seeds are
+        # what's needed to reproduce any shard standalone.
         out["seeds"] = [s.seed for s in shards]
     out["backend"] = args.backend
     if args.hist:
@@ -158,7 +161,7 @@ def main(argv=None) -> int:
     p_sw.add_argument("--shard-instances", type=int, default=500)
     p_sw.add_argument("--seed", type=int, default=0)
     p_sw.add_argument("--coin", choices=["local", "shared"], default="shared")
-    p_sw.add_argument("--delivery", choices=["keys", "urn"], default="keys")
+    p_sw.add_argument("--delivery", choices=["keys", "urn"], default="urn")
     p_sw.add_argument("--plot", default=None, metavar="FILE",
                       help="render the round-distribution figure (png/svg)")
     p_sw.set_defaults(fn=cmd_sweep)

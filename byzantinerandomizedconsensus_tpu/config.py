@@ -26,6 +26,11 @@ class SimConfig:
     round_cap: int = 256
     crash_window: int = 4
     init: InitKind = "random"
+    # Scheduling model. "urn" (spec §4b, count-level, O(n·f)) is the product
+    # semantics — all benchmark presets pin it. "keys" (spec §4, the O(n²)
+    # permutation-key mask) is the validation model: an independent exact
+    # sampler of the same delivery-distribution family, kept as the
+    # SimConfig default for ad-hoc spec-§4 work and cross-model checks.
     delivery: DeliveryKind = "keys"
 
     @property
@@ -70,11 +75,13 @@ def _f_opt(n: int) -> int:
 
 
 # Benchmark presets (BASELINE.json:6-12; pinned in spec/PROTOCOL.md §7).
+# All presets pin delivery="urn" — the product scheduling model; pass
+# delivery="keys" explicitly to run the spec-§4 validation model instead.
 PRESETS: dict[str, SimConfig] = {
-    "config1": SimConfig(protocol="benor", n=4, f=1, instances=1, adversary="none", coin="local"),
-    "config2": SimConfig(protocol="benor", n=64, f=21, instances=10_000, adversary="crash", coin="local"),
-    "config3": SimConfig(protocol="bracha", n=256, f=85, instances=1_000, adversary="byzantine", coin="shared"),
-    "config4": SimConfig(protocol="bracha", n=512, f=170, instances=100_000, adversary="none", coin="shared"),
+    "config1": SimConfig(protocol="benor", n=4, f=1, instances=1, adversary="none", coin="local", delivery="urn"),
+    "config2": SimConfig(protocol="benor", n=64, f=21, instances=10_000, adversary="crash", coin="local", delivery="urn"),
+    "config3": SimConfig(protocol="bracha", n=256, f=85, instances=1_000, adversary="byzantine", coin="shared", delivery="urn"),
+    "config4": SimConfig(protocol="bracha", n=512, f=170, instances=100_000, adversary="none", coin="shared", delivery="urn"),
 }
 
 # Config 5 is a sweep (spec §7): bracha, adaptive adversary, shared coin.
@@ -85,7 +92,7 @@ SWEEP_INSTANCES = 2_000
 def sweep_point(n: int, seed: int = 0, instances: int = SWEEP_INSTANCES) -> SimConfig:
     return SimConfig(
         protocol="bracha", n=n, f=_f_opt(n), instances=instances,
-        adversary="adaptive", coin="shared", seed=seed,
+        adversary="adaptive", coin="shared", seed=seed, delivery="urn",
     ).validate()
 
 

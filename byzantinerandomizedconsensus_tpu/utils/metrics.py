@@ -35,6 +35,7 @@ def summary(res: SimResult) -> dict:
         "f": res.config.f,
         "adversary": res.config.adversary,
         "coin": res.config.coin,
+        "delivery": res.config.delivery,
         "seed": res.config.seed,
         "instances": int(len(res.inst_ids)),
         "decided": int(decided.sum()),

@@ -38,9 +38,11 @@ def run_large(cfg: SimConfig, total_instances: int, backend: str = "jax",
     """Run ``total_instances`` Monte-Carlo trials of ``cfg`` across derived seeds.
 
     Returns ``(result, shards)``: ``result`` is a merged :class:`SimResult`
-    (``inst_ids`` globally numbered 0..total-1; its config is shard 0's) and
-    ``shards`` the list of per-shard ``SimConfig``s for reproducing any shard
-    standalone (e.g. to bit-match a sampled subset against the oracle).
+    (``inst_ids`` globally numbered 0..total-1; its config is the *user's*
+    ``cfg`` with ``instances=total_instances``, so summaries report the base
+    seed — per-shard derived seeds live in ``shards``) and ``shards`` the
+    list of per-shard ``SimConfig``s for reproducing any shard standalone
+    (e.g. to bit-match a sampled subset against the oracle).
     """
     if total_instances <= 0:
         raise ValueError("total_instances must be positive")
@@ -64,8 +66,10 @@ def run_large(cfg: SimConfig, total_instances: int, backend: str = "jax",
                      f"{res.instances_per_sec:.0f} inst/s")
         done += count
         k += 1
+    # Not .validate()d: total_instances may legitimately exceed the per-seed
+    # packing limit — that is the whole point of multi-seed sharding.
     merged = SimResult(
-        config=shards[0],
+        config=dataclasses.replace(cfg, instances=total_instances),
         inst_ids=np.arange(total_instances, dtype=np.int64),
         rounds=np.concatenate(rounds),
         decision=np.concatenate(decisions),

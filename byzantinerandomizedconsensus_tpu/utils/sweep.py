@@ -23,11 +23,12 @@ def run_sweep(
     seed: int = 0,
     shard_instances: int = 500,
     coin: str = "shared",
-    delivery: str = "keys",
+    delivery: str = "urn",
     progress=print,
 ) -> dict:
     """Run (or resume) the sweep; returns {n: summary-with-round-histogram}."""
     be = get_backend(backend)
+    _warn_stale_shards(out_dir, delivery, progress)
     out = {}
     for n in ns:
         cfg = sweep_point(n, seed=seed, instances=instances)
@@ -51,6 +52,26 @@ def run_sweep(
         s["round_histogram"] = metrics.round_histogram(merged).tolist()
         out[n] = s
     return out
+
+
+def _warn_stale_shards(out_dir: pathlib.Path, delivery: str, progress) -> None:
+    """Surface checkpoint shards that cannot resume under the current delivery
+    model — e.g. keys-named shards from before the urn default flip. They are
+    ignored (shard names encode the delivery), which silently restarts the
+    sweep from zero unless the user is told."""
+    if not out_dir.is_dir():
+        return
+    stale = []
+    for p in out_dir.glob("*.npz"):
+        named_urn = "_urn_" in p.name
+        if (delivery == "urn") != named_urn:
+            stale.append(p.name)
+    if stale:
+        progress(
+            f"warning: {len(stale)} checkpoint shard(s) in {out_dir} belong to the "
+            f"other delivery model (e.g. {stale[0]}) and will NOT resume this "
+            f"delivery={delivery!r} sweep; pass --delivery to match them or use a "
+            "fresh --out directory")
 
 
 def _merge(cfg, shards):

@@ -44,10 +44,14 @@ def test_small_exhaustive(cfg):
 @pytest.mark.parametrize("name,n_sample", [("config2", 6), ("config3", 4), ("config4", 3)])
 def test_benchmark_configs_sampled(name, n_sample):
     """Sampled bit-match at benchmark scale: instance i depends only on (cfg, seed, i),
-    so the oracle simulates a pseudo-random subset and must match the batched run."""
+    so the oracle simulates a pseudo-random subset and must match the batched run.
+
+    Pinned to the keys validation model — the presets themselves pin urn, whose
+    benchmark-scale sampled bit-match lives in tests/test_urn.py; this test keeps
+    the keys O(n²)-mask path covered at benchmark n against the oracle."""
     import zlib
 
-    cfg = preset(name, round_cap=64)
+    cfg = preset(name, round_cap=64, delivery="keys")
     rng = np.random.default_rng(zlib.crc32(name.encode()))
     ids = np.unique(rng.integers(0, cfg.instances, size=n_sample))
     ref = Simulator(cfg, "cpu").run(ids)
