@@ -29,6 +29,12 @@ def main() -> int:
 
     from byzantinerandomizedconsensus_tpu.backends import get_backend
 
+    # Headless resilience: if the TPU tunnel is dead, fall back to CPU (with a
+    # stderr warning + the platform recorded below) instead of hanging forever.
+    from byzantinerandomizedconsensus_tpu.utils.devices import ensure_live_backend
+
+    ensure_live_backend()
+
     instances = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
     # The headline is the preset as shipped: config4 pins delivery="urn"
     # (spec §4b — count-level scheduling, O(n·f) per instance-step) on the
@@ -73,6 +79,7 @@ def main() -> int:
         "unit": "instances/s",
         "vs_baseline": round(inst_per_sec / TARGET_INST_PER_SEC, 3),
         "detail": {
+            "platform": __import__("jax").default_backend(),
             "instances": instances,
             "wall_s": round(wall, 2),
             "walls_s": [round(w, 3) for w in walls],

@@ -167,6 +167,12 @@ def main(argv=None) -> int:
     p_sw.set_defaults(fn=cmd_sweep)
 
     args = ap.parse_args(argv)
+    if getattr(args, "backend", "").startswith("jax"):
+        # Headless resilience (docs/NEXT.md item 6): never hang on a dead TPU
+        # tunnel — probe device init out-of-process and fall back to CPU.
+        from byzantinerandomizedconsensus_tpu.utils.devices import ensure_live_backend
+
+        ensure_live_backend()
     return args.fn(args)
 
 

@@ -58,23 +58,45 @@ def init_distributed(coordinator_address: Optional[str] = None,
     jax.distributed.initialize(coordinator_address, num_processes, process_id)
 
 
-def make_hybrid_mesh(n_model: int = 1) -> Mesh:
+def make_hybrid_mesh(n_model: int = 1,
+                     devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """(data, model) mesh with DCN-aware placement for multi-host runs: the data
     axis spans hosts (no collectives cross DCN — instances are independent), the
-    model axis stays within each host's ICI slice. Falls back to :func:`make_mesh`
-    ordering on single-host or when the hybrid helper is unavailable."""
-    devs = jax.devices()
-    n_hosts = max(d.process_index for d in devs) + 1
-    if n_hosts == 1:
-        return make_mesh(n_model=n_model)
-    from jax.experimental import mesh_utils
+    model axis stays within one host's ICI domain.
 
+    The slow-link boundary is the TPU *slice* on multi-slice pods
+    (``slice_index`` varies → ``mesh_utils.create_hybrid_device_mesh`` orders
+    the intra-slice grid by physical topology), and the host *process*
+    everywhere else — including CPU multi-process runs and single-slice
+    multi-host pods, where ``slice_index`` is constant and the mesh_utils
+    helper rejects the shape (proven by tests/test_multihost.py's two-process
+    run). Single-host falls back to :func:`make_mesh`."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if len({d.process_index for d in devs}) == 1:
+        return make_mesh(n_model=n_model, devices=devs)
+    return Mesh(hybrid_grid(devs, n_model), (DATA_AXIS, MODEL_AXIS))
+
+
+def hybrid_grid(devs: Sequence, n_model: int) -> np.ndarray:
+    """(data, model) device grid for a multi-host device set (pure layout
+    logic, unit-testable with stand-in device objects)."""
+    n_hosts = len({d.process_index for d in devs})
     per_host = len(devs) // n_hosts
     if per_host % n_model:
         raise ValueError(f"n_model={n_model} must divide per-host device count {per_host}")
-    grid = mesh_utils.create_hybrid_device_mesh(
-        mesh_shape=(per_host // n_model, n_model),
-        dcn_mesh_shape=(n_hosts, 1),
-        devices=devs,
-    )
-    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+    n_slices = len({getattr(d, "slice_index", 0) for d in devs})
+    if n_slices > 1:
+        from jax.experimental import mesh_utils
+
+        per_slice = len(devs) // n_slices
+        if per_slice % n_model == 0:
+            return mesh_utils.create_hybrid_device_mesh(
+                mesh_shape=(per_slice // n_model, n_model),
+                dcn_mesh_shape=(n_slices, 1),
+                devices=devs,
+            )
+    # Process-grouped grid: host-major order, model-axis groups of n_model
+    # consecutive same-host devices, data axis crossing hosts in blocks.
+    order = sorted(devs, key=lambda d: (getattr(d, "slice_index", 0),
+                                        d.process_index, d.id))
+    return np.asarray(order, dtype=object).reshape(-1, n_model)

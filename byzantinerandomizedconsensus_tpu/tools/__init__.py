@@ -1,0 +1,1 @@
+"""Operational tools: acceptance-artifact generation and related drivers."""

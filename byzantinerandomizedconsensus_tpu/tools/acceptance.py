@@ -1,0 +1,232 @@
+"""At-scale acceptance harness (SURVEY.md §4.2; BASELINE.json:5).
+
+The north-star criterion is per-instance bit-matching at the benchmark configs.
+The Python object oracle (backends/cpu.py) is the semantic arbiter but costs
+~0.5 s/instance at n=512, so broad at-scale checking uses a two-stage scheme:
+
+1. **Anchor** — the native C++ core (native/simcore.cpp, an independent third
+   implementation) is pinned to the Python oracle on hundreds of small/medium
+   instances plus a handful of benchmark-n instances (`run_anchor`).
+2. **Arbiter** — the anchored native core then arbitrates every accelerated
+   backend (numpy, jax, jax_pallas, jax_sharded at benchmark n) over >=10^3
+   sampled instances per preset x delivery (`check_at_scale`).
+
+`python -m byzantinerandomizedconsensus_tpu.tools.acceptance` writes/merges
+`artifacts/acceptance_r2.json`. Separate invocations merge into one artifact,
+so the TPU legs (jax, jax_pallas) and the virtual-mesh sharded legs can be
+generated in different environments. tests/test_acceptance.py runs the same
+functions at reduced sample counts in CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import pathlib
+import time
+import zlib
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu.backends import get_backend
+from byzantinerandomizedconsensus_tpu.config import SimConfig, preset
+
+# Acceptance round_cap per preset: config2 (local coin at f=Theta(n)) saturates
+# any cap, so a 64-round cap bounds cost without losing coverage (rounds are
+# PRF-addressed; higher rounds re-run the same code on bigger indices). The
+# shared-coin presets decide in <= 3 rounds, so their shipped cap is free.
+ACCEPT_ROUND_CAP = {"config1": 64, "config2": 64, "config3": 256, "config4": 256}
+
+DEFAULT_PRESETS = ("config1", "config2", "config3", "config4")
+DEFAULT_DELIVERIES = ("urn", "keys")
+DEFAULT_BACKENDS = ("numpy", "jax")
+
+# Oracle-vs-native anchor grid: small n exhaustive-ish (hundreds of instances),
+# medium n sampled. Benchmark-n anchor ids are added per preset in run_anchor.
+ANCHOR_CONFIGS = [
+    SimConfig(protocol="benor", n=7, f=3, instances=80, adversary="crash",
+              coin="local", round_cap=48, seed=21),
+    SimConfig(protocol="benor", n=11, f=2, instances=80, adversary="adaptive",
+              coin="shared", round_cap=48, seed=22),
+    SimConfig(protocol="bracha", n=10, f=3, instances=80, adversary="byzantine",
+              coin="shared", round_cap=48, seed=23),
+    SimConfig(protocol="bracha", n=16, f=5, instances=80, adversary="adaptive",
+              coin="shared", round_cap=48, seed=24),
+    SimConfig(protocol="benor", n=64, f=21, instances=40, adversary="crash",
+              coin="local", round_cap=24, seed=25),
+    SimConfig(protocol="bracha", n=64, f=21, instances=40, adversary="byzantine",
+              coin="shared", round_cap=48, seed=26),
+]
+
+
+def _accept_config(name: str, delivery: str, samples: int) -> SimConfig:
+    cfg = preset(name, delivery=delivery, round_cap=ACCEPT_ROUND_CAP[name])
+    if cfg.instances < samples:
+        # config1 ships with instances=1; widen the id range so sampling means
+        # something (instance i depends only on (cfg, seed, i) — spec §1).
+        cfg = dataclasses.replace(cfg, instances=samples).validate()
+    return cfg
+
+
+def sample_ids(cfg: SimConfig, samples: int, tag: str) -> np.ndarray:
+    """Deterministic pseudo-random instance subset, keyed by the check's tag."""
+    rng = np.random.default_rng(zlib.crc32(tag.encode()))
+    return np.unique(rng.integers(0, cfg.instances, size=samples))
+
+
+def _compare(ref, got) -> dict:
+    mism = int(np.count_nonzero((ref.rounds != got.rounds)
+                                | (ref.decision != got.decision)))
+    return {"match": mism == 0, "mismatches": mism}
+
+
+def check_at_scale(name: str, delivery: str, backends=DEFAULT_BACKENDS,
+                   samples: int = 1000, progress=None) -> dict:
+    """Native-arbitrated sampled bit-match for one preset x delivery.
+
+    Returns an artifact entry; raises nothing on mismatch (the entry records
+    it) so a full artifact run always completes and reports.
+    """
+    cfg = _accept_config(name, delivery, samples)
+    ids = sample_ids(cfg, samples, f"{name}:{delivery}")
+    t0 = time.perf_counter()
+    ref = get_backend("native").run(cfg, ids)
+    native_wall = time.perf_counter() - t0
+    entry = {
+        "n": cfg.n, "f": cfg.f, "protocol": cfg.protocol,
+        "adversary": cfg.adversary, "coin": cfg.coin, "delivery": delivery,
+        "round_cap": cfg.round_cap, "seed": cfg.seed,
+        "samples": int(len(ids)),
+        "arbiter": {"backend": "native", "wall_s": round(native_wall, 2)},
+        "backends": {},
+    }
+    for bname in backends:
+        if progress:
+            progress(f"{name}:{delivery} vs {bname} ({len(ids)} samples)")
+        try:
+            t0 = time.perf_counter()
+            got = get_backend(bname).run(cfg, ids)
+            wall = time.perf_counter() - t0
+        except Exception as e:  # record, don't abort the artifact run
+            entry["backends"][bname] = {"error": f"{type(e).__name__}: {e}"}
+            continue
+        rec = _compare(ref, got)
+        rec["wall_s"] = round(wall, 2)
+        rec["inst_per_sec"] = round(len(ids) / wall, 1) if wall > 0 else None
+        entry["backends"][bname] = rec
+    return entry
+
+
+def run_anchor(presets=DEFAULT_PRESETS, deliveries=DEFAULT_DELIVERIES,
+               bench_ids: int = 2, progress=None) -> dict:
+    """Pin the native arbiter to the Python oracle: the small/medium grid in
+    full, plus ``bench_ids`` sampled instances at each benchmark config."""
+    out = {}
+    oracle = get_backend("cpu")
+    native = get_backend("native")
+    for base in ANCHOR_CONFIGS:
+        for delivery in deliveries:
+            cfg = dataclasses.replace(base, delivery=delivery).validate()
+            tag = (f"{cfg.protocol}-n{cfg.n}f{cfg.f}-{cfg.adversary}-"
+                   f"{cfg.coin}:{delivery}")
+            if progress:
+                progress(f"anchor {tag} ({cfg.instances} instances)")
+            t0 = time.perf_counter()
+            ref = oracle.run(cfg)
+            wall = time.perf_counter() - t0
+            got = native.run(cfg)
+            rec = _compare(ref, got)
+            rec.update(instances=cfg.instances, oracle_wall_s=round(wall, 2))
+            out[tag] = rec
+    for name in presets:
+        if name == "config1":
+            continue  # n=4 is already densely covered by the grid above
+        for delivery in deliveries:
+            cfg = _accept_config(name, delivery, 1000)
+            ids = sample_ids(cfg, bench_ids, f"anchor:{name}:{delivery}")
+            tag = f"{name}:{delivery}@bench_n"
+            if progress:
+                progress(f"anchor {tag} ids={ids.tolist()}")
+            t0 = time.perf_counter()
+            ref = oracle.run(cfg, ids)
+            wall = time.perf_counter() - t0
+            got = native.run(cfg, ids)
+            rec = _compare(ref, got)
+            rec.update(ids=ids.tolist(), oracle_wall_s=round(wall, 2))
+            out[tag] = rec
+    return out
+
+
+def merge_artifact(path: pathlib.Path, anchor: dict | None,
+                   at_scale: dict | None, platform: str) -> dict:
+    art = json.loads(path.read_text()) if path.exists() else {}
+    art.setdefault("description",
+                   "North-star acceptance: oracle-anchored native C++ arbiter "
+                   "vs every accelerated backend, sampled per preset x delivery "
+                   "(tools/acceptance.py)")
+    if anchor:
+        art.setdefault("anchor", {}).update(anchor)
+    if at_scale:
+        for key, entry in at_scale.items():
+            slot = art.setdefault("at_scale", {}).setdefault(key, {})
+            backends = slot.get("backends", {})
+            meta_changed = any(slot.get(k) != entry[k] for k in entry
+                               if k != "backends" and k in slot)
+            if meta_changed:
+                backends = {}  # sample set changed; stale legs don't merge
+            backends.update({f"{b}@{platform}": rec
+                             for b, rec in entry["backends"].items()})
+            slot.update({k: v for k, v in entry.items() if k != "backends"})
+            slot["backends"] = backends
+    art["all_match"] = bool(
+        all(rec.get("match") for rec in art.get("anchor", {}).values())
+        and all(rec.get("match")
+                for e in art.get("at_scale", {}).values()
+                for rec in e["backends"].values()))
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(art, indent=1, sort_keys=True) + "\n")
+    return art
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Generate/merge the at-scale acceptance artifact")
+    ap.add_argument("--out", default="artifacts/acceptance_r2.json")
+    ap.add_argument("--samples", type=int, default=1000)
+    ap.add_argument("--presets", nargs="*", default=list(DEFAULT_PRESETS))
+    ap.add_argument("--deliveries", nargs="*", default=list(DEFAULT_DELIVERIES),
+                    choices=["urn", "keys"])
+    ap.add_argument("--backends", nargs="*", default=list(DEFAULT_BACKENDS),
+                    help="accelerated backends to arbitrate (e.g. numpy jax "
+                         "jax_pallas jax_sharded:2)")
+    ap.add_argument("--anchor", action="store_true",
+                    help="also run the oracle-vs-native anchor set (slow: "
+                         "drives the Python object loop)")
+    ap.add_argument("--skip-at-scale", action="store_true")
+    args = ap.parse_args(argv)
+
+    from byzantinerandomizedconsensus_tpu.utils.devices import ensure_live_backend
+
+    ensure_live_backend()  # never hang on a dead TPU tunnel (docs/NEXT.md #6)
+    import jax
+
+    platform = jax.default_backend()
+    progress = lambda msg: print(msg, flush=True)  # noqa: E731
+    anchor = run_anchor(progress=progress) if args.anchor else None
+    at_scale = None
+    if not args.skip_at_scale:
+        at_scale = {}
+        for name in args.presets:
+            for delivery in args.deliveries:
+                key = f"{name}:{delivery}"
+                at_scale[key] = check_at_scale(
+                    name, delivery, backends=args.backends,
+                    samples=args.samples, progress=progress)
+    art = merge_artifact(pathlib.Path(args.out), anchor, at_scale, platform)
+    print(json.dumps({"all_match": art["all_match"], "out": args.out}))
+    return 0 if art["all_match"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

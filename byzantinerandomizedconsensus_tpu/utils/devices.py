@@ -1,0 +1,86 @@
+"""Tunnel-resilient device discovery (docs/NEXT.md item 6; VERDICT r1 #8).
+
+The environment may carry an ``axon`` TPU-tunnel PJRT plugin registered from
+``sitecustomize`` in every interpreter. When the relay tunnel is dead, the
+*first backend initialization* (``jax.devices()`` or any traced op) dials it
+and blocks indefinitely — including for ``JAX_PLATFORMS=cpu`` requests,
+because the plugin's registration pins ``jax.config.jax_platforms``.
+tests/conftest.py solves this for the test process; this module is the same
+defense for headless ``bench.py`` / CLI runs.
+
+Strategy: probe device initialization in a *subprocess* with a timeout (a
+thread cannot be used — a hung in-process probe would wedge xla_bridge's init
+lock for the whole process), and on hang/failure drop the tunnel plugin and
+force the CPU platform before this process touches any device.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Callable, Optional
+
+_PROBE_CODE = "import jax; jax.devices()"
+
+
+def _drop_accelerator_plugins() -> None:
+    """Force the CPU platform in this process (same dance as tests/conftest.py)."""
+    try:
+        from jax._src import xla_bridge as xb
+
+        for name in list(xb._backend_factories):
+            if name != "cpu":
+                xb._backend_factories.pop(name, None)
+        import jax
+
+        if xb.backends_are_initialized():
+            from jax.extend.backend import clear_backends
+
+            clear_backends()
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        # Private-API drift: leave the env-var layer (set by our caller) to do
+        # what it can rather than failing the run outright.
+        pass
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def _default_probe(timeout_s: float) -> bool:
+    """True iff a fresh interpreter can initialize jax devices in time."""
+    try:
+        subprocess.run([sys.executable, "-c", _PROBE_CODE], check=True,
+                       capture_output=True, timeout=timeout_s)
+        return True
+    except (subprocess.TimeoutExpired, subprocess.CalledProcessError, OSError):
+        return False
+
+
+def ensure_live_backend(timeout_s: float = 45.0,
+                        probe: Optional[Callable[[float], bool]] = None,
+                        force_cpu: Optional[Callable[[], None]] = None,
+                        warn=None) -> str:
+    """Make sure this process's first jax device init cannot hang.
+
+    Returns ``"cpu-env"`` (platform already forced to CPU — nothing to do),
+    ``"ok"`` (probe initialized devices; this process can safely do the same),
+    or ``"cpu-fallback"`` (probe hung/failed; accelerator plugins dropped and
+    CPU forced in this process). ``probe``/``force_cpu`` are injectable for
+    unit tests (tests/test_devices.py).
+    """
+    probe = probe or _default_probe
+    force_cpu = force_cpu or _drop_accelerator_plugins
+    if os.environ.get("JAX_PLATFORMS", "").split(",")[0] == "cpu":
+        # CPU explicitly requested: no probe needed, but the tunnel plugin must
+        # still be dropped — its registration pins jax.config.jax_platforms
+        # OVER the env var, so a poisoned interpreter would hang regardless.
+        force_cpu()
+        return "cpu-env"
+    if probe(timeout_s):
+        return "ok"
+    if warn is None:
+        warn = lambda m: print(m, file=sys.stderr)  # noqa: E731
+    warn(f"warning: device initialization did not come up within {timeout_s:.0f}s "
+         "(accelerator tunnel down?); falling back to the CPU platform")
+    force_cpu()
+    return "cpu-fallback"

@@ -20,6 +20,9 @@ def plot_sweep(sweep_out: Mapping, path, log_y: bool = True, max_round=None) -> 
     import matplotlib.pyplot as plt
 
     fig, ax = plt.subplots(figsize=(8, 5))
+    # Title fields (protocol/adversary/coin) are common across a sweep; read
+    # them from the first point rather than whatever the loop last touched.
+    first = sweep_out[min(sweep_out, key=int)]
     for n_key in sorted(sweep_out, key=int):
         s = sweep_out[n_key]
         hist = s["round_histogram"]
@@ -31,8 +34,8 @@ def plot_sweep(sweep_out: Mapping, path, log_y: bool = True, max_round=None) -> 
         ax.set_yscale("symlog")
     ax.set_xlabel("rounds to decision")
     ax.set_ylabel("instances")
-    ax.set_title(f"round distribution — {s['protocol']}, {s['adversary']} adversary, "
-                 f"{s['coin']} coin")
+    ax.set_title(f"round distribution — {first['protocol']}, {first['adversary']} "
+                 f"adversary, {first['coin']} coin")
     ax.legend(fontsize=8)
     ax.grid(True, alpha=0.3)
     fig.tight_layout()
@@ -55,6 +58,7 @@ def plot_coin_contrast(shared_out: Mapping, local_out: Mapping, path,
     fig, axes = plt.subplots(1, 2, figsize=(12, 5), sharey=True)
     for ax, out, title in ((axes[0], shared_out, "shared coin"),
                            (axes[1], local_out, "local coin")):
+        first = out[min(out, key=int)]
         for n_key in sorted(out, key=int):
             s = out[n_key]
             hist = s["round_histogram"]
@@ -64,7 +68,7 @@ def plot_coin_contrast(shared_out: Mapping, local_out: Mapping, path,
                     label=f"n={n_key}")
         ax.set_yscale("symlog")
         ax.set_xlabel("rounds to decision")
-        ax.set_title(f"{s['protocol']}, {s['adversary']} — {title}")
+        ax.set_title(f"{first['protocol']}, {first['adversary']} — {title}")
         ax.legend(fontsize=8)
         ax.grid(True, alpha=0.3)
     axes[0].set_ylabel("instances")

@@ -1,0 +1,146 @@
+"""Exact expected rounds-to-decision for Ben-Or n=4, f=1 (SURVEY.md §4.4).
+
+Closed-form anchor for the statistical suite (VERDICT r1 #5): a subtly wrong
+protocol can pass cross-seed stability checks, but not an exact constant.
+
+Model (spec/PROTOCOL.md §5.1 Protocol A, adversary="none", coin="local",
+n=4, f=1 — benchmark config 1):
+
+- Delivery: every receiver gets its own message plus exactly n−f−1 = 2 of the
+  other 3, the dropped sender uniform over the 3 and independent across
+  receivers and steps. The keys (§4) and urn (§4b) samplers both realize
+  exactly this distribution at n=4, f=1 with no silent senders, so one chain
+  covers both delivery models' *means* (bit-level draws differ).
+- Step 0 (report): receiver with seen counts (c0, c1), c0+c1 = 3, proposes
+  1 if 2·c1 > 4, 0 if 2·c0 > 4, else ⊥  (replica.py on_counts t=0).
+- Step 1 (proposal): w = 1 if c1 ≥ c0 else 0 over non-⊥ proposals seen;
+  decide iff c_w ≥ f+1 = 2; adopt est=w iff c_w ≥ 1; else est = fair coin
+  (independent per replica — local coin).
+- Decided replicas keep sending with est frozen (spec §1); the instance
+  terminates at the end of the round in which the last replica decides.
+
+State: multiset of per-replica (est, decided); replica exchangeability under
+the uniform delivery makes the sorted tuple canonical. The absorbing state is
+all-decided. E[rounds] solves the first-step linear system exactly (fractions
+avoided — float64 on a ~25-state chain is exact to well below Monte-Carlo
+resolution).
+
+The resulting constant is pinned in spec/PROTOCOL.md §8a and asserted against
+simulation in tests/test_statistics.py.
+"""
+
+from __future__ import annotations
+
+import itertools
+from functools import lru_cache
+
+import numpy as np
+
+N = 4
+
+
+def _propose(ests, dropped_by):
+    """Per-receiver proposals after step 0. ``dropped_by[i]`` = sender index
+    whose message receiver i loses (never i itself)."""
+    props = []
+    for i in range(N):
+        c1 = sum(ests[k] for k in range(N) if k != dropped_by[i])
+        c0 = 3 - c1
+        props.append(1 if 2 * c1 > N else (0 if 2 * c0 > N else 2))
+    return props
+
+
+def _step1(props, dropped_by):
+    """Per-receiver (w, decide, adopt) after step 1."""
+    out = []
+    for i in range(N):
+        c1 = sum(1 for k in range(N) if k != dropped_by[i] and props[k] == 1)
+        c0 = sum(1 for k in range(N) if k != dropped_by[i] and props[k] == 0)
+        w = 1 if c1 >= c0 else 0
+        c = c1 if w else c0
+        out.append((w, c >= 2, c >= 1))
+    return out
+
+
+def _round_transitions(state):
+    """{next_state: probability} for one round from ``state`` (tuple of
+    (est, decided) pairs, canonically sorted)."""
+    drops = [tuple(j for j in range(N) if j != i) for i in range(N)]
+    ests = [e for e, _ in state]
+    decided = [d for _, d in state]
+    out: dict = {}
+    combos = list(itertools.product(*drops))
+    p_combo = (1.0 / 3 ** N) ** 2
+    for d0 in combos:
+        props = _propose(ests, d0)
+        for d1 in combos:
+            acts = _step1(props, d1)
+            # Coin branches: replicas that neither decide nor adopt.
+            coin_users = [i for i in range(N)
+                          if not decided[i] and not acts[i][1] and not acts[i][2]]
+            for coins in itertools.product((0, 1), repeat=len(coin_users)):
+                p = p_combo * 0.5 ** len(coin_users)
+                nest, ndec = list(ests), list(decided)
+                ci = iter(coins)
+                for i in range(N):
+                    if decided[i]:
+                        continue
+                    w, dec, adopt = acts[i]
+                    if dec:
+                        ndec[i] = True
+                        nest[i] = w
+                    elif adopt:
+                        nest[i] = w
+                    else:
+                        nest[i] = next(ci)
+                ns = tuple(sorted(zip(nest, ndec)))
+                out[ns] = out.get(ns, 0.0) + p
+    return out
+
+
+@lru_cache(maxsize=1)
+def expected_rounds_by_state():
+    """Solve E[rounds | state] for every reachable state exactly."""
+    # Reachable exploration from all 16 initial estimate vectors.
+    initial = [tuple(sorted((e, False) for e in bits))
+               for bits in itertools.product((0, 1), repeat=N)]
+    todo = list(dict.fromkeys(initial))
+    trans: dict = {}
+    while todo:
+        s = todo.pop()
+        if s in trans or all(d for _, d in s):
+            continue
+        trans[s] = _round_transitions(s)
+        for ns in trans[s]:
+            if ns not in trans and not all(d for _, d in ns):
+                todo.append(ns)
+    states = sorted(trans)
+    idx = {s: k for k, s in enumerate(states)}
+    n = len(states)
+    A = np.eye(n)
+    b = np.ones(n)
+    for s, ts in trans.items():
+        for ns, p in ts.items():
+            if ns in idx:
+                A[idx[s], idx[ns]] -= p
+    E = np.linalg.solve(A, b)
+    return {s: float(E[idx[s]]) for s in states}
+
+
+@lru_cache(maxsize=1)
+def expected_rounds_benor_n4() -> float:
+    """E[rounds to all-decided], initial estimates uniform on {0,1}^4."""
+    E = expected_rounds_by_state()
+    total = 0.0
+    for bits in itertools.product((0, 1), repeat=N):
+        s = tuple(sorted((e, False) for e in bits))
+        total += E.get(s, 0.0)  # absorbing (impossible initially) would be 0
+    return total / 2 ** N
+
+
+if __name__ == "__main__":
+    E = expected_rounds_by_state()
+    print(f"reachable undecided states: {len(E)}")
+    for s, v in sorted(E.items(), key=lambda kv: kv[1]):
+        print(f"  {s}: {v:.6f}")
+    print(f"E[rounds] (uniform init) = {expected_rounds_benor_n4():.6f}")

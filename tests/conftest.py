@@ -23,21 +23,19 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 
 def _force_cpu_backend() -> None:
-    try:
-        from jax._src import xla_bridge as xb
+    # The one shared implementation of the drop-plugin private-API dance
+    # (swallows private-API drift internally, leaving the env vars above as
+    # the fallback layer rather than killing collection for the whole suite).
+    from byzantinerandomizedconsensus_tpu.utils.devices import _drop_accelerator_plugins
 
-        xb._backend_factories.pop("axon", None)
-        import jax
-
-        if xb.backends_are_initialized():  # nothing should have touched a device yet
-            from jax.extend.backend import clear_backends
-
-            clear_backends()
-        jax.config.update("jax_platforms", "cpu")
-    except Exception:
-        # Private-API shims for the pinned jax; if they drift, fall back to the
-        # env vars above rather than killing collection for the whole suite.
-        return
+    _drop_accelerator_plugins()
 
 
 _force_cpu_backend()
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavyweight at-scale checks (still run by default; deselect "
+        "with -m 'not slow' for a quick iteration loop)")

@@ -1,0 +1,100 @@
+"""Worker script for the two-process multi-host test (tests/test_multihost.py).
+
+Run as: python tests/multihost_worker.py <coordinator_port> <process_id> <num_processes>
+
+Each process owns 4 virtual CPU devices; jax.distributed glues them into one
+8-device global topology with two process indices — the smallest faithful model
+of a DCN-connected two-host deployment (SURVEY.md §5 distributed comm backend).
+Prints "MULTIHOST_OK" on success; any assertion/exception exits non-zero.
+"""
+
+import os
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4").strip()
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+
+def main() -> int:
+    port, pid, nproc = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+
+    # Keep the axon TPU plugin from hijacking the platform list (its
+    # registration pins jax.config.jax_platforms, overriding the env var) —
+    # the one shared implementation of the private-API dance.
+    from byzantinerandomizedconsensus_tpu.utils.devices import _drop_accelerator_plugins
+
+    _drop_accelerator_plugins()
+
+    import jax
+
+    from byzantinerandomizedconsensus_tpu.parallel import mesh as pmesh
+
+    pmesh.init_distributed(f"localhost:{port}", num_processes=nproc,
+                           process_id=pid)
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    devs = jax.devices()
+    assert len(devs) == 4 * nproc, f"global devices: {len(devs)}"
+    assert max(d.process_index for d in devs) == nproc - 1
+
+    # Hybrid mesh: data axis spans hosts (DCN leg), model axis stays host-local
+    # (the ICI analog). per_host=4, n_model=2 -> global (data=4, model=2).
+    mesh = pmesh.make_hybrid_mesh(n_model=2)
+    grid = mesh.devices
+    assert grid.shape == (2 * nproc, 2), grid.shape
+    for row in grid:
+        assert row[0].process_index == row[1].process_index, \
+            "model axis must not cross hosts"
+    data_procs = [grid[i, 0].process_index for i in range(grid.shape[0])]
+    assert set(data_procs) == set(range(nproc)), \
+        f"data axis must span all hosts, got {data_procs}"
+
+    # Cross-host collective through the mesh: psum over both axes.
+    from functools import partial
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(), out_specs=P())
+    def probe():
+        return jax.lax.psum(jnp.ones((1,), jnp.int32), ("data", "model"))
+
+    total = jax.jit(probe)()
+    assert int(np.asarray(total)[0]) == 4 * nproc, total
+
+    # The real product path: one sharded simulation chunk over the hybrid mesh,
+    # bit-matched against the native arbiter on every host.
+    from jax.experimental import multihost_utils
+
+    from byzantinerandomizedconsensus_tpu.backends import get_backend
+    from byzantinerandomizedconsensus_tpu.config import SimConfig
+    from byzantinerandomizedconsensus_tpu.parallel.sharded import _run_chunk_sharded
+
+    cfg = SimConfig(protocol="bracha", n=16, f=5, instances=16,
+                    adversary="byzantine", coin="shared", round_cap=32,
+                    seed=7, delivery="urn").validate()
+    ids = np.arange(cfg.instances, dtype=np.uint32)
+    sharding = NamedSharding(mesh, P("data"))
+    gids = jax.make_array_from_callback(
+        ids.shape, sharding, lambda idx: ids[idx])
+    rounds, decision = jax.jit(
+        partial(_run_chunk_sharded, cfg, mesh))(gids)
+    rounds = multihost_utils.process_allgather(rounds, tiled=True)
+    decision = multihost_utils.process_allgather(decision, tiled=True)
+
+    ref = get_backend("native").run(cfg)
+    np.testing.assert_array_equal(np.asarray(rounds), ref.rounds)
+    np.testing.assert_array_equal(np.asarray(decision), ref.decision)
+
+    print(f"MULTIHOST_OK pid={pid}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

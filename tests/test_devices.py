@@ -1,0 +1,56 @@
+"""Tunnel-resilient device discovery (utils/devices.py): the probe/fallback
+decision logic with the probe and plugin-drop injected, so no real tunnel (or
+hang) is involved."""
+
+import pytest
+
+from byzantinerandomizedconsensus_tpu.utils import devices
+
+
+@pytest.fixture
+def no_cpu_env(monkeypatch):
+    # conftest forces JAX_PLATFORMS=cpu for the suite; these tests exercise the
+    # non-forced (headless bench/CLI) entry conditions.
+    monkeypatch.setenv("JAX_PLATFORMS", "axon,cpu")
+
+
+def test_cpu_env_skips_probe_but_still_drops_plugins(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    calls = []
+    out = devices.ensure_live_backend(probe=lambda t: calls.append(t),
+                                      force_cpu=lambda: calls.append("force"))
+    # No subprocess probe, but the plugin drop must run: the tunnel plugin's
+    # registration overrides the env var, so cpu-env alone does not protect.
+    assert out == "cpu-env" and calls == ["force"]
+
+
+def test_live_probe_leaves_platform_alone(no_cpu_env):
+    forced = []
+    out = devices.ensure_live_backend(probe=lambda t: True,
+                                      force_cpu=lambda: forced.append(1))
+    assert out == "ok" and not forced
+
+
+def test_dead_probe_forces_cpu_and_warns(no_cpu_env):
+    forced, warnings = [], []
+    out = devices.ensure_live_backend(timeout_s=7.0,
+                                      probe=lambda t: False,
+                                      force_cpu=lambda: forced.append(1),
+                                      warn=warnings.append)
+    assert out == "cpu-fallback"
+    assert forced == [1]
+    assert warnings and "7s" in warnings[0]
+
+
+def test_default_probe_detects_broken_interpreter(monkeypatch, no_cpu_env):
+    """The real subprocess probe, pointed at a python that exits non-zero."""
+    import subprocess
+
+    real_run = subprocess.run
+
+    def fake_run(cmd, **kw):
+        raise subprocess.TimeoutExpired(cmd, kw.get("timeout", 0))
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    assert devices._default_probe(0.1) is False
+    monkeypatch.setattr(subprocess, "run", real_run)

@@ -1,0 +1,93 @@
+"""Multi-host (DCN) leg of the distributed comm backend (SURVEY.md §5, §7
+step 8; VERDICT r1 missing #1).
+
+Two layers of evidence, neither needing real multi-host hardware:
+
+1. Unit tests of the hybrid-mesh layout logic (`hybrid_grid`) with stand-in
+   device objects — the DCN boundary grouping (process-per-host; slice on
+   multi-slice pods) and its error paths.
+2. A real two-process ``jax.distributed`` run on localhost (4 virtual CPU
+   devices per process = the smallest faithful two-host topology): global
+   device discovery, hybrid-mesh layout, a cross-host psum, and the sharded
+   round driver bit-matching the native arbiter across processes
+   (tests/multihost_worker.py).
+"""
+
+import pathlib
+import socket
+import subprocess
+import sys
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from byzantinerandomizedconsensus_tpu.parallel import mesh as pmesh
+
+
+def _fake_devs(n_hosts, per_host, n_slices=1):
+    devs = []
+    for h in range(n_hosts):
+        for k in range(per_host):
+            devs.append(SimpleNamespace(
+                id=h * per_host + k,
+                process_index=h,
+                slice_index=h % n_slices if n_slices > 1 else 0))
+    return devs
+
+
+def test_hybrid_grid_two_hosts_layout():
+    grid = pmesh.hybrid_grid(_fake_devs(2, 4), n_model=2)
+    assert grid.shape == (4, 2)
+    for row in grid:
+        assert row[0].process_index == row[1].process_index
+    assert [grid[i, 0].process_index for i in range(4)] == [0, 0, 1, 1]
+
+
+def test_hybrid_grid_four_hosts_model4():
+    grid = pmesh.hybrid_grid(_fake_devs(4, 8), n_model=4)
+    assert grid.shape == (8, 4)
+    for row in grid:
+        assert len({d.process_index for d in row}) == 1
+    assert sorted({grid[i, 0].process_index for i in range(8)}) == [0, 1, 2, 3]
+
+
+def test_hybrid_grid_rejects_bad_model_split():
+    with pytest.raises(ValueError, match="n_model=3"):
+        pmesh.hybrid_grid(_fake_devs(2, 4), n_model=3)
+
+
+def test_hybrid_single_host_fallback():
+    """With one process, make_hybrid_mesh must equal the plain mesh."""
+    a = pmesh.make_hybrid_mesh(n_model=2)
+    b = pmesh.make_mesh(n_model=2)
+    assert a.shape == b.shape
+    assert (a.devices == b.devices).all()
+
+
+@pytest.mark.slow
+def test_two_process_distributed_end_to_end():
+    """Spawn 2 jax.distributed processes on localhost; each asserts the hybrid
+    mesh layout, runs a cross-host collective, and bit-matches the sharded
+    round driver against native (see multihost_worker.py)."""
+    worker = pathlib.Path(__file__).parent / "multihost_worker.py"
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    procs = [subprocess.Popen(
+        [sys.executable, str(worker), str(port), str(k), "2"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for k in range(2)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multihost worker timed out")
+        outs.append((p.returncode, out))
+    for k, (rc, out) in enumerate(outs):
+        assert rc == 0, f"worker {k} failed:\n{out[-3000:]}"
+        assert f"MULTIHOST_OK pid={k}" in out
