@@ -122,7 +122,7 @@ def cmd_sweep(args) -> int:
         ns=tuple(int(x) for x in args.ns) if args.ns else sweep.SWEEP_NS,
         instances=args.instances, seed=args.seed,
         shard_instances=args.shard_instances, coin=args.coin,
-        delivery=args.delivery,
+        delivery=args.delivery, round_cap=args.round_cap,
         progress=lambda msg: print(msg, file=sys.stderr),
     )
     print(json.dumps(out))
@@ -160,6 +160,7 @@ def main(argv=None) -> int:
     p_sw.add_argument("--instances", type=int, default=sweep.SWEEP_INSTANCES)
     p_sw.add_argument("--shard-instances", type=int, default=500)
     p_sw.add_argument("--seed", type=int, default=0)
+    p_sw.add_argument("--round-cap", type=int, default=None)
     p_sw.add_argument("--coin", choices=["local", "shared"], default="shared")
     p_sw.add_argument("--delivery", choices=["keys", "urn"], default="urn")
     p_sw.add_argument("--plot", default=None, metavar="FILE",

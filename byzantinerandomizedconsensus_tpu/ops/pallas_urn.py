@@ -1,18 +1,28 @@
-"""Pallas TPU kernel for urn delivery (spec §4b) — bit-matched alternative path.
+"""Pallas TPU kernel for urn delivery (spec §4b) — **semantics cross-check, not a
+performance path**.
 
-Holds the whole per-(instance-block, receiver-tile) urn state — LCG streams and
-the remaining-count planes — in VMEM/registers for all f draws: HBM traffic is
-one read of the value/silence rows and one write of the count outputs.
+Role (decided round 2, VERDICT r1 #4): this kernel exists to lower the §4b urn
+process through a second, independent compiler stack (Mosaic vs XLA) so the
+spec has a fourth bit-exact lowering for tests — it is *not* part of the
+advertised fast-path surface. The product urn path is the XLA lowering in
+ops/urn.py (backends/jax_backend.py default).
 
-**Measured result (v5e, config 4): the XLA path wins.** ops/urn.py's unrolled
-``fori_loop`` reaches ~220k instances/s while this kernel reaches ~13k,
+**Measured (v5e, config 4): the XLA path wins by ~17×.** ops/urn.py's unrolled
+``fori_loop`` reaches ~280k instances/s while this kernel reaches ~13k,
 invariant to tile/block shape — the sequential in-kernel draw loop (two uint32
 multiplies per draw) lowers poorly under Mosaic compared to XLA's fusion of the
-same arithmetic. The kernel is kept as a correct, independently-lowered
-implementation (selected via ``JaxBackend(kernel='pallas')`` with
-``delivery='urn'``; bit-matched against the oracle in tests/test_urn.py), and as
-the starting point if Mosaic's integer-multiply lowering improves. The default
-urn path is XLA (backends/jax_backend.py).
+same arithmetic. A known restructuring remains open if this ever needs to be a
+perf path (docs/NEXT.md item 2): the LCG states are affine in the start state
+(s_j = A^j·s_0 + C_j with compile-time A^j, C_j tables), and in the
+single-stratum case the urn size L−j is deterministic, so both multiplies
+vectorize over j and only a cheap compare/subtract scan stays sequential.
+
+Design: holds the whole per-(instance-block, receiver-tile) urn state — LCG
+streams and the remaining-count planes — in VMEM/registers for all f draws:
+HBM traffic is one read of the value/silence rows and one write of the count
+outputs. Faithful draw-for-draw to ops/urn.py; selected via
+``JaxBackend(kernel='pallas')`` with ``delivery='urn'`` and bit-matched against
+the oracle in tests/test_urn.py (interpret mode on CPU, Mosaic on TPU).
 
 Faithfulness: draw-for-draw identical to ops/urn.py (same threefry seeding,
 LCG constants, multiply-shift range reduction, stratum priority), verified

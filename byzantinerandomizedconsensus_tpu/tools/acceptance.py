@@ -70,9 +70,14 @@ def _accept_config(name: str, delivery: str, samples: int) -> SimConfig:
 
 
 def sample_ids(cfg: SimConfig, samples: int, tag: str) -> np.ndarray:
-    """Deterministic pseudo-random instance subset, keyed by the check's tag."""
+    """Deterministic pseudo-random instance subset of *exactly* ``samples``
+    ids (without replacement), keyed by the check's tag; the whole id range
+    when it is no larger than the request."""
+    if samples >= cfg.instances:
+        return np.arange(cfg.instances, dtype=np.int64)
     rng = np.random.default_rng(zlib.crc32(tag.encode()))
-    return np.unique(rng.integers(0, cfg.instances, size=samples))
+    return np.sort(rng.choice(cfg.instances, size=samples,
+                              replace=False)).astype(np.int64)
 
 
 def _compare(ref, got) -> dict:
@@ -171,8 +176,14 @@ def merge_artifact(path: pathlib.Path, anchor: dict | None,
         for key, entry in at_scale.items():
             slot = art.setdefault("at_scale", {}).setdefault(key, {})
             backends = slot.get("backends", {})
-            meta_changed = any(slot.get(k) != entry[k] for k in entry
-                               if k != "backends" and k in slot)
+            # Legs from other environments stay mergeable: only *semantic*
+            # metadata (config + sample set) invalidates them — per-run timing
+            # like arbiter.wall_s must not (it differs between hosts by
+            # construction).
+            semantic = [k for k in entry
+                        if k not in ("backends", "arbiter")]
+            meta_changed = any(slot.get(k) != entry[k] for k in semantic
+                               if k in slot)
             if meta_changed:
                 backends = {}  # sample set changed; stale legs don't merge
             backends.update({f"{b}@{platform}": rec

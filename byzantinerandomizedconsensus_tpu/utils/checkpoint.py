@@ -18,11 +18,15 @@ from byzantinerandomizedconsensus_tpu.config import SimConfig
 
 
 def shard_name(cfg: SimConfig, lo: int, hi: int) -> str:
-    # delivery joined the config surface after the original naming scheme; keys
-    # keeps the legacy name so existing sweep checkpoints stay resumable.
+    # delivery and round_cap joined the config surface after the original
+    # naming scheme; keys / the default cap keep the legacy name so existing
+    # sweep checkpoints stay resumable. A non-default cap MUST be encoded:
+    # round histograms and the overflow bucket depend on it, so a resumed
+    # sweep may never reuse shards computed under a different cap.
     deliv = "" if cfg.delivery == "keys" else f"_{cfg.delivery}"
+    cap = "" if cfg.round_cap == 256 else f"_c{cfg.round_cap}"
     return (f"{cfg.protocol}_n{cfg.n}_f{cfg.f}_{cfg.adversary}_{cfg.coin}"
-            f"{deliv}_s{cfg.seed}_i{lo}-{hi}.npz")
+            f"{deliv}{cap}_s{cfg.seed}_i{lo}-{hi}.npz")
 
 
 def save_shard(out_dir: pathlib.Path, cfg: SimConfig, res: SimResult) -> pathlib.Path:

@@ -46,6 +46,23 @@ def _drop_accelerator_plugins() -> None:
     os.environ["JAX_PLATFORMS"] = "cpu"
 
 
+def _tunnel_hazard_present() -> bool:
+    """True iff a tunnel-style PJRT plugin that can hang init is registered.
+
+    On plugin-free machines the probe (a full child-interpreter jax import +
+    device init) would be pure startup latency, so callers skip it.
+    """
+    if "PALLAS_AXON_POOL_IPS" in os.environ or \
+            "axon" in os.environ.get("JAX_PLATFORMS", ""):
+        return True
+    try:
+        from jax._src import xla_bridge as xb
+
+        return any(name not in ("cpu", "tpu") for name in xb._backend_factories)
+    except Exception:
+        return True  # can't tell — probe to be safe
+
+
 def _default_probe(timeout_s: float) -> bool:
     """True iff a fresh interpreter can initialize jax devices in time."""
     try:
@@ -62,7 +79,8 @@ def ensure_live_backend(timeout_s: float = 45.0,
                         warn=None) -> str:
     """Make sure this process's first jax device init cannot hang.
 
-    Returns ``"cpu-env"`` (platform already forced to CPU — nothing to do),
+    Returns ``"no-hazard"`` (no tunnel plugin registered — nothing can hang),
+    ``"cpu-env"`` (platform forced to CPU; plugin dropped, no probe needed),
     ``"ok"`` (probe initialized devices; this process can safely do the same),
     or ``"cpu-fallback"`` (probe hung/failed; accelerator plugins dropped and
     CPU forced in this process). ``probe``/``force_cpu`` are injectable for
@@ -70,6 +88,8 @@ def ensure_live_backend(timeout_s: float = 45.0,
     """
     probe = probe or _default_probe
     force_cpu = force_cpu or _drop_accelerator_plugins
+    if not _tunnel_hazard_present():
+        return "no-hazard"
     if os.environ.get("JAX_PLATFORMS", "").split(",")[0] == "cpu":
         # CPU explicitly requested: no probe needed, but the tunnel plugin must
         # still be dropped — its registration pins jax.config.jax_platforms

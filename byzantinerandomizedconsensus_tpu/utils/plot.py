@@ -45,19 +45,23 @@ def plot_sweep(sweep_out: Mapping, path, log_y: bool = True, max_round=None) -> 
     plt.close(fig)
 
 
-def plot_coin_contrast(shared_out: Mapping, local_out: Mapping, path,
-                       max_round=None) -> None:
-    """Side-by-side round distributions: shared coin (expected O(1) rounds)
-    vs local coin (round-cap saturation at f = Θ(n) — SURVEY.md §3.4, the
-    reason config 4's shared-coin variant exists)."""
+def plot_round_panels(panels, path, label_fn=None, max_round=None) -> None:
+    """Shared multi-panel round-distribution renderer.
+
+    ``panels``: sequence of (title_suffix, {n: summary-with-round_histogram});
+    ``label_fn(n_key, summary) -> str`` customises the per-curve legend.
+    Used by :func:`plot_coin_contrast` and tools/slack.py.
+    """
     import matplotlib
 
     matplotlib.use("Agg")
     import matplotlib.pyplot as plt
 
-    fig, axes = plt.subplots(1, 2, figsize=(12, 5), sharey=True)
-    for ax, out, title in ((axes[0], shared_out, "shared coin"),
-                           (axes[1], local_out, "local coin")):
+    if label_fn is None:
+        label_fn = lambda n_key, s: f"n={n_key}"  # noqa: E731
+    fig, axes = plt.subplots(1, len(panels), figsize=(6 * len(panels), 5),
+                             sharey=True, squeeze=False)
+    for ax, (title, out) in zip(axes[0], panels):
         first = out[min(out, key=int)]
         for n_key in sorted(out, key=int):
             s = out[n_key]
@@ -65,15 +69,24 @@ def plot_coin_contrast(shared_out: Mapping, local_out: Mapping, path,
             hi = max_round or max(i for i, c in enumerate(hist) if c) + 1
             ys = hist[1:hi + 1]
             ax.plot(range(1, 1 + len(ys)), ys, marker="o", markersize=3,
-                    label=f"n={n_key}")
+                    label=label_fn(n_key, s))
         ax.set_yscale("symlog")
         ax.set_xlabel("rounds to decision")
         ax.set_title(f"{first['protocol']}, {first['adversary']} — {title}")
         ax.legend(fontsize=8)
         ax.grid(True, alpha=0.3)
-    axes[0].set_ylabel("instances")
+    axes[0][0].set_ylabel("instances")
     fig.tight_layout()
     path = pathlib.Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     fig.savefig(path, dpi=150)
     plt.close(fig)
+
+
+def plot_coin_contrast(shared_out: Mapping, local_out: Mapping, path,
+                       max_round=None) -> None:
+    """Side-by-side round distributions: shared coin (expected O(1) rounds)
+    vs local coin (round-cap saturation at f = Θ(n) — SURVEY.md §3.4, the
+    reason config 4's shared-coin variant exists)."""
+    plot_round_panels([("shared coin", shared_out), ("local coin", local_out)],
+                      path, max_round=max_round)

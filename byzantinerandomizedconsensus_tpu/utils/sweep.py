@@ -24,6 +24,7 @@ def run_sweep(
     shard_instances: int = 500,
     coin: str = "shared",
     delivery: str = "urn",
+    round_cap: int | None = None,
     progress=print,
 ) -> dict:
     """Run (or resume) the sweep; returns {n: summary-with-round-histogram}."""
@@ -32,10 +33,14 @@ def run_sweep(
     out = {}
     for n in ns:
         cfg = sweep_point(n, seed=seed, instances=instances)
-        if coin != cfg.coin or delivery != cfg.delivery:
+        if coin != cfg.coin or delivery != cfg.delivery or \
+                (round_cap is not None and round_cap != cfg.round_cap):
             import dataclasses
 
-            cfg = dataclasses.replace(cfg, coin=coin, delivery=delivery).validate()
+            cfg = dataclasses.replace(
+                cfg, coin=coin, delivery=delivery,
+                round_cap=cfg.round_cap if round_cap is None else round_cap,
+            ).validate()
         shards = []
         for lo in range(0, instances, shard_instances):
             hi = min(lo + shard_instances, instances)

@@ -55,10 +55,14 @@ def test_artifact_merge_roundtrip(tmp_path):
     """Separate tool invocations (TPU legs, virtual-mesh legs) must merge into
     one artifact without clobbering each other's backend entries."""
     entry = {"n": 4, "f": 1, "samples": 8, "delivery": "urn",
+             "arbiter": {"backend": "native", "wall_s": 1.23},
              "backends": {"numpy": {"match": True, "mismatches": 0}}}
     path = tmp_path / "acc.json"
     acceptance.merge_artifact(path, None, {"config1:urn": dict(entry)}, "cpu")
     entry2 = dict(entry)
+    # Per-run timing differs between hosts by construction; it must NOT
+    # invalidate previously-merged legs.
+    entry2["arbiter"] = {"backend": "native", "wall_s": 9.99}
     entry2["backends"] = {"jax": {"match": True, "mismatches": 0}}
     art = acceptance.merge_artifact(path, None, {"config1:urn": entry2}, "tpu")
     legs = art["at_scale"]["config1:urn"]["backends"]

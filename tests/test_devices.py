@@ -1,6 +1,6 @@
-"""Tunnel-resilient device discovery (utils/devices.py): the probe/fallback
-decision logic with the probe and plugin-drop injected, so no real tunnel (or
-hang) is involved."""
+"""Tunnel-resilient device discovery (utils/devices.py): the hazard-gate and
+probe/fallback decision logic with the probe and plugin-drop injected, so no
+real tunnel (or hang) is involved."""
 
 import pytest
 
@@ -8,13 +8,23 @@ from byzantinerandomizedconsensus_tpu.utils import devices
 
 
 @pytest.fixture
-def no_cpu_env(monkeypatch):
-    # conftest forces JAX_PLATFORMS=cpu for the suite; these tests exercise the
-    # non-forced (headless bench/CLI) entry conditions.
+def hazard_env(monkeypatch):
+    # Simulate an axon-tunnel machine: plugin marker present, platform list
+    # not CPU-forced (the headless bench/CLI entry conditions).
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "192.0.2.1")
     monkeypatch.setenv("JAX_PLATFORMS", "axon,cpu")
 
 
+def test_no_hazard_skips_probe_and_force(monkeypatch):
+    monkeypatch.setattr(devices, "_tunnel_hazard_present", lambda: False)
+    calls = []
+    out = devices.ensure_live_backend(probe=lambda t: calls.append("probe"),
+                                      force_cpu=lambda: calls.append("force"))
+    assert out == "no-hazard" and calls == []
+
+
 def test_cpu_env_skips_probe_but_still_drops_plugins(monkeypatch):
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "192.0.2.1")
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
     calls = []
     out = devices.ensure_live_backend(probe=lambda t: calls.append(t),
@@ -24,14 +34,14 @@ def test_cpu_env_skips_probe_but_still_drops_plugins(monkeypatch):
     assert out == "cpu-env" and calls == ["force"]
 
 
-def test_live_probe_leaves_platform_alone(no_cpu_env):
+def test_live_probe_leaves_platform_alone(hazard_env):
     forced = []
     out = devices.ensure_live_backend(probe=lambda t: True,
                                       force_cpu=lambda: forced.append(1))
     assert out == "ok" and not forced
 
 
-def test_dead_probe_forces_cpu_and_warns(no_cpu_env):
+def test_dead_probe_forces_cpu_and_warns(hazard_env):
     forced, warnings = [], []
     out = devices.ensure_live_backend(timeout_s=7.0,
                                       probe=lambda t: False,
@@ -42,15 +52,21 @@ def test_dead_probe_forces_cpu_and_warns(no_cpu_env):
     assert warnings and "7s" in warnings[0]
 
 
-def test_default_probe_detects_broken_interpreter(monkeypatch, no_cpu_env):
-    """The real subprocess probe, pointed at a python that exits non-zero."""
-    import subprocess
+def test_hazard_detection_env_markers(monkeypatch):
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "axon,cpu")
+    assert devices._tunnel_hazard_present()
+    monkeypatch.setenv("JAX_PLATFORMS", "")
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "192.0.2.1")
+    assert devices._tunnel_hazard_present()
 
-    real_run = subprocess.run
+
+def test_default_probe_detects_broken_interpreter(monkeypatch, hazard_env):
+    """The real subprocess probe, pointed at a python that times out."""
+    import subprocess
 
     def fake_run(cmd, **kw):
         raise subprocess.TimeoutExpired(cmd, kw.get("timeout", 0))
 
     monkeypatch.setattr(subprocess, "run", fake_run)
     assert devices._default_probe(0.1) is False
-    monkeypatch.setattr(subprocess, "run", real_run)

@@ -1,0 +1,36 @@
+"""Slack-vs-rounds tool (tools/slack.py): slack bookkeeping, resumability via
+sweep shards, and the figure render — at toy sizes."""
+
+import json
+
+from byzantinerandomizedconsensus_tpu.tools import slack
+
+
+def test_run_slack_fields_and_plot(tmp_path):
+    ns = (13, 14, 15)  # slacks 1, 2, 3 (f = 4, 4, 4)
+    out = slack.run_slack(tmp_path / "shards", ns=ns, instances=24,
+                          backend="numpy", round_cap=12, progress=lambda m: None)
+    for coin in ("local", "shared"):
+        assert sorted(out[coin]) == sorted(ns)
+        for n in ns:
+            s = out[coin][n]
+            assert s["slack"] == n - 3 * s["f"] and s["slack"] in (1, 2, 3)
+            assert 0.0 <= s["capped_fraction"] <= 1.0
+            assert sum(s["round_histogram"]) == 24
+    # Shared coin cannot be stalled by the adaptive adversary: nothing capped.
+    assert all(out["shared"][n]["capped_fraction"] == 0.0 for n in ns)
+    fig = tmp_path / "slack.png"
+    slack.plot_slack(out, fig)
+    assert fig.stat().st_size > 0
+
+
+def test_slack_cli_roundtrip(tmp_path, capsys):
+    rc = slack.main(["--out", str(tmp_path / "s.json"),
+                     "--shards", str(tmp_path / "shards"),
+                     "--fig", str(tmp_path / "s.png"),
+                     "--ns", "13", "14", "--instances", "12",
+                     "--round-cap", "8", "--backend", "numpy"])
+    assert rc == 0
+    data = json.loads((tmp_path / "s.json").read_text())
+    assert set(data) == {"local", "shared"}
+    assert (tmp_path / "s.png").exists()
