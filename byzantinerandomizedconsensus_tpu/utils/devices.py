@@ -29,8 +29,13 @@ def _drop_accelerator_plugins() -> None:
     try:
         from jax._src import xla_bridge as xb
 
+        # Drop only tunnel-style plugins. The builtin "tpu" factory must stay
+        # registered even when unusable: Pallas registers MLIR lowering rules
+        # for the "tpu" platform at import, which requires it to be *known* —
+        # popping it turns every interpret-mode Pallas test into
+        # NotImplementedError ("unknown platform tpu").
         for name in list(xb._backend_factories):
-            if name != "cpu":
+            if name not in ("cpu", "tpu"):
                 xb._backend_factories.pop(name, None)
         import jax
 

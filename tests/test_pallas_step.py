@@ -1,0 +1,138 @@
+"""Step-level Pallas-kernel equality — the cheap, broad layer of Pallas
+coverage.
+
+Interpret-mode Pallas inside the jitted round *driver* costs ~20 s of
+tracing/lowering per config (measured; execution is ~10 ms), so the full grid
+of driver-level Pallas bit-matches made the suite compile-bound. The kernels,
+however, are pure per-step functions: running the real ``round_body`` *eagerly*
+(no jit, interpret-mode pallas_call) exercises them in their exact calling
+context — adversary injection, validation silences, wire values — at ~1 s per
+config. Full-driver Pallas runs remain, but only one per kernel family
+(tests/test_pallas.py, tests/test_urn.py); this module carries the breadth.
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from byzantinerandomizedconsensus_tpu.config import SimConfig
+from byzantinerandomizedconsensus_tpu.models import benor, bracha, state as state_mod
+from byzantinerandomizedconsensus_tpu.models.adversaries import AdversaryModel
+
+
+def _run_rounds(cfg, counts_fn, n_rounds=2):
+    """Eager round_body applications; returns the per-round state snapshots."""
+    ids = jnp.arange(cfg.instances, dtype=jnp.uint32)
+    round_body = benor.round_body if cfg.protocol == "benor" else bracha.round_body
+    adv = AdversaryModel(cfg)
+    setup = adv.setup(cfg.seed, ids, xp=jnp)
+    st = state_mod.init_state(cfg, cfg.seed, ids, xp=jnp)
+    out = []
+    for r in range(n_rounds):
+        st = round_body(cfg, cfg.seed, ids, r, st, adv, setup, xp=jnp,
+                        counts_fn=counts_fn)
+        out.append({k: np.asarray(v) for k, v in st.items()})
+    return out
+
+
+def _assert_rounds_equal(cfg, ref_counts_fn, got_counts_fn):
+    ref = _run_rounds(cfg, ref_counts_fn)
+    got = _run_rounds(cfg, got_counts_fn)
+    for r, (a, b) in enumerate(zip(ref, got)):
+        assert sorted(a) == sorted(b)
+        for k in a:
+            np.testing.assert_array_equal(a[k], b[k],
+                                          err_msg=f"round {r} field {k}")
+
+
+URN_STEP = [
+    SimConfig(protocol="benor", n=4, f=1, instances=16, adversary="none",
+              coin="local", round_cap=8, seed=0, delivery="urn"),
+    SimConfig(protocol="benor", n=9, f=4, instances=16, adversary="crash",
+              coin="local", round_cap=8, seed=1, delivery="urn"),
+    SimConfig(protocol="benor", n=16, f=3, instances=16, adversary="byzantine",
+              coin="local", round_cap=8, seed=2, delivery="urn"),  # two-faced
+    SimConfig(protocol="benor", n=11, f=2, instances=16, adversary="adaptive",
+              coin="shared", round_cap=8, seed=3, delivery="urn"),
+    SimConfig(protocol="bracha", n=10, f=3, instances=16, adversary="byzantine",
+              coin="shared", round_cap=8, seed=4, delivery="urn"),
+    SimConfig(protocol="bracha", n=16, f=5, instances=16, adversary="adaptive",
+              coin="shared", round_cap=8, seed=5, delivery="urn"),
+    SimConfig(protocol="bracha", n=13, f=4, instances=16, adversary="crash",
+              coin="local", round_cap=8, seed=6, delivery="urn"),
+]
+
+
+@pytest.mark.parametrize(
+    "cfg", URN_STEP,
+    ids=lambda c: f"{c.protocol}-n{c.n}f{c.f}-{c.adversary}")
+def test_urn_kernel_steps(cfg):
+    """Pallas urn kernel == XLA urn path through the real round body."""
+    from byzantinerandomizedconsensus_tpu.ops import pallas_urn
+
+    _assert_rounds_equal(
+        cfg, None, functools.partial(pallas_urn.counts_fn, interpret=True))
+
+
+KEYS_STEP = [
+    SimConfig(protocol="benor", n=7, f=3, instances=16, adversary="none",
+              coin="shared", round_cap=8, seed=13),
+    SimConfig(protocol="benor", n=11, f=2, instances=16, adversary="byzantine",
+              coin="shared", round_cap=8, seed=13),
+    SimConfig(protocol="benor", n=7, f=3, instances=16, adversary="crash",
+              coin="local", round_cap=8, seed=5),
+    SimConfig(protocol="bracha", n=10, f=3, instances=16, adversary="crash",
+              coin="shared", round_cap=8, seed=13),
+    SimConfig(protocol="bracha", n=10, f=3, instances=16, adversary="byzantine",
+              coin="shared", round_cap=8, seed=13),
+    SimConfig(protocol="bracha", n=16, f=5, instances=16, adversary="adaptive",
+              coin="shared", round_cap=8, seed=13),
+    # Tile boundaries: n == lane width, and n straddling two receiver tiles.
+    SimConfig(protocol="bracha", n=128, f=42, instances=8, adversary="byzantine",
+              coin="shared", round_cap=4, seed=2),
+    SimConfig(protocol="bracha", n=200, f=66, instances=8, adversary="adaptive",
+              coin="shared", round_cap=4, seed=2),
+]
+
+
+@pytest.mark.parametrize(
+    "cfg", KEYS_STEP,
+    ids=lambda c: f"{c.protocol}-n{c.n}f{c.f}-{c.adversary}")
+def test_keys_kernel_steps(cfg):
+    """Fused Pallas selection+tally kernel == XLA masks+tally path through the
+    real round body (incl. the tile-boundary shapes)."""
+    from byzantinerandomizedconsensus_tpu.ops import pallas_tally
+
+    _assert_rounds_equal(
+        cfg, None, functools.partial(pallas_tally.counts_fn, interpret=True))
+
+
+@pytest.mark.parametrize("lo,hi", [(0, 5), (5, 11), (11, 16)])
+def test_urn_kernel_receiver_shard_offsets(lo, hi):
+    """Direct counts_fn comparison on receiver sub-ranges: the Pallas urn
+    kernel's recv_offset path (incl. the two-faced class boundary at
+    (n+1)//2 = 8) must match ops/urn.py for every shard."""
+    from byzantinerandomizedconsensus_tpu.ops import pallas_urn, prf, urn
+
+    cfg = SimConfig(protocol="benor", n=16, f=3, instances=12,
+                    adversary="byzantine", coin="local", round_cap=8, seed=31,
+                    delivery="urn").validate()
+    B, n = cfg.instances, cfg.n
+    inst = np.arange(B, dtype=np.uint32)
+    send = np.arange(n, dtype=np.uint32)
+    honest = prf.prf_bit(cfg.seed, inst[:, None], 0, 0, 0, send[None, :],
+                         prf.INIT_EST, xp=np).astype(np.uint8)
+    faulty = (send[None, :] % 5 == 0) & np.ones((B, 1), bool)
+    silent = np.zeros((B, n), dtype=bool)
+    recv = np.arange(lo, hi, dtype=np.uint32)
+    a0, a1 = urn.counts_fn(cfg, cfg.seed, inst, 1, 0, honest, silent, faulty,
+                           honest, recv_ids=recv, xp=np)
+    b0, b1 = pallas_urn.counts_fn(
+        cfg, cfg.seed, jnp.asarray(inst), 1, 0, jnp.asarray(honest),
+        jnp.asarray(silent), jnp.asarray(faulty), jnp.asarray(honest),
+        recv_ids=jnp.asarray(recv), interpret=True)
+    np.testing.assert_array_equal(a0, np.asarray(b0))
+    np.testing.assert_array_equal(a1, np.asarray(b1))

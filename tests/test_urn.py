@@ -33,12 +33,22 @@ URN_SMALL = [
 ]
 
 
+# The interpret-mode Pallas leg costs ~20 s of tracing per config; driver-level
+# Pallas runs once, on the most intricate path (two-faced Ben-Or equivocation).
+# The full grid's Pallas coverage lives in tests/test_pallas_step.py at
+# step level, and the cheap backends keep driver breadth here.
+_PALLAS_SEEDS = {2}
+
+
 @pytest.mark.parametrize(
     "cfg", URN_SMALL,
     ids=lambda c: f"{c.protocol}-n{c.n}f{c.f}-{c.adversary}-{c.coin}")
 def test_urn_bitmatch_small(cfg):
     ref = Simulator(cfg, "cpu").run()
-    for backend in ("numpy", "jax", "native", "jax_pallas"):
+    backends = ("numpy", "jax", "native")
+    if cfg.seed in _PALLAS_SEEDS:
+        backends += ("jax_pallas",)
+    for backend in backends:
         got = Simulator(cfg, backend).run()
         np.testing.assert_array_equal(ref.rounds, got.rounds, err_msg=f"rounds {backend}")
         np.testing.assert_array_equal(ref.decision, got.decision,
@@ -93,8 +103,12 @@ def test_urn_matches_keys_statistically(adversary, coin, tol):
                - float((urn.decision == 1).mean())) < 0.08
 
 
-@pytest.mark.parametrize("kernel", ["xla", "pallas"])
-@pytest.mark.parametrize("n_data,n_model", [(8, 1), (4, 2), (2, 4)])
+@pytest.mark.parametrize("n_data,n_model,kernel", [
+    (8, 1, "xla"), (4, 2, "xla"), (2, 4, "xla"),
+    # Pallas: one driver-level mesh shape (receiver-shard path); shard-offset
+    # breadth incl. the class boundary is step-level in test_pallas_step.py.
+    (4, 2, "pallas"),
+])
 def test_urn_sharded_bitmatch(n_data, n_model, kernel):
     """Urn delivery under shard_map (instance + replica sharding) bit-matches
     the single-device jax backend on every mesh shape, with both the XLA urn
@@ -112,10 +126,12 @@ def test_urn_sharded_bitmatch(n_data, n_model, kernel):
     np.testing.assert_array_equal(ref.decision, got.decision)
 
 
-@pytest.mark.parametrize("kernel", ["xla", "pallas"])
+@pytest.mark.parametrize("kernel", ["xla"])
 def test_urn_sharded_two_faced_byzantine(kernel):
     """Two-faced equivocation (spec §4b) under replica sharding: the per-class
-    value recomputation must line up with global receiver indices."""
+    value recomputation must line up with global receiver indices. (The Pallas
+    kernel's two-faced shard-offset path is covered at step level in
+    test_pallas_step.py::test_urn_kernel_receiver_shard_offsets.)"""
     from byzantinerandomizedconsensus_tpu.parallel.mesh import make_mesh
     from byzantinerandomizedconsensus_tpu.parallel.sharded import JaxShardedBackend
 
