@@ -51,6 +51,23 @@ def test_benchmark_n_sharded_vs_native(n_model):
     np.testing.assert_array_equal(ref.decision, got.decision)
 
 
+@pytest.mark.slow
+def test_max_n_sharded_vs_native():
+    """n=1024 — the packing limit (prf.MAX_N) and config-5's top sweep point —
+    under replica-axis sharding ((2,4) mesh), bit-matched against native."""
+    from byzantinerandomizedconsensus_tpu.config import sweep_point
+
+    cfg = sweep_point(1024, instances=64)
+    import dataclasses
+
+    cfg = dataclasses.replace(cfg, round_cap=64).validate()
+    ref = get_backend("native").run(cfg)
+    got = get_backend("jax_sharded:4").run(cfg)
+    np.testing.assert_array_equal(ref.rounds, got.rounds)
+    np.testing.assert_array_equal(ref.decision, got.decision)
+    assert (ref.decision != 2).all(), "shared coin should decide well before the cap"
+
+
 def test_artifact_merge_roundtrip(tmp_path):
     """Separate tool invocations (TPU legs, virtual-mesh legs) must merge into
     one artifact without clobbering each other's backend entries."""

@@ -51,6 +51,25 @@ def test_oracle_bitmatch_random_configs(cfg):
     np.testing.assert_array_equal(a.decision, b.decision)
 
 
+@settings(max_examples=20, deadline=None)
+@given(cfg=sim_configs())
+def test_native_differential_random_configs(cfg):
+    """Differential fuzz of the C++ core vs the vectorized backend on
+    arbitrary configs — the arbiter (tools/acceptance.py) must agree with the
+    reference implementations off the fixed grid too. The native run covers
+    all 12 instances (cheap), numpy cross-checks them."""
+    import shutil
+
+    import pytest
+
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    a = Simulator(cfg, "native").run()
+    b = Simulator(cfg, "numpy").run()
+    np.testing.assert_array_equal(a.rounds, b.rounds)
+    np.testing.assert_array_equal(a.decision, b.decision)
+
+
 @settings(max_examples=40, deadline=None)
 @given(
     seed=st.integers(min_value=0, max_value=2**63 - 1),
