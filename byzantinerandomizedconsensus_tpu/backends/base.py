@@ -42,7 +42,7 @@ class SimulatorBackend(abc.ABC):
         """Simulate the given instances (default: all of them) to termination."""
 
     @staticmethod
-    def _run_chunked(fn, ids: np.ndarray, chunk: int):
+    def _run_chunked(fn, ids: np.ndarray, chunk: int, extra_args=()):
         """Run ``fn(chunk_ids) -> (rounds, decision)`` over fixed-size chunks.
 
         The tail chunk is padded (with a repeated last id) to the compiled shape so
@@ -65,7 +65,7 @@ class SimulatorBackend(abc.ABC):
             cids = ids[lo:hi]
             if len(cids) < chunk:
                 cids = np.concatenate([cids, np.full(chunk - len(cids), cids[-1])])
-            pending.append(fn(jnp.asarray(cids, dtype=jnp.uint32)))
+            pending.append(fn(jnp.asarray(cids, dtype=jnp.uint32), *extra_args))
 
         fetched = jax.device_get(pending)
         rounds_out = np.empty(len(ids), dtype=np.int32)
@@ -99,10 +99,28 @@ class JitChunkedBackend(SimulatorBackend):
     and SimResult assembly. Subclasses provide ``_make_fn`` / ``_chunk_size`` and
     may override ``_check_config`` / ``_clamp_chunk`` / ``_device_ctx``."""
 
+    #: "pallas" kernels need concrete PRF key words in-kernel; everything else
+    #: takes the key dynamically so one program serves every seed.
+    kernel: str = "xla"
+
     def __init__(self, chunk_bytes: int, max_chunk: int):
         self.chunk_bytes = chunk_bytes
         self.max_chunk = max_chunk
         self._compiled: dict = {}
+
+    def _cache_key(self, cfg: SimConfig) -> SimConfig:
+        if self.kernel == "pallas":
+            return cfg
+        return dataclasses.replace(cfg, seed=0)
+
+    def _extra_args(self, cfg: SimConfig) -> tuple:
+        if self.kernel == "pallas":
+            return ()
+        import jax.numpy as jnp
+
+        from byzantinerandomizedconsensus_tpu.ops import prf
+
+        return (jnp.asarray(prf.seed_key(cfg.seed), dtype=jnp.uint32),)
 
     def _make_fn(self, cfg: SimConfig):
         raise NotImplementedError
@@ -122,9 +140,10 @@ class JitChunkedBackend(SimulatorBackend):
         return contextlib.nullcontext()
 
     def _fn(self, cfg: SimConfig):
-        if cfg not in self._compiled:
-            self._compiled[cfg] = self._make_fn(cfg)
-        return self._compiled[cfg]
+        key = self._cache_key(cfg)
+        if key not in self._compiled:
+            self._compiled[key] = self._make_fn(key)
+        return self._compiled[key]
 
     def run(self, cfg: SimConfig, inst_ids: Optional[np.ndarray] = None) -> SimResult:
         cfg = cfg.validate()
@@ -133,7 +152,8 @@ class JitChunkedBackend(SimulatorBackend):
         chunk = self._clamp_chunk(cfg, min(self._chunk_size(cfg), max(1, len(ids))))
         fn = self._fn(cfg)
         with self._device_ctx():
-            rounds_out, decision_out = self._run_chunked(fn, ids, chunk)
+            rounds_out, decision_out = self._run_chunked(
+                fn, ids, chunk, self._extra_args(cfg))
         return SimResult(config=cfg, inst_ids=ids, rounds=rounds_out, decision=decision_out)
 
 

@@ -28,17 +28,22 @@ from byzantinerandomizedconsensus_tpu.models import benor, bracha, state as stat
 from byzantinerandomizedconsensus_tpu.models.adversaries import AdversaryModel
 
 
-def _run_chunk(cfg: SimConfig, inst_ids: jnp.ndarray, counts_fn=None):
+def _run_chunk(cfg: SimConfig, inst_ids: jnp.ndarray, key=None, counts_fn=None):
     """Simulate one padded chunk; returns (rounds (B,), decision (B,)).
 
     ``counts_fn`` selects the delivery+tally implementation: None = the XLA
     masks+tally path; ops/pallas_tally.counts_fn = the fused Pallas kernel.
+    ``key`` is the (2,) uint32 PRF key as a *dynamic* argument (None = bake
+    cfg.seed statically — required by the Pallas kernels, whose in-kernel
+    threefry needs concrete key words): with a dynamic key, runs that differ
+    only in seed (multi-seed sharding, seed sweeps) reuse one program.
     """
+    seed = cfg.seed if key is None else key
     round_body = benor.round_body if cfg.protocol == "benor" else bracha.round_body
     adv = AdversaryModel(cfg)
-    setup = adv.setup(cfg.seed, inst_ids, xp=jnp)
+    setup = adv.setup(seed, inst_ids, xp=jnp)
     faulty = setup["faulty"]
-    st = state_mod.init_state(cfg, cfg.seed, inst_ids, xp=jnp)
+    st = state_mod.init_state(cfg, seed, inst_ids, xp=jnp)
     done_at = jnp.full(inst_ids.shape[0], -1, dtype=jnp.int32)
 
     def cond(carry):
@@ -47,7 +52,7 @@ def _run_chunk(cfg: SimConfig, inst_ids: jnp.ndarray, counts_fn=None):
 
     def body(carry):
         r, st, done_at = carry
-        st = round_body(cfg, cfg.seed, inst_ids, r, st, adv, setup, xp=jnp,
+        st = round_body(cfg, seed, inst_ids, r, st, adv, setup, xp=jnp,
                         counts_fn=counts_fn)
         done_now = state_mod.all_correct_decided(st, faulty, xp=jnp)
         done_at = jnp.where((done_at < 0) & done_now, r + 1, done_at)

@@ -167,6 +167,22 @@ def main(argv=None) -> int:
                       help="render the round-distribution figure (png/svg)")
     p_sw.set_defaults(fn=cmd_sweep)
 
+    # Artifact tools, surfaced for discoverability in --help; dispatched
+    # before argparse (argparse.REMAINDER cannot capture leading options).
+    sub.add_parser("accept",
+                   help="at-scale acceptance artifact (tools/acceptance.py; "
+                        "all further options pass through)")
+    sub.add_parser("slack",
+                   help="slack-vs-rounds boundary artifact (tools/slack.py; "
+                        "all further options pass through)")
+
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] in ("accept", "slack"):
+        from byzantinerandomizedconsensus_tpu.tools import acceptance, slack
+
+        tool = acceptance if argv[0] == "accept" else slack
+        return tool.main(argv[1:])
     args = ap.parse_args(argv)
     if getattr(args, "backend", "").startswith("jax"):
         # Headless resilience (docs/NEXT.md item 6): never hang on a dead TPU

@@ -89,8 +89,18 @@ def threefry2x32(k0, k1, x0, x1, xp=np):
     return x0
 
 
-def seed_key(seed: int):
-    """Split a 64-bit python int seed into the (k0, k1) uint32 key pair."""
+def seed_key(seed):
+    """Split a 64-bit python int seed into the (k0, k1) uint32 key pair.
+
+    Also accepts an already-split key — a (k0, k1) tuple or a (2,) uint32
+    array (possibly a traced jax value): backends pass the key as a *dynamic*
+    argument so that runs differing only in seed (multi-seed sharding,
+    seed sweeps) reuse one compiled program instead of recompiling.
+    """
+    if isinstance(seed, tuple):
+        return seed
+    if not isinstance(seed, (int, np.integer)) and getattr(seed, "shape", None) == (2,):
+        return seed[0], seed[1]
     seed = int(seed) & 0xFFFFFFFFFFFFFFFF
     return np.uint32(seed & 0xFFFFFFFF), np.uint32((seed >> 32) & 0xFFFFFFFF)
 
@@ -98,8 +108,10 @@ def seed_key(seed: int):
 def prf_u32(seed, instance, rnd, step, recv, send, purpose, xp=np):
     """One PRF evaluation per spec/PROTOCOL.md §2.
 
-    ``seed`` is a python int; all other arguments are integers or integer arrays
-    (mutually broadcastable). Returns uint32 of the broadcast shape.
+    ``seed`` is a python int, or an already-split (k0, k1) key (tuple or (2,)
+    uint32 array, possibly traced — see :func:`seed_key`); all other arguments
+    are integers or integer arrays (mutually broadcastable). Returns uint32 of
+    the broadcast shape.
 
     Packing:
         x0 = (send << 17) | instance

@@ -36,13 +36,21 @@ from byzantinerandomizedconsensus_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
 
 def _run_chunk_sharded(cfg: SimConfig, mesh: Mesh, inst_ids: jnp.ndarray,
-                       counts_fn=None):
-    """Simulate one padded chunk on the mesh; returns (rounds (B,), decision (B,))."""
+                       key=None, counts_fn=None):
+    """Simulate one padded chunk on the mesh; returns (rounds (B,), decision (B,)).
+
+    ``key``: (2,) uint32 PRF key as a dynamic argument (None = derive it from
+    cfg.seed inside the trace — a constant, used by the Pallas-kernel path
+    whose in-kernel threefry bakes the seed anyway)."""
+    from byzantinerandomizedconsensus_tpu.ops import prf
+
     n_model = mesh.shape[MODEL_AXIS]
     n_local = cfg.n // n_model
     round_body = benor.round_body if cfg.protocol == "benor" else bracha.round_body
+    if key is None:
+        key = jnp.asarray(prf.seed_key(cfg.seed), dtype=jnp.uint32)
 
-    def mapped(ids_local):
+    def mapped(ids_local, key_arr):
         midx = jax.lax.axis_index(MODEL_AXIS)
         recv_ids = (midx * n_local + jnp.arange(n_local, dtype=jnp.uint32)).astype(
             jnp.uint32
@@ -52,10 +60,10 @@ def _run_chunk_sharded(cfg: SimConfig, mesh: Mesh, inst_ids: jnp.ndarray,
             return jax.lax.all_gather(v, MODEL_AXIS, axis=v.ndim - 1, tiled=True)
 
         adv = AdversaryModel(cfg)
-        setup = adv.setup(cfg.seed, ids_local, xp=jnp)   # sender-width: full (B, n)
+        setup = adv.setup(key_arr, ids_local, xp=jnp)    # sender-width: full (B, n)
         faulty = setup["faulty"]
         faulty_local = jax.lax.dynamic_slice_in_dim(faulty, midx * n_local, n_local, 1)
-        st = state_mod.init_state(cfg, cfg.seed, ids_local, xp=jnp, recv_ids=recv_ids)
+        st = state_mod.init_state(cfg, key_arr, ids_local, xp=jnp, recv_ids=recv_ids)
         done_at = jnp.full(ids_local.shape[0], -1, dtype=jnp.int32)
         # Constant-initialized carry components are typed unvarying; the loop body
         # makes state (data, model)-varying and done_at data-varying (it only ever
@@ -75,7 +83,7 @@ def _run_chunk_sharded(cfg: SimConfig, mesh: Mesh, inst_ids: jnp.ndarray,
 
         def body(carry):
             r, st, done_at = carry
-            st = round_body(cfg, cfg.seed, ids_local, r, st, adv, setup, xp=jnp,
+            st = round_body(cfg, key_arr, ids_local, r, st, adv, setup, xp=jnp,
                             recv_ids=recv_ids, gather=gather, counts_fn=counts_fn)
             cnt = jax.lax.psum(
                 (st["decided"] | faulty_local).sum(axis=-1, dtype=jnp.int32),
@@ -107,10 +115,10 @@ def _run_chunk_sharded(cfg: SimConfig, mesh: Mesh, inst_ids: jnp.ndarray,
     return jax.shard_map(
         mapped,
         mesh=mesh,
-        in_specs=P(DATA_AXIS),
+        in_specs=(P(DATA_AXIS), P()),
         out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
         check_vma=counts_fn is None,
-    )(inst_ids)
+    )(inst_ids, key)
 
 
 class JaxShardedBackend(JitChunkedBackend):

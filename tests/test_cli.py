@@ -81,3 +81,26 @@ def test_invalid_config_errors():
         cli.main(["run", "--delivery", "bogus"])
     with pytest.raises(KeyError, match="unknown backend"):
         cli.main(["run", "--preset", "config1", "--backend", "nope"])
+
+
+def test_accept_subcommand_passthrough(capsys, tmp_path):
+    """`cli accept` forwards argv to tools/acceptance.py."""
+    import shutil
+
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    rc, out = _run_cli(capsys, [
+        "accept", "--out", str(tmp_path / "acc.json"), "--samples", "8",
+        "--presets", "config1", "--deliveries", "urn", "--backends", "numpy"])
+    assert rc == 0
+    assert out["all_match"] is True
+
+
+def test_slack_subcommand_passthrough(capsys, tmp_path):
+    rc, out = _run_cli(capsys, [
+        "slack", "--out", str(tmp_path / "s.json"),
+        "--shards", str(tmp_path / "shards"), "--fig", "",
+        "--ns", "13", "--instances", "8", "--round-cap", "8",
+        "--backend", "numpy"])
+    assert rc == 0
+    assert (tmp_path / "s.json").exists()
