@@ -30,6 +30,27 @@ def test_single_trace_per_config_shape():
     assert fn._cache_size() == 2, "second-shape rerun retraced"
 
 
+def test_one_program_across_seeds():
+    """Runs differing only in seed share one compiled program (the PRF key is
+    a runtime argument) — and the seed still changes the results."""
+    import dataclasses
+
+    be = JaxBackend()
+    cfg1 = SimConfig(protocol="bracha", n=10, f=3, instances=32,
+                     adversary="byzantine", coin="shared", round_cap=32,
+                     seed=1, delivery="urn").validate()
+    cfg2 = dataclasses.replace(cfg1, seed=2)
+    a = be.run(cfg1)
+    fn = be._fn(cfg1)
+    assert fn._cache_size() == 1
+    b = be.run(cfg2)
+    assert be._fn(cfg2) is fn, "seed must not key the compiled-fn cache"
+    assert fn._cache_size() == 1, "different seed retraced the program"
+    assert not (np.array_equal(a.rounds, b.rounds)
+                and np.array_equal(a.decision, b.decision)), \
+        "different seeds produced identical trajectories"
+
+
 def test_profiling_noop_and_annotate():
     with profiling.trace(None):
         x = np.arange(4).sum()
