@@ -69,21 +69,28 @@ def _accept_config(name: str, delivery: str, samples: int) -> SimConfig:
     return cfg
 
 
-def sample_ids(cfg: SimConfig, samples: int, tag: str) -> np.ndarray:
+def sample_ids(cfg: SimConfig, samples: int, tag: str = None,
+               seed: int = None) -> np.ndarray:
     """Deterministic pseudo-random instance subset of *exactly* ``samples``
-    ids (without replacement), keyed by the check's tag; the whole id range
-    when it is no larger than the request."""
+    ids (without replacement), keyed by the check's tag (or an explicit
+    seed — the CLI keys on cfg.seed); the whole id range when it is no
+    larger than the request."""
     if samples >= cfg.instances:
         return np.arange(cfg.instances, dtype=np.int64)
-    rng = np.random.default_rng(zlib.crc32(tag.encode()))
+    rng = np.random.default_rng(zlib.crc32(tag.encode()) if seed is None
+                                else seed)
     return np.sort(rng.choice(cfg.instances, size=samples,
                               replace=False)).astype(np.int64)
 
 
-def _compare(ref, got) -> dict:
+def compare_results(ref, got) -> dict:
+    """The bit-match surface (spec §1): per-instance (rounds, decision)."""
     mism = int(np.count_nonzero((ref.rounds != got.rounds)
                                 | (ref.decision != got.decision)))
     return {"match": mism == 0, "mismatches": mism}
+
+
+_compare = compare_results
 
 
 def check_at_scale(name: str, delivery: str, backends=DEFAULT_BACKENDS,

@@ -104,3 +104,18 @@ def test_slack_subcommand_passthrough(capsys, tmp_path):
         "--backend", "numpy"])
     assert rc == 0
     assert (tmp_path / "s.json").exists()
+
+
+def test_bitmatch_native_arbiter(capsys):
+    import shutil
+
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    rc, out = _run_cli(capsys, [
+        "bitmatch", "--protocol", "bracha", "-n", "16", "-f", "5",
+        "--instances", "200", "--adversary", "adaptive", "--coin", "shared",
+        "--delivery", "urn", "--backend", "numpy",
+        "--arbiter", "native", "--samples", "100"])
+    assert rc == 0
+    assert out["bitmatch"] is True and out["arbiter"] == "native"
+    assert out["n_samples"] == 100 and "samples" not in out
