@@ -16,8 +16,6 @@ import json
 import pathlib
 import sys
 
-import numpy as np
-
 from byzantinerandomizedconsensus_tpu import PRESETS, SimConfig, Simulator, preset
 from byzantinerandomizedconsensus_tpu.utils import metrics, sweep
 
@@ -40,7 +38,14 @@ def _add_config_args(p: argparse.ArgumentParser, default_backend: str = "cpu") -
                         "the validation model)")
     p.add_argument("--backend", default=default_backend,
                    help="cpu (oracle) | numpy | native[:threads] | jax | jax_cpu "
-                        "| jax_sharded[:n_model]")
+                        "| jax_pallas | jax_sharded[:n_model]")
+
+
+def _positive_int(text: str) -> int:
+    value = int(text)
+    if value <= 0:
+        raise argparse.ArgumentTypeError(f"must be positive, got {value}")
+    return value
 
 
 def _config_from(args) -> SimConfig:
@@ -100,10 +105,18 @@ def cmd_bitmatch(args) -> int:
     if args.backend.partition(":")[0] == args.arbiter:
         print("bitmatch compares the arbiter against a *different* backend; "
               "pick a --backend not implemented by the arbiter "
-              "(numpy|jax|jax_cpu|jax_sharded, or native vs --arbiter cpu)",
-              file=sys.stderr)
+              "(numpy|jax|jax_cpu|jax_pallas|jax_sharded, or native vs "
+              "--arbiter cpu)", file=sys.stderr)
         return 2
     cfg = _config_from(args)
+    if cfg.instances < args.samples:
+        # A small preset (config1 ships instances=1) must not silently shrink
+        # a requested thousand-sample check to a near-vacuous one: widen the
+        # id range instead (instance i depends only on (cfg, seed, i) —
+        # spec §1; tools/acceptance.py does the same).
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, instances=args.samples).validate()
     ids = sample_ids(cfg, args.samples, seed=cfg.seed)
     ref = Simulator(cfg, args.arbiter).run(ids)
     got = Simulator(cfg, args.backend).run(ids)
@@ -165,7 +178,7 @@ def main(argv=None) -> int:
 
     p_bm = sub.add_parser("bitmatch", help="sampled oracle-vs-backend bit-match")
     _add_config_args(p_bm, default_backend="jax")
-    p_bm.add_argument("--samples", type=int, default=4)
+    p_bm.add_argument("--samples", type=_positive_int, default=4)
     p_bm.add_argument("--arbiter", choices=["cpu", "native"], default="cpu",
                       help="reference implementation: cpu (object oracle) | "
                            "native (oracle-anchored C++ core — fast enough "

@@ -72,9 +72,11 @@ def _accept_config(name: str, delivery: str, samples: int) -> SimConfig:
 def sample_ids(cfg: SimConfig, samples: int, tag: str = None,
                seed: int = None) -> np.ndarray:
     """Deterministic pseudo-random instance subset of *exactly* ``samples``
-    ids (without replacement), keyed by the check's tag (or an explicit
-    seed — the CLI keys on cfg.seed); the whole id range when it is no
-    larger than the request."""
+    ids (without replacement), keyed by exactly one of the check's ``tag``
+    (artifact entries) or an explicit ``seed`` (the CLI keys on cfg.seed);
+    the whole id range when it is no larger than the request."""
+    if (tag is None) == (seed is None):
+        raise ValueError("sample_ids needs exactly one of tag= or seed=")
     if samples >= cfg.instances:
         return np.arange(cfg.instances, dtype=np.int64)
     rng = np.random.default_rng(zlib.crc32(tag.encode()) if seed is None
@@ -85,12 +87,14 @@ def sample_ids(cfg: SimConfig, samples: int, tag: str = None,
 
 def compare_results(ref, got) -> dict:
     """The bit-match surface (spec §1): per-instance (rounds, decision)."""
+    if ref.rounds.shape != got.rounds.shape \
+            or ref.decision.shape != got.decision.shape:
+        return {"match": False, "mismatches": -1,
+                "error": f"shape mismatch: arbiter {ref.rounds.shape} vs "
+                         f"backend {got.rounds.shape}"}
     mism = int(np.count_nonzero((ref.rounds != got.rounds)
                                 | (ref.decision != got.decision)))
     return {"match": mism == 0, "mismatches": mism}
-
-
-_compare = compare_results
 
 
 def check_at_scale(name: str, delivery: str, backends=DEFAULT_BACKENDS,
@@ -123,7 +127,7 @@ def check_at_scale(name: str, delivery: str, backends=DEFAULT_BACKENDS,
         except Exception as e:  # record, don't abort the artifact run
             entry["backends"][bname] = {"error": f"{type(e).__name__}: {e}"}
             continue
-        rec = _compare(ref, got)
+        rec = compare_results(ref, got)
         rec["wall_s"] = round(wall, 2)
         rec["inst_per_sec"] = round(len(ids) / wall, 1) if wall > 0 else None
         entry["backends"][bname] = rec
@@ -148,7 +152,7 @@ def run_anchor(presets=DEFAULT_PRESETS, deliveries=DEFAULT_DELIVERIES,
             ref = oracle.run(cfg)
             wall = time.perf_counter() - t0
             got = native.run(cfg)
-            rec = _compare(ref, got)
+            rec = compare_results(ref, got)
             rec.update(instances=cfg.instances, oracle_wall_s=round(wall, 2))
             out[tag] = rec
     for name in presets:
@@ -164,7 +168,7 @@ def run_anchor(presets=DEFAULT_PRESETS, deliveries=DEFAULT_DELIVERIES,
             ref = oracle.run(cfg, ids)
             wall = time.perf_counter() - t0
             got = native.run(cfg, ids)
-            rec = _compare(ref, got)
+            rec = compare_results(ref, got)
             rec.update(ids=ids.tolist(), oracle_wall_s=round(wall, 2))
             out[tag] = rec
     return out
