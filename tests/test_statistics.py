@@ -77,6 +77,22 @@ def test_mean_rounds_matches_exact_markov_constant():
                               f"{exact:.6f} (z={z:+.2f})")
 
 
+def test_rabin_configuration_constant_rounds():
+    """Rabin (FOCS 1983) = Ben-Or's rounds + a common lottery coin — the
+    `protocol="benor", coin="shared"` configuration (spec §5.3). Its defining
+    property vs plain Ben-Or: expected O(1) rounds even at f = Θ(n), where the
+    local coin saturates the cap."""
+    base = dict(protocol="benor", n=32, f=15, instances=400, adversary="crash",
+                round_cap=64, seed=44)
+    rabin = Simulator(SimConfig(coin="shared", **base), "numpy").run()
+    benor = Simulator(SimConfig(coin="local", **base), "numpy").run()
+    assert (rabin.decision != 2).all(), "shared coin must decide within the cap"
+    assert float(rabin.rounds.mean()) < 6
+    # The same sizes under the local coin mostly saturate — the contrast that
+    # makes the common coin the point of Rabin's construction.
+    assert (benor.decision == 2).mean() > 0.5
+
+
 def test_shared_coin_expected_constant_rounds():
     """With the shared coin the adversary cannot stall: mean rounds is O(1) and
     nearly independent of n (spec §5.3) — the reason config 4 exists."""
