@@ -126,6 +126,10 @@ def cmd_bitmatch(args) -> int:
         "arbiter": args.arbiter,
         "backend": args.backend,
         "n_samples": int(len(ids)),
+        # The *effective* id range: may exceed a small preset's shipped
+        # instances (widened above) — record it so the artifact is honest
+        # about which config was actually compared.
+        "instances": int(cfg.instances),
         "mismatches": cmp["mismatches"],
     }
     if len(ids) <= 32:  # keep the JSON line readable for the common case

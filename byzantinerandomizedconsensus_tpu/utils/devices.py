@@ -56,8 +56,13 @@ def _tunnel_hazard_present() -> bool:
 
     On plugin-free machines the probe (a full child-interpreter jax import +
     device init) would be pure startup latency, so callers skip it.
+
+    The env-var markers are checked first and unconditionally: a tunnel
+    plugin is free to register under the standard "tpu" factory name, in
+    which case the factory-name scan below would miss it (ADVICE r2).
+    Whenever the tunnel's own configuration variables are present, probe.
     """
-    if "PALLAS_AXON_POOL_IPS" in os.environ or \
+    if any(k.startswith(("PALLAS_AXON", "AXON_")) for k in os.environ) or \
             "axon" in os.environ.get("JAX_PLATFORMS", ""):
         return True
     try:

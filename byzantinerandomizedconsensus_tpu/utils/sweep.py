@@ -6,6 +6,7 @@ shards; instances are chunked so an interrupted point restarts mid-way, not from
 from __future__ import annotations
 
 import pathlib
+import re
 from typing import Iterable, Optional
 
 import numpy as np
@@ -29,7 +30,10 @@ def run_sweep(
 ) -> dict:
     """Run (or resume) the sweep; returns {n: summary-with-round-histogram}."""
     be = get_backend(backend)
-    _warn_stale_shards(out_dir, delivery, progress)
+    # 256 = the SimConfig default cap, which is also the cap legacy shard
+    # names imply (checkpoint.shard_name encodes only non-default caps).
+    eff_cap = 256 if round_cap is None else round_cap
+    _warn_stale_shards(out_dir, delivery, eff_cap, progress)
     out = {}
     for n in ns:
         cfg = sweep_point(n, seed=seed, instances=instances)
@@ -59,24 +63,29 @@ def run_sweep(
     return out
 
 
-def _warn_stale_shards(out_dir: pathlib.Path, delivery: str, progress) -> None:
+def _warn_stale_shards(out_dir: pathlib.Path, delivery: str, round_cap: int,
+                       progress) -> None:
     """Surface checkpoint shards that cannot resume under the current delivery
-    model — e.g. keys-named shards from before the urn default flip. They are
-    ignored (shard names encode the delivery), which silently restarts the
-    sweep from zero unless the user is told."""
+    model or round cap — e.g. keys-named shards from before the urn default
+    flip, or cap-128 shards against a cap-256 sweep. They are ignored (shard
+    names encode both fields; a different cap MUST invalidate shards — see
+    checkpoint.shard_name), which silently restarts the sweep from zero unless
+    the user is told."""
     if not out_dir.is_dir():
         return
     stale = []
     for p in out_dir.glob("*.npz"):
         named_urn = "_urn_" in p.name
-        if (delivery == "urn") != named_urn:
+        m = re.search(r"_c(\d+)_s", p.name)
+        named_cap = int(m.group(1)) if m else 256  # legacy names = default cap
+        if (delivery == "urn") != named_urn or named_cap != round_cap:
             stale.append(p.name)
     if stale:
         progress(
-            f"warning: {len(stale)} checkpoint shard(s) in {out_dir} belong to the "
-            f"other delivery model (e.g. {stale[0]}) and will NOT resume this "
-            f"delivery={delivery!r} sweep; pass --delivery to match them or use a "
-            "fresh --out directory")
+            f"warning: {len(stale)} checkpoint shard(s) in {out_dir} belong to a "
+            f"different delivery model or round cap (e.g. {stale[0]}) and will "
+            f"NOT resume this delivery={delivery!r} round_cap={round_cap} sweep; "
+            "pass matching --delivery/--round-cap or use a fresh --out directory")
 
 
 def _merge(cfg, shards):

@@ -119,3 +119,30 @@ def test_bitmatch_native_arbiter(capsys):
     assert rc == 0
     assert out["bitmatch"] is True and out["arbiter"] == "native"
     assert out["n_samples"] == 100 and "samples" not in out
+
+
+def test_bitmatch_reports_effective_instances(capsys):
+    """Widening a small preset's id range is recorded in the output JSON
+    (ADVICE r2): instances must reflect the config actually compared."""
+    rc, out = _run_cli(capsys, [
+        "bitmatch", "--preset", "config1", "--backend", "numpy",
+        "--samples", "8"])
+    assert rc == 0
+    assert out["instances"] == 8  # config1 ships instances=1, widened to samples
+    rc2, out2 = _run_cli(capsys, [
+        "bitmatch", "--protocol", "benor", "-n", "4", "-f", "1",
+        "--instances", "30", "--backend", "numpy", "--samples", "4"])
+    assert rc2 == 0 and out2["instances"] == 30  # no widening: kept verbatim
+
+
+def test_sweep_warns_on_round_cap_mismatch(tmp_path, capsys):
+    """Shards computed under a different round cap must not silently fail to
+    resume (ADVICE r2): the driver names them stale and says why."""
+    base = ["sweep", "--out", str(tmp_path), "--backend", "numpy",
+            "--ns", "16", "--instances", "20", "--shard-instances", "20",
+            "--delivery", "urn"]
+    assert cli.main(base + ["--round-cap", "64"]) == 0
+    capsys.readouterr()
+    assert cli.main(base + ["--round-cap", "128"]) == 0
+    err = capsys.readouterr().err
+    assert "round cap" in err and "round_cap=128" in err
