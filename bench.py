@@ -22,6 +22,45 @@ import numpy as np
 TARGET_INST_PER_SEC = 100_000 / 60.0  # north-star: 100k instances < 60 s
 
 
+def _prev_round_headline():
+    """(artifact_name, inst/s) from the previous round's BENCH_r*.json.
+
+    The driver records bench output per round; comparing against the previous
+    round's artifact is the perf-regression guard (VERDICT r2 #4): tunnel
+    variance is ±10-15% (docs/PERF.md), so |vs_prev_round - 1| > 0.15 means a
+    real change, not noise, and must be explained in PERF.md.
+
+    "Previous round" is the round VERDICT.md judged (the latest artifact can
+    be the CURRENT round's, written by the driver after its bench capture — a
+    rerun comparing against it would always read ~1.0 and mask regressions).
+    Without a parseable VERDICT the latest artifact is used.
+    """
+    import pathlib
+    import re
+
+    root = pathlib.Path(__file__).resolve().parent
+    cap = None  # highest round number eligible as "previous"
+    try:
+        m = re.search(r"VERDICT\s*[—-]+\s*round\s+(\d+)",
+                      (root / "VERDICT.md").read_text())
+        cap = int(m.group(1)) if m else None
+    except OSError:
+        pass
+    best = None
+    for p in sorted(root.glob("BENCH_r*.json")):
+        m = re.match(r"BENCH_r(\d+)\.json", p.name)
+        if not m or (cap is not None and int(m.group(1)) > cap):
+            continue
+        try:
+            doc = json.loads(p.read_text())
+            val = doc.get("parsed", doc).get("value")
+            if val:
+                best = (p.name, float(val))
+        except (OSError, ValueError, AttributeError):
+            continue
+    return best
+
+
 def main() -> int:
     import os
 
@@ -73,11 +112,14 @@ def main() -> int:
 
     inst_per_sec = instances / wall
     undecided = int((res.decision == 2).sum())
+    prev = _prev_round_headline()
     print(json.dumps({
         "metric": "consensus_instances_per_sec@n512_f170_shared_coin",
         "value": round(inst_per_sec, 1),
         "unit": "instances/s",
         "vs_baseline": round(inst_per_sec / TARGET_INST_PER_SEC, 3),
+        **({"vs_prev_round": round(inst_per_sec / prev[1], 3),
+            "prev_round_artifact": prev[0]} if prev else {}),
         "detail": {
             "platform": __import__("jax").default_backend(),
             "instances": instances,

@@ -13,6 +13,11 @@ CoinKind = Literal["local", "shared"]
 InitKind = Literal["random", "all0", "all1", "split"]
 DeliveryKind = Literal["keys", "urn"]
 
+# Single source for the default round cap. checkpoint.shard_name encodes only
+# NON-default caps (legacy shard names imply this value), so every site that
+# interprets a shard name must agree with SimConfig's field default.
+DEFAULT_ROUND_CAP = 256
+
 
 @dataclasses.dataclass(frozen=True)
 class SimConfig:
@@ -23,7 +28,7 @@ class SimConfig:
     adversary: AdversaryKind = "none"
     coin: CoinKind = "local"
     seed: int = 0
-    round_cap: int = 256
+    round_cap: int = DEFAULT_ROUND_CAP
     crash_window: int = 4
     init: InitKind = "random"
     # Scheduling model. "urn" (spec §4b, count-level, O(n·f)) is the product

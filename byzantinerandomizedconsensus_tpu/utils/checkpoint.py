@@ -14,7 +14,7 @@ import pathlib
 import numpy as np
 
 from byzantinerandomizedconsensus_tpu.backends.base import SimResult
-from byzantinerandomizedconsensus_tpu.config import SimConfig
+from byzantinerandomizedconsensus_tpu.config import DEFAULT_ROUND_CAP, SimConfig
 
 
 def shard_name(cfg: SimConfig, lo: int, hi: int) -> str:
@@ -24,7 +24,7 @@ def shard_name(cfg: SimConfig, lo: int, hi: int) -> str:
     # round histograms and the overflow bucket depend on it, so a resumed
     # sweep may never reuse shards computed under a different cap.
     deliv = "" if cfg.delivery == "keys" else f"_{cfg.delivery}"
-    cap = "" if cfg.round_cap == 256 else f"_c{cfg.round_cap}"
+    cap = "" if cfg.round_cap == DEFAULT_ROUND_CAP else f"_c{cfg.round_cap}"
     return (f"{cfg.protocol}_n{cfg.n}_f{cfg.f}_{cfg.adversary}_{cfg.coin}"
             f"{deliv}{cap}_s{cfg.seed}_i{lo}-{hi}.npz")
 

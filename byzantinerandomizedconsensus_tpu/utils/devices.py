@@ -61,8 +61,12 @@ def _tunnel_hazard_present() -> bool:
     plugin is free to register under the standard "tpu" factory name, in
     which case the factory-name scan below would miss it (ADVICE r2).
     Whenever the tunnel's own configuration variables are present, probe.
+    The marker set is scoped to the tunnel's actual variable family
+    (PALLAS_AXON_* / AXON_LOOPBACK_RELAY) — a bare "AXON_" prefix would
+    drag unrelated variables into a 45 s probe on plugin-free machines.
     """
-    if any(k.startswith(("PALLAS_AXON", "AXON_")) for k in os.environ) or \
+    if any(k.startswith("PALLAS_AXON") for k in os.environ) or \
+            "AXON_LOOPBACK_RELAY" in os.environ or \
             "axon" in os.environ.get("JAX_PLATFORMS", ""):
         return True
     try:

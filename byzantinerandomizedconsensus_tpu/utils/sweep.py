@@ -12,7 +12,8 @@ from typing import Iterable, Optional
 import numpy as np
 
 from byzantinerandomizedconsensus_tpu.backends.base import get_backend
-from byzantinerandomizedconsensus_tpu.config import SWEEP_INSTANCES, SWEEP_NS, sweep_point
+from byzantinerandomizedconsensus_tpu.config import (
+    DEFAULT_ROUND_CAP, SWEEP_INSTANCES, SWEEP_NS, sweep_point)
 from byzantinerandomizedconsensus_tpu.utils import checkpoint, metrics
 
 
@@ -30,9 +31,7 @@ def run_sweep(
 ) -> dict:
     """Run (or resume) the sweep; returns {n: summary-with-round-histogram}."""
     be = get_backend(backend)
-    # 256 = the SimConfig default cap, which is also the cap legacy shard
-    # names imply (checkpoint.shard_name encodes only non-default caps).
-    eff_cap = 256 if round_cap is None else round_cap
+    eff_cap = DEFAULT_ROUND_CAP if round_cap is None else round_cap
     _warn_stale_shards(out_dir, delivery, eff_cap, progress)
     out = {}
     for n in ns:
@@ -77,7 +76,7 @@ def _warn_stale_shards(out_dir: pathlib.Path, delivery: str, round_cap: int,
     for p in out_dir.glob("*.npz"):
         named_urn = "_urn_" in p.name
         m = re.search(r"_c(\d+)_s", p.name)
-        named_cap = int(m.group(1)) if m else 256  # legacy names = default cap
+        named_cap = int(m.group(1)) if m else DEFAULT_ROUND_CAP  # legacy names
         if (delivery == "urn") != named_urn or named_cap != round_cap:
             stale.append(p.name)
     if stale:
