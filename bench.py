@@ -46,16 +46,19 @@ def _prev_round_headline():
         cap = int(m.group(1)) if m else None
     except OSError:
         pass
-    best = None
-    for p in sorted(root.glob("BENCH_r*.json")):
+    best, best_round = None, -1
+    for p in root.glob("BENCH_r*.json"):
         m = re.match(r"BENCH_r(\d+)\.json", p.name)
-        if not m or (cap is not None and int(m.group(1)) > cap):
+        if not m:
+            continue
+        rnd = int(m.group(1))
+        if rnd <= best_round or (cap is not None and rnd > cap):
             continue
         try:
             doc = json.loads(p.read_text())
             val = doc.get("parsed", doc).get("value")
             if val:
-                best = (p.name, float(val))
+                best, best_round = (p.name, float(val)), rnd
         except (OSError, ValueError, AttributeError):
             continue
     return best

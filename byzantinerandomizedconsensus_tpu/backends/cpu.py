@@ -111,5 +111,23 @@ class CpuBackend(SimulatorBackend):
             for rep in replicas:
                 rep.end_round(int(coin[rep.index]))
             if all(replicas[j].decided for j in correct):
+                # Always-on Agreement invariant (VERDICT r2 #2): the result
+                # surface reports correct[0]'s value, which would mask a
+                # disagreement among higher-indexed correct replicas — so the
+                # oracle checks ALL of them before returning. Every
+                # oracle-anchored run (tools/acceptance.py run_anchor,
+                # bitmatch --arbiter cpu) is thereby an agreement check.
+                vals = {replicas[j].decided_val for j in correct}
+                if len(vals) != 1:
+                    raise AssertionError(
+                        f"Agreement violation: correct replicas decided {sorted(vals)} "
+                        f"(instance={instance}, cfg={cfg})")
                 return r + 1, replicas[correct[0]].decided_val
+        # Agreement binds any two correct deciders even when the instance
+        # caps out with a partial decided set.
+        vals = {replicas[j].decided_val for j in correct if replicas[j].decided}
+        if len(vals) > 1:
+            raise AssertionError(
+                f"Agreement violation at round cap: correct replicas decided "
+                f"{sorted(vals)} (instance={instance}, cfg={cfg})")
         return cfg.round_cap, 2

@@ -33,6 +33,25 @@ class NumpyBackend(SimulatorBackend):
         return max(1, min(1 << 14, self.chunk_bytes // per_inst))
 
     def run(self, cfg: SimConfig, inst_ids: Optional[np.ndarray] = None) -> SimResult:
+        res, _, _ = self._run_impl(cfg, inst_ids, collect_state=False)
+        return res
+
+    def run_with_state(self, cfg: SimConfig,
+                       inst_ids: Optional[np.ndarray] = None):
+        """``run`` plus the FULL final per-replica state and the faulty mask.
+
+        Returns ``(SimResult, state, faulty)`` where ``state`` is the
+        models/state.py dict with every array concatenated to ``(B, n)`` and
+        ``faulty`` is the (B, n) bool mask. This is the direct
+        protocol-property surface (VERDICT r2 #2): ``SimResult.decision``
+        deliberately collapses an instance to the lowest-indexed correct
+        replica's value (models/state.py:extract_decision), which *assumes*
+        Agreement — at-scale tests must instead assert Agreement/Validity
+        over every replica of the state the product path actually computed.
+        """
+        return self._run_impl(cfg, inst_ids, collect_state=True)
+
+    def _run_impl(self, cfg: SimConfig, inst_ids, collect_state: bool):
         cfg = cfg.validate()
         ids = self._resolve_inst_ids(cfg, inst_ids)
         round_body = benor.round_body if cfg.protocol == "benor" else bracha.round_body
@@ -41,6 +60,7 @@ class NumpyBackend(SimulatorBackend):
 
         rounds_out = np.full(len(ids), cfg.round_cap, dtype=np.int32)
         decision_out = np.full(len(ids), 2, dtype=np.uint8)
+        states, faulties = [], []
 
         for lo in range(0, len(ids), chunk):
             sl = slice(lo, min(lo + chunk, len(ids)))
@@ -58,5 +78,15 @@ class NumpyBackend(SimulatorBackend):
             done = done_at >= 0
             rounds_out[sl] = np.where(done, done_at, cfg.round_cap)
             decision_out[sl] = state_mod.extract_decision(st, faulty, done, xp=np)
+            if collect_state:
+                states.append(st)
+                faulties.append(faulty)
 
-        return SimResult(config=cfg, inst_ids=ids, rounds=rounds_out, decision=decision_out)
+        res = SimResult(config=cfg, inst_ids=ids, rounds=rounds_out, decision=decision_out)
+        if not collect_state:
+            return res, None, None
+        if not states:  # empty inst_ids: mirror run()'s empty-result support
+            empty = state_mod.init_state(cfg, cfg.seed, ids, xp=np)
+            return res, empty, np.zeros((0, cfg.n), dtype=bool)
+        state = {k: np.concatenate([s[k] for s in states]) for k in states[0]}
+        return res, state, np.concatenate(faulties)

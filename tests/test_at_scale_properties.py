@@ -1,0 +1,130 @@
+"""Direct protocol-property checks at benchmark n (VERDICT r2 #2; SURVEY.md §4.1).
+
+At n>=256 the suite's correctness evidence was previously *cross-implementation
+equality only* — which a spec misreading encoded identically in all four
+implementations would survive. These tests close that loop: they run the real
+vectorized product path (NumpyBackend.run_with_state — the same models/ round
+bodies the JAX backend jits) at config-3/config-4 scale and assert the [ALG]
+invariants over the FULL (B, n) per-replica state, not the collapsed
+per-instance decision:
+
+- **Agreement**: no two correct replicas of one instance decide differently.
+- **Validity**: unanimous correct inputs v force decision v, under Byzantine
+  and adaptive adversaries.
+- **Termination**: with the shared coin, every instance decides well under the
+  round cap (expected O(1) rounds [ALG: Rabin '83 / CKS '00]).
+- **Decision consistency**: SimResult.decision — which reads only the
+  lowest-indexed correct replica (models/state.py:extract_decision) — equals
+  EVERY correct replica's decided value (the weak-#6 closure: the bit-match
+  surface cannot hide a higher-indexed disagreement if this holds).
+
+Urn legs run at full width (hundreds of instances — cheap: O(n·f) count-level
+work); the O(n²) keys legs are slow-marked at reduced-but-real sample sizes.
+"""
+
+import numpy as np
+import pytest
+
+from byzantinerandomizedconsensus_tpu.backends.numpy_backend import NumpyBackend
+from byzantinerandomizedconsensus_tpu.config import SimConfig
+
+
+def _run(n, f, adversary, delivery, instances, init="random", seed=31):
+    cfg = SimConfig(protocol="bracha", n=n, f=f, instances=instances,
+                    adversary=adversary, coin="shared", seed=seed,
+                    delivery=delivery, init=init).validate()
+    res, state, faulty = NumpyBackend().run_with_state(cfg)
+    return cfg, res, state, faulty
+
+
+def _assert_invariants(cfg, res, state, faulty, expect_value=None):
+    correct = ~faulty
+    decided = state["decided"]
+    vals = state["decided_val"]
+
+    # Termination (shared coin): every correct replica of every instance
+    # decided, comfortably under the cap.
+    assert bool((decided | faulty).all()), "undecided correct replica"
+    assert int(res.rounds.max()) < cfg.round_cap
+    assert int((res.decision == 2).sum()) == 0
+
+    # Decided values are bits.
+    assert bool(np.isin(vals[correct & decided], (0, 1)).all())
+
+    # Agreement over the full state: per instance, the correct deciders'
+    # values span max-min == 0.
+    cd = correct & decided
+    v_masked = np.where(cd, vals, 0)
+    per_inst_max = v_masked.max(axis=1)
+    v_masked_hi = np.where(cd, vals, 1)
+    per_inst_min = v_masked_hi.min(axis=1)
+    assert bool((per_inst_max == per_inst_min).all()), \
+        "Agreement violation among correct replicas"
+
+    # Decision consistency (weak #6): the reported per-instance decision must
+    # equal EVERY correct replica's decided value, not just replica correct[0].
+    assert bool((vals[cd] == np.broadcast_to(
+        res.decision[:, None], vals.shape)[cd]).all())
+
+    # Validity: unanimous correct inputs force that value.
+    if expect_value is not None:
+        assert bool((res.decision == expect_value).all()), \
+            f"Validity violation: expected unanimous decision {expect_value}"
+
+
+@pytest.mark.parametrize("n,f", [(256, 85), (512, 170)])
+@pytest.mark.parametrize("adversary", ["byzantine", "adaptive"])
+def test_invariants_urn_at_benchmark_n(n, f, adversary):
+    cfg, res, state, faulty = _run(n, f, adversary, "urn", instances=200)
+    _assert_invariants(cfg, res, state, faulty)
+
+
+@pytest.mark.parametrize("n,f,adversary,instances", [
+    (256, 85, "byzantine", 100),
+    (256, 85, "adaptive", 100),
+    (512, 170, "byzantine", 64),
+])
+@pytest.mark.slow
+def test_invariants_keys_at_benchmark_n(n, f, adversary, instances):
+    cfg, res, state, faulty = _run(n, f, adversary, "keys", instances=instances)
+    _assert_invariants(cfg, res, state, faulty)
+
+
+@pytest.mark.parametrize("n,f", [(256, 85), (512, 170)])
+@pytest.mark.parametrize("adversary", ["byzantine", "adaptive"])
+@pytest.mark.parametrize("init,expect", [("all0", 0), ("all1", 1)])
+def test_validity_unanimous_urn_at_benchmark_n(n, f, adversary, init, expect):
+    cfg, res, state, faulty = _run(n, f, adversary, "urn", instances=100,
+                                   init=init)
+    _assert_invariants(cfg, res, state, faulty, expect_value=expect)
+
+
+@pytest.mark.parametrize("init,expect", [("all0", 0), ("all1", 1)])
+@pytest.mark.slow
+def test_validity_unanimous_keys_at_benchmark_n(init, expect):
+    cfg, res, state, faulty = _run(256, 85, "byzantine", "keys", instances=64,
+                                   init=init)
+    _assert_invariants(cfg, res, state, faulty, expect_value=expect)
+
+
+def test_oracle_agreement_assert_is_armed():
+    """The oracle's always-on Agreement check (backends/cpu.py) fires on a
+    fabricated disagreement — so its silence on real runs is evidence."""
+    from byzantinerandomizedconsensus_tpu.backends.cpu import CpuBackend
+    from byzantinerandomizedconsensus_tpu.core import replica as replica_mod
+
+    cfg = SimConfig(protocol="benor", n=4, f=0, instances=1,
+                    adversary="none", coin="shared", seed=3).validate()
+    orig = replica_mod.Replica.end_round
+
+    def sabotage(self, coin):
+        orig(self, coin)
+        if self.decided and self.index == 0:
+            self.decided_val = 1 - self.decided_val
+
+    replica_mod.Replica.end_round = sabotage
+    try:
+        with pytest.raises(AssertionError, match="Agreement violation"):
+            CpuBackend().run(cfg)
+    finally:
+        replica_mod.Replica.end_round = orig
