@@ -1,0 +1,343 @@
+"""Exact rounds-to-decision law for Bracha n=4, f=1, Byzantine adversary.
+
+Second closed-form anchor (VERDICT r2 #8), companion to spec/analytic.py's
+Ben-Or chain: Bracha's three-step round with §5.1b message validation is the
+subtlest logic in the repo (models/validation.py and its three independent
+re-implementations), and cross-implementation equality cannot catch a shared
+misreading — an exact constant derived from the *spec text* can.
+
+Model (spec/PROTOCOL.md §5.2 + §5.1b + §4b/§4 + §6.3; n=4, f=1,
+adversary="byzantine", both delivery models):
+
+- One faulty replica (the FAULTY_RANK draw is independent of everything else
+  and replicas are exchangeable, so it is fixed w.l.o.g.; its initial estimate
+  is still uniform). Correct replicas: 3. Initial estimates iid uniform.
+- Per step, the Byzantine sender's RBC outcome is iid uniform over
+  {silent, 0, 1, honest} (spec §6.3: ``b = prf & 3`` with b=0 silent,
+  b=1 value 0, b=2 value 1, b=3 the honest machine's value). The faulty
+  replica runs the honest state machine internally (spec §5.1 last ¶) — its
+  internal m/d/est evolve from its own deliveries; its own-message delivery
+  carries its *wire* value (silent outcome ⇒ wire = honest value, spec §4b
+  "own value = vals(v)").
+- Validation (spec §5.1b, independent re-derivation): with q = n−f = 3,
+  step-1 value 1 needs G0_1 ≥ ⌈q/2⌉ = 2; value 0 needs G0_0 ≥ ⌊q/2⌋+1 = 2;
+  step-2 value y∈{0,1} needs G1_y ≥ ⌊n/2⌋+1 = 3; step-2 ⊥ needs
+  max(0, q−G1_0, q−⌊n/2⌋) ≤ min(G1_1, q, ⌊n/2⌋). Invalid senders are
+  silenced *before* delivery and drop out of the wait quota. Correct senders
+  are provably never invalid — asserted during enumeration.
+- Delivery (spec §4b / §4 — identical distribution at this config, which is
+  why one chain covers both delivery models' laws): every receiver gets its
+  own wire value plus min(L, n−f−1 = 2) of its L live others; when L = 3 the
+  single dropped message is uniform over the live others (urn: stratum-
+  uniform by remaining class counts ≡ uniform over live senders; keys: the
+  largest of three exchangeable PRF keys), independent across receivers and
+  steps. No scheduling bias: the Byzantine adversary sets none (spec §6.3).
+- Round body per receiver (spec §5.2): m = majority of delivered step-0
+  (ties→1); d = 1 if 2·S1_1 > n else 0 if 2·S1_0 > n else ⊥; step-2 over
+  delivered non-⊥: w = 1 if D1 ≥ D0 else 0, c = D_w; decide iff c ≥ 2f+1 = 3,
+  adopt est=w iff f+1 = 2 ≤ c ≤ 2f = 2, else est = coin. Decided replicas
+  keep sending (est frozen) but never update.
+- Termination: the instance's rounds-to-decision is the round in which the
+  last *correct* replica decides (spec §1).
+
+State between rounds: (faulty (est, decided), sorted multiset of correct
+(est, decided)). Within a round the joint law over receivers factorizes given
+the wire/silence profile (delivery draws are independent per receiver), so the
+enumeration propagates a distribution over canonical trajectory multisets —
+receivers are exchangeable given (own state, own derived values so far).
+
+Exact constants (float64 on the 18-state chain; Monte-Carlo-resolution-proof)
+are pinned in spec/PROTOCOL.md §8b and asserted against the vectorized numpy
+backend for both delivery models and both coins in tests/test_statistics.py
+(the cross-implementation bit-match web extends the pin to every other
+backend).
+"""
+
+from __future__ import annotations
+
+import itertools
+from functools import lru_cache
+
+import numpy as np
+
+N, F = 4, 1
+Q = N - F            # 3: the wait quota / validation witness size
+K = N - F - 1        # 2: delivered others on top of own
+
+# Byzantine per-step RBC outcomes (spec §6.3), each probability 1/4.
+OUT_SILENT, OUT_ZERO, OUT_ONE, OUT_HONEST = range(4)
+BOT = 2
+
+
+def _valid(step: int, value: int, g) -> bool:
+    """spec §5.1b at n=4, f=1. ``g`` = (G_0, G_1) of the previous step."""
+    g0, g1 = g
+    if step == 1:
+        if value == 1:
+            return g1 >= (Q + 1) // 2
+        if value == 0:
+            return g0 >= Q // 2 + 1
+        return True  # ⊥ never occurs at step 1, but is unconstrained
+    if value == 1:
+        return g1 >= N // 2 + 1
+    if value == 0:
+        return g0 >= N // 2 + 1
+    return max(0, Q - g0, Q - N // 2) <= min(g1, Q, N // 2)
+
+
+def _wire(step_vals, o):
+    """Wire values + silence after Byzantine injection: ``step_vals`` are the
+    honest machine values (faulty's first), ``o`` the faulty outcome."""
+    vals = list(step_vals)
+    silent = [False] * N
+    if o == OUT_SILENT:
+        silent[0] = True
+    elif o == OUT_ZERO:
+        vals[0] = 0
+    elif o == OUT_ONE:
+        vals[0] = 1
+    return vals, silent
+
+
+def _apply_validation(step, vals, silent, g_prev):
+    """Silence invalid senders (spec §5.2: merged into the silent set before
+    the delivery draw). Correct senders must never be invalid (§5.1b claim)."""
+    out = list(silent)
+    for u in range(N):
+        if not _valid(step, vals[u], g_prev):
+            assert u == 0, (
+                f"spec §5.1b broken: correct sender {u} invalid "
+                f"(step={step}, value={vals[u]}, g={g_prev})")
+            out[u] = True
+    return out
+
+
+def _live_counts(vals, silent):
+    """(G_0, G_1) over live senders — the next step's validation input."""
+    return (sum(1 for u in range(N) if not silent[u] and vals[u] == 0),
+            sum(1 for u in range(N) if not silent[u] and vals[u] == 1))
+
+
+def _deliver_dist(own_val, others):
+    """{(c0, c1): p} — delivered counts at one receiver (spec §4b).
+
+    ``others``: [cnt_0, cnt_1, cnt_⊥] of live other senders. L ≤ 3 others;
+    at L = 3 one uniformly chosen message is dropped (class probability
+    proportional to remaining class count — the single-stratum urn), at
+    L ≤ 2 everything live is delivered. Own message always on top.
+    """
+    L = sum(others)
+    own = (1 if own_val == 0 else 0, 1 if own_val == 1 else 0)
+    if L <= K:
+        return {(others[0] + own[0], others[1] + own[1]): 1.0}
+    out = {}
+    for w in range(3):
+        if others[w] == 0:
+            continue
+        rem = list(others)
+        rem[w] -= 1
+        key = (rem[0] + own[0], rem[1] + own[1])
+        out[key] = out.get(key, 0.0) + others[w] / L
+    return out
+
+
+def _derive(step, counts):
+    """Receiver update from delivered (c0, c1) (spec §5.2)."""
+    c0, c1 = counts
+    if step == 0:
+        return 1 if c1 >= c0 else 0                      # m: ties → 1
+    if step == 1:
+        return 1 if 2 * c1 > N else (0 if 2 * c0 > N else BOT)   # d
+    w = 1 if c1 >= c0 else 0
+    c = c1 if w else c0
+    if c >= 2 * F + 1:
+        return ("decide", w)
+    if c >= F + 1:
+        return ("adopt", w)
+    return ("coin", None)
+
+
+def _product_over_receivers(recv_dists):
+    """Joint law over the N receivers' outcomes — delivery draws are
+    independent per receiver (spec §4b), so the joint is the product.
+    Profiles stay ordered (index 0 = faulty); canonicalization happens only
+    at round end."""
+    out = {}
+    for combo in itertools.product(*(d.items() for d in recv_dists)):
+        vals = tuple(v for v, _ in combo)
+        p = 1.0
+        for _, pi in combo:
+            p *= pi
+        out[vals] = out.get(vals, 0.0) + p
+    return out
+
+
+def _round_transitions(state, coin):
+    """{(next_state, all_correct_decided): prob} for one round."""
+    f_state, c_states = state
+    states = [f_state] + list(c_states)          # index 0 = faulty
+    ests = [s[0] for s in states]
+    decided = [s[1] for s in states]
+    out = {}
+
+    for o_vec in itertools.product(range(4), repeat=3):
+        p_o = 0.25 ** 3
+        # ---- step 0: honest values are the (frozen) estimates.
+        vals0, silent0 = _wire(ests, o_vec[0])
+        g0 = _live_counts(vals0, silent0)
+        # Per-receiver m distribution.
+        m_dists = []
+        for v in range(N):
+            others = [0, 0, 0]
+            for u in range(N):
+                if u != v and not silent0[u]:
+                    others[vals0[u]] += 1
+            dist_v = {}
+            for cnts, pc in _deliver_dist(vals0[v], others).items():
+                m = _derive(0, cnts)
+                dist_v[m] = dist_v.get(m, 0.0) + pc
+            m_dists.append(dist_v)
+        for m_prof, p_m in _product_over_receivers(m_dists).items():
+            # ---- step 1: honest values are the m's; validation vs g0.
+            vals1, silent1 = _wire(m_prof, o_vec[1])
+            silent1 = _apply_validation(1, vals1, silent1, g0)
+            g1 = _live_counts(vals1, silent1)
+            d_dists = []
+            for v in range(N):
+                others = [0, 0, 0]
+                for u in range(N):
+                    if u != v and not silent1[u]:
+                        others[vals1[u]] += 1
+                dist_v = {}
+                for cnts, pc in _deliver_dist(vals1[v], others).items():
+                    d = _derive(1, cnts)
+                    dist_v[d] = dist_v.get(d, 0.0) + pc
+                d_dists.append(dist_v)
+            for d_prof, p_d in _product_over_receivers(d_dists).items():
+                # ---- step 2: honest values are the d's; validation vs g1.
+                vals2, silent2 = _wire(d_prof, o_vec[2])
+                silent2 = _apply_validation(2, vals2, silent2, g1)
+                act_dists = []
+                for v in range(N):
+                    others = [0, 0, 0]
+                    for u in range(N):
+                        if u != v and not silent2[u]:
+                            others[vals2[u]] += 1
+                    dist_v = {}
+                    for cnts, pc in _deliver_dist(vals2[v], others).items():
+                        act = _derive(2, cnts)
+                        dist_v[act] = dist_v.get(act, 0.0) + pc
+                    act_dists.append(dist_v)
+                for acts, p_a in _product_over_receivers(act_dists).items():
+                    p_base = p_o * p_m * p_d * p_a
+                    # ---- end of round: coin branches.
+                    users = [v for v in range(N)
+                             if not decided[v] and acts[v][0] == "coin"]
+                    if coin == "shared":
+                        coin_branches = [((b,) * N, 0.5) for b in (0, 1)] \
+                            if users else [((0,) * N, 1.0)]
+                    else:
+                        coin_branches = []
+                        for bits in itertools.product((0, 1), repeat=len(users)):
+                            full = [0] * N
+                            for v, b in zip(users, bits):
+                                full[v] = b
+                            coin_branches.append((tuple(full), 0.5 ** len(users)))
+                    for coins, p_c in coin_branches:
+                        nest, ndec = list(ests), list(decided)
+                        for v in range(N):
+                            if decided[v]:
+                                continue
+                            kind, w = acts[v]
+                            if kind == "decide":
+                                ndec[v] = True
+                                nest[v] = w
+                            elif kind == "adopt":
+                                nest[v] = w
+                            else:
+                                nest[v] = coins[v]
+                        ns = ((nest[0], ndec[0]),
+                              tuple(sorted(zip(nest[1:], ndec[1:]))))
+                        done = all(ndec[1:])
+                        key = (ns, done)
+                        out[key] = out.get(key, 0.0) + p_base * p_c
+    return out
+
+
+@lru_cache(maxsize=4)
+def rounds_law(coin: str = "shared"):
+    """Solve the chain exactly: returns (E_by_state, P1_by_state) where
+    E is E[rounds to all-correct-decided | state] and P1 the probability the
+    correct replicas' common decision is 1."""
+    initial = set()
+    for bits in itertools.product((0, 1), repeat=N):
+        initial.add(((bits[0], False), tuple(sorted((e, False) for e in bits[1:]))))
+    todo = list(initial)
+    trans = {}
+    while todo:
+        s = todo.pop()
+        if s in trans:
+            continue
+        t = _round_transitions(s, coin)
+        trans[s] = t
+        for (ns, done) in t:
+            if not done and ns not in trans:
+                todo.append(ns)
+    states = sorted(trans)
+    idx = {s: k for k, s in enumerate(states)}
+    n = len(states)
+    A = np.eye(n)
+    b = np.ones(n)
+    A1 = np.eye(n)
+    b1 = np.zeros(n)
+    for s, ts in trans.items():
+        i = idx[s]
+        for (ns, done), p in ts.items():
+            if done:
+                # Terminal this round: rounds contribution already in b;
+                # decision value = the correct replicas' common decided_val.
+                vals = {e for e, d in ns[1]}
+                assert len(vals) == 1, f"agreement violation in chain: {ns}"
+                if vals.pop() == 1:
+                    b1[i] += p
+            else:
+                A[i, idx[ns]] -= p
+                A1[i, idx[ns]] -= p
+    E = np.linalg.solve(A, b)
+    P1 = np.linalg.solve(A1, b1)
+    return ({s: float(E[idx[s]]) for s in states},
+            {s: float(P1[idx[s]]) for s in states})
+
+
+@lru_cache(maxsize=4)
+def expected_rounds_bracha_n4(coin: str = "shared") -> float:
+    """E[rounds], initial estimates iid uniform (incl. the faulty one)."""
+    E, _ = rounds_law(coin)
+    tot = 0.0
+    for bits in itertools.product((0, 1), repeat=N):
+        s = ((bits[0], False), tuple(sorted((e, False) for e in bits[1:])))
+        tot += E[s]
+    return tot / 2 ** N
+
+
+@lru_cache(maxsize=4)
+def p_decide_one_bracha_n4(coin: str = "shared") -> float:
+    """P[common decision = 1], initial estimates iid uniform. Exactly 1/2:
+    at n=4 the delivered step-0/1 count is always 3 (odd — the m/d ties→1
+    rules never fire) and a step-2 tie forces c ≤ 1 (the coin branch), so
+    every ties→1 rule is outcome-irrelevant and the chain is 0↔1 symmetric
+    (spec §8b). At larger n the tie-breaks do bias toward 1."""
+    _, P1 = rounds_law(coin)
+    tot = 0.0
+    for bits in itertools.product((0, 1), repeat=N):
+        s = ((bits[0], False), tuple(sorted((e, False) for e in bits[1:])))
+        tot += P1[s]
+    return tot / 2 ** N
+
+
+if __name__ == "__main__":
+    for coin in ("shared", "local"):
+        E, P1 = rounds_law(coin)
+        print(f"coin={coin}: reachable undecided states: {len(E)}")
+        print(f"  E[rounds]  (uniform init) = {expected_rounds_bracha_n4(coin):.6f}")
+        print(f"  P[decide 1](uniform init) = {p_decide_one_bracha_n4(coin):.6f}")

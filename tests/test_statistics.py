@@ -77,6 +77,56 @@ def test_mean_rounds_matches_exact_markov_constant():
                               f"{exact:.6f} (z={z:+.2f})")
 
 
+def test_mean_rounds_matches_exact_bracha_chain():
+    """Mean rounds-to-decision for Bracha n=4 f=1 under the *Byzantine*
+    adversary against the exact spec/analytic_bracha.py enumeration (VERDICT
+    r2 #8; spec §8b). This is the analytic pin for the §5.1b validation logic
+    and the three-step round body: E[rounds] = 1.244628 (shared coin) /
+    1.313035 (local coin), identically for both delivery models. The chain is
+    re-derived here (≈6 s, cached) so a drift in either the enumeration or
+    the pinned constants fails loudly."""
+    from spec.analytic_bracha import expected_rounds_bracha_n4
+
+    pinned = {"shared": 1.244628, "local": 1.313035}
+    for coin, want in pinned.items():
+        exact = expected_rounds_bracha_n4(coin)
+        assert abs(exact - want) < 1e-5, \
+            f"enumeration drifted from the pinned spec §8b value ({coin})"
+    for coin in ("shared", "local"):
+        for delivery in ("urn", "keys"):
+            cfg = SimConfig(protocol="bracha", n=4, f=1, instances=8000,
+                            adversary="byzantine", coin=coin, round_cap=64,
+                            seed=47, delivery=delivery)
+            res = Simulator(cfg, "numpy").run()
+            r = res.rounds.astype(np.float64)
+            sem = r.std(ddof=1) / np.sqrt(len(r))
+            z = (r.mean() - pinned[coin]) / sem
+            assert abs(z) < 4.5, (
+                f"{coin}/{delivery}: mean {r.mean():.4f} vs exact "
+                f"{pinned[coin]:.6f} (z={z:+.2f})")
+            # The decision-value law on the same runs: P[1] = 1/2 exactly
+            # (spec §8b), for every coin x delivery leg.
+            d = res.decision
+            assert (d != 2).all()
+            assert _chi2_fair(int((d == 0).sum()),
+                              int((d == 1).sum())) < CHI2_1DOF_P001, \
+                f"{coin}/{delivery}: decision split off 1/2"
+
+
+def test_bracha_decision_split_matches_exact_chain():
+    """The chain's decision-value law: P[decide 1] = 1/2 exactly at uniform
+    init, both coins. Not an accident: at n=4 f=1 the delivered step-0/1
+    count is always 3 (odd — the m/d ties→1 breaks never fire) and a step-2
+    tie forces c ≤ 1, i.e. the coin branch, so w's tie-break is
+    outcome-irrelevant — the chain is fully 0↔1 symmetric (spec §8b). The
+    simulation legs live in test_mean_rounds_matches_exact_bracha_chain,
+    which chi-squares the decision split of every coin x delivery run."""
+    from spec.analytic_bracha import p_decide_one_bracha_n4
+
+    assert abs(p_decide_one_bracha_n4("shared") - 0.5) < 1e-9
+    assert abs(p_decide_one_bracha_n4("local") - 0.5) < 1e-9
+
+
 def test_rabin_configuration_constant_rounds():
     """Rabin (FOCS 1983) = Ben-Or's rounds + a common lottery coin — the
     `protocol="benor", coin="shared"` configuration (spec §5.3). Its defining
