@@ -287,9 +287,8 @@ def rounds_law(coin: str = "shared"):
     idx = {s: k for k, s in enumerate(states)}
     n = len(states)
     A = np.eye(n)
-    b = np.ones(n)
-    A1 = np.eye(n)
-    b1 = np.zeros(n)
+    b = np.ones(n)       # E[rounds]: +1 per round taken
+    b1 = np.zeros(n)     # P[decide 1]: terminal mass on decision 1
     for s, ts in trans.items():
         i = idx[s]
         for (ns, done), p in ts.items():
@@ -302,9 +301,9 @@ def rounds_law(coin: str = "shared"):
                     b1[i] += p
             else:
                 A[i, idx[ns]] -= p
-                A1[i, idx[ns]] -= p
-    E = np.linalg.solve(A, b)
-    P1 = np.linalg.solve(A1, b1)
+    # Same transition matrix for both first-step systems: one solve, two RHS.
+    sol = np.linalg.solve(A, np.stack([b, b1], axis=1))
+    E, P1 = sol[:, 0], sol[:, 1]
     return ({s: float(E[idx[s]]) for s in states},
             {s: float(P1[idx[s]]) for s in states})
 

@@ -15,11 +15,26 @@ Real-TPU runs (bench.py, CLI) are unaffected — this applies to the test proces
 """
 
 import os
+import pathlib
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Persistent XLA compilation cache: the suite is compile-bound (~100 distinct
+# jit programs x 2-6 s of XLA CPU compile each — measured, VERDICT r2 #5), and
+# the programs are identical run-to-run, so the second and every later suite
+# run skips almost all of it (test_sharded.py alone: 39 s cold -> 16 s warm).
+# Repo-local and gitignored; JAX_COMPILATION_CACHE_DIR overrides, empty
+# disables. min_compile_time=0 + min_entry_size=-1: cache even the tiny eager
+# op executables that interpret-mode Pallas tests churn through.
+if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = str(
+        pathlib.Path(__file__).resolve().parent.parent / ".jax_cache")
+if os.environ["JAX_COMPILATION_CACHE_DIR"]:
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
 
 
 def _force_cpu_backend() -> None:

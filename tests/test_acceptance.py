@@ -22,7 +22,9 @@ pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
 
 # CI sample counts: big enough to dwarf the oracle-sampled checks (3-6 ids),
 # small enough to keep the suite quick; the >=10^3 runs live in the artifact.
-CI_SAMPLES = {"urn": 192, "keys": 48}
+# keys at benchmark n costs ~0.5 s/instance on the 1-core box, so its CI
+# count is the suite-budget compromise (VERDICT r2 #5).
+CI_SAMPLES = {"urn": 192, "keys": 24}
 
 
 @pytest.mark.parametrize("delivery", ["urn", "keys"])
@@ -34,6 +36,26 @@ def test_at_scale_native_arbiter(name, delivery):
     bad = {b: rec for b, rec in entry["backends"].items()
            if not rec.get("match")}
     assert not bad, f"{name}:{delivery} mismatches vs native: {bad}"
+
+
+@pytest.mark.slow
+def test_config2_shipped_round_cap():
+    """Config 2 at its SHIPPED round cap (256) — the artifact runs lower the
+    cap to 64 for cost (ACCEPT_ROUND_CAP, PRF-addressing argument), so this is
+    the one leg that bit-matches the exact shipped config-2 surface
+    (VERDICT r2 #7): ~100 sampled instances, native vs jax, 0 mismatches."""
+    from byzantinerandomizedconsensus_tpu.config import preset
+
+    cfg = preset("config2")
+    assert cfg.round_cap == 256, "config2 shipped cap changed — update this test"
+    ids = acceptance.sample_ids(cfg, 100, "config2:shipped-cap")
+    ref = get_backend("native").run(cfg, ids)
+    got = get_backend("jax").run(cfg, ids)
+    np.testing.assert_array_equal(ref.rounds, got.rounds)
+    np.testing.assert_array_equal(ref.decision, got.decision)
+    # Local coin at f=(n-1)//3: most instances cap out, so the leg genuinely
+    # exercises the 256-round overflow surface.
+    assert (got.decision == 2).any()
 
 
 @pytest.mark.slow

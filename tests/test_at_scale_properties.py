@@ -80,9 +80,9 @@ def test_invariants_urn_at_benchmark_n(n, f, adversary):
 
 
 @pytest.mark.parametrize("n,f,adversary,instances", [
-    (256, 85, "byzantine", 100),
-    (256, 85, "adaptive", 100),
-    (512, 170, "byzantine", 64),
+    (256, 85, "byzantine", 64),
+    (256, 85, "adaptive", 64),
+    (512, 170, "byzantine", 32),
 ])
 @pytest.mark.slow
 def test_invariants_keys_at_benchmark_n(n, f, adversary, instances):
@@ -102,7 +102,7 @@ def test_validity_unanimous_urn_at_benchmark_n(n, f, adversary, init, expect):
 @pytest.mark.parametrize("init,expect", [("all0", 0), ("all1", 1)])
 @pytest.mark.slow
 def test_validity_unanimous_keys_at_benchmark_n(init, expect):
-    cfg, res, state, faulty = _run(256, 85, "byzantine", "keys", instances=64,
+    cfg, res, state, faulty = _run(256, 85, "byzantine", "keys", instances=48,
                                    init=init)
     _assert_invariants(cfg, res, state, faulty, expect_value=expect)
 

@@ -52,10 +52,13 @@ def test_bitmatch_xla_nosort_grid(proto, adv):
     np.testing.assert_array_equal(a.decision, b.decision)
 
 
+@pytest.mark.slow
 def test_bitmatch_sharded_composition():
     """Fused kernel inside shard_map: receiver-shard offsets keep PRF addressing
     global, so the replica-sharded mesh bit-matches the reference path. (One
-    mesh shape at driver level; shard-offset breadth is step-level.)"""
+    mesh shape at driver level; shard-offset breadth is step-level. Slow: a
+    second ~20 s interpret-mode driver trace — the composition it adds over
+    test_bitmatch_full_driver + the step-level offset grid is mesh plumbing.)"""
     from byzantinerandomizedconsensus_tpu.parallel.mesh import make_mesh
     from byzantinerandomizedconsensus_tpu.parallel.sharded import JaxShardedBackend
 

@@ -23,7 +23,7 @@ from byzantinerandomizedconsensus_tpu.models import benor, bracha, state as stat
 from byzantinerandomizedconsensus_tpu.models.adversaries import AdversaryModel
 
 
-def _run_rounds(cfg, counts_fn, n_rounds=2):
+def _run_rounds(cfg, counts_fn, n_rounds):
     """Eager round_body applications; returns the per-round state snapshots."""
     ids = jnp.arange(cfg.instances, dtype=jnp.uint32)
     round_body = benor.round_body if cfg.protocol == "benor" else bracha.round_body
@@ -38,9 +38,9 @@ def _run_rounds(cfg, counts_fn, n_rounds=2):
     return out
 
 
-def _assert_rounds_equal(cfg, ref_counts_fn, got_counts_fn):
-    ref = _run_rounds(cfg, ref_counts_fn)
-    got = _run_rounds(cfg, got_counts_fn)
+def _assert_rounds_equal(cfg, ref_counts_fn, got_counts_fn, n_rounds=2):
+    ref = _run_rounds(cfg, ref_counts_fn, n_rounds)
+    got = _run_rounds(cfg, got_counts_fn, n_rounds)
     for r, (a, b) in enumerate(zip(ref, got)):
         assert sorted(a) == sorted(b)
         for k in a:
@@ -48,66 +48,84 @@ def _assert_rounds_equal(cfg, ref_counts_fn, got_counts_fn):
                                           err_msg=f"round {r} field {k}")
 
 
+# Interpret-mode pallas_call cost is dominated by per-call tracing (~flat in
+# the batch size, linear in rounds x steps — measured), so the default subset
+# is a *covering* one (VERDICT r2 #5): one config per adversary class plus
+# the tile-boundary shapes (which themselves carry the byzantine/adaptive
+# classes for the keys kernel), small batches, mostly one round. The kernels
+# under test are stateless per step (counts_fn sees only this step's values/
+# silences/faulty planes), so round-2 runs buy different *inputs* — decided
+# replicas, validation-silenced senders — not different kernel code paths;
+# the slow-marked entries carry that second-round input coverage for the
+# fault-injecting adversary classes; the tile shapes stay one-round.
+
 URN_STEP = [
-    SimConfig(protocol="benor", n=4, f=1, instances=16, adversary="none",
-              coin="local", round_cap=8, seed=0, delivery="urn"),
-    SimConfig(protocol="benor", n=9, f=4, instances=16, adversary="crash",
-              coin="local", round_cap=8, seed=1, delivery="urn"),
-    SimConfig(protocol="benor", n=16, f=3, instances=16, adversary="byzantine",
-              coin="local", round_cap=8, seed=2, delivery="urn"),  # two-faced
-    SimConfig(protocol="benor", n=11, f=2, instances=16, adversary="adaptive",
-              coin="shared", round_cap=8, seed=3, delivery="urn"),
-    SimConfig(protocol="bracha", n=10, f=3, instances=16, adversary="byzantine",
-              coin="shared", round_cap=8, seed=4, delivery="urn"),
-    SimConfig(protocol="bracha", n=16, f=5, instances=16, adversary="adaptive",
-              coin="shared", round_cap=8, seed=5, delivery="urn"),
-    SimConfig(protocol="bracha", n=13, f=4, instances=16, adversary="crash",
-              coin="local", round_cap=8, seed=6, delivery="urn"),
+    # (cfg, n_rounds, slow)
+    (SimConfig(protocol="benor", n=4, f=1, instances=8, adversary="none",
+               coin="local", round_cap=8, seed=0, delivery="urn"), 2, False),
+    (SimConfig(protocol="benor", n=9, f=4, instances=8, adversary="crash",
+               coin="local", round_cap=8, seed=1, delivery="urn"), 2, True),
+    (SimConfig(protocol="benor", n=16, f=3, instances=8, adversary="byzantine",
+               coin="local", round_cap=8, seed=2, delivery="urn"), 1, False),  # two-faced
+    (SimConfig(protocol="benor", n=16, f=3, instances=8, adversary="byzantine",
+               coin="local", round_cap=8, seed=7, delivery="urn"), 2, True),   # two-faced, r2 inputs
+    (SimConfig(protocol="benor", n=11, f=2, instances=8, adversary="adaptive",
+               coin="shared", round_cap=8, seed=3, delivery="urn"), 2, True),
+    (SimConfig(protocol="bracha", n=10, f=3, instances=8, adversary="byzantine",
+               coin="shared", round_cap=8, seed=4, delivery="urn"), 1, False),
+    (SimConfig(protocol="bracha", n=16, f=5, instances=8, adversary="adaptive",
+               coin="shared", round_cap=8, seed=5, delivery="urn"), 2, False),
+    (SimConfig(protocol="bracha", n=13, f=4, instances=8, adversary="crash",
+               coin="local", round_cap=8, seed=6, delivery="urn"), 2, True),
 ]
 
 
 @pytest.mark.parametrize(
-    "cfg", URN_STEP,
-    ids=lambda c: f"{c.protocol}-n{c.n}f{c.f}-{c.adversary}")
-def test_urn_kernel_steps(cfg):
+    "cfg,n_rounds", [pytest.param(c, r, marks=[pytest.mark.slow] if s else [],
+                                  id=f"{c.protocol}-n{c.n}f{c.f}-{c.adversary}")
+                     for c, r, s in URN_STEP])
+def test_urn_kernel_steps(cfg, n_rounds):
     """Pallas urn kernel == XLA urn path through the real round body."""
     from byzantinerandomizedconsensus_tpu.ops import pallas_urn
 
     _assert_rounds_equal(
-        cfg, None, functools.partial(pallas_urn.counts_fn, interpret=True))
+        cfg, None, functools.partial(pallas_urn.counts_fn, interpret=True),
+        n_rounds=n_rounds)
 
 
 KEYS_STEP = [
-    SimConfig(protocol="benor", n=7, f=3, instances=16, adversary="none",
-              coin="shared", round_cap=8, seed=13),
-    SimConfig(protocol="benor", n=11, f=2, instances=16, adversary="byzantine",
-              coin="shared", round_cap=8, seed=13),
-    SimConfig(protocol="benor", n=7, f=3, instances=16, adversary="crash",
-              coin="local", round_cap=8, seed=5),
-    SimConfig(protocol="bracha", n=10, f=3, instances=16, adversary="crash",
-              coin="shared", round_cap=8, seed=13),
-    SimConfig(protocol="bracha", n=10, f=3, instances=16, adversary="byzantine",
-              coin="shared", round_cap=8, seed=13),
-    SimConfig(protocol="bracha", n=16, f=5, instances=16, adversary="adaptive",
-              coin="shared", round_cap=8, seed=13),
+    (SimConfig(protocol="benor", n=7, f=3, instances=6, adversary="none",
+               coin="shared", round_cap=8, seed=13), 1, False),
+    (SimConfig(protocol="benor", n=11, f=2, instances=6, adversary="byzantine",
+               coin="shared", round_cap=8, seed=13), 2, True),
+    (SimConfig(protocol="benor", n=7, f=3, instances=6, adversary="crash",
+               coin="local", round_cap=8, seed=5), 1, False),
+    (SimConfig(protocol="bracha", n=10, f=3, instances=6, adversary="crash",
+               coin="shared", round_cap=8, seed=13), 2, True),
+    (SimConfig(protocol="bracha", n=10, f=3, instances=6, adversary="byzantine",
+               coin="shared", round_cap=8, seed=13), 2, True),
+    (SimConfig(protocol="bracha", n=16, f=5, instances=6, adversary="adaptive",
+               coin="shared", round_cap=8, seed=13), 2, True),
     # Tile boundaries: n == lane width, and n straddling two receiver tiles.
-    SimConfig(protocol="bracha", n=128, f=42, instances=8, adversary="byzantine",
-              coin="shared", round_cap=4, seed=2),
-    SimConfig(protocol="bracha", n=200, f=66, instances=8, adversary="adaptive",
-              coin="shared", round_cap=4, seed=2),
+    (SimConfig(protocol="bracha", n=128, f=42, instances=4, adversary="byzantine",
+               coin="shared", round_cap=4, seed=2), 1, False),
+    (SimConfig(protocol="bracha", n=200, f=66, instances=4, adversary="adaptive",
+               coin="shared", round_cap=4, seed=2), 1, False),
 ]
 
 
 @pytest.mark.parametrize(
-    "cfg", KEYS_STEP,
-    ids=lambda c: f"{c.protocol}-n{c.n}f{c.f}-{c.adversary}")
-def test_keys_kernel_steps(cfg):
+    "cfg,n_rounds", [pytest.param(c, r, marks=[pytest.mark.slow] if s else [],
+                                  id=f"{c.protocol}-n{c.n}f{c.f}-{c.adversary}")
+                     for c, r, s in KEYS_STEP])
+def test_keys_kernel_steps(cfg, n_rounds):
     """Fused Pallas selection+tally kernel == XLA masks+tally path through the
     real round body (incl. the tile-boundary shapes)."""
     from byzantinerandomizedconsensus_tpu.ops import pallas_tally
 
     _assert_rounds_equal(
-        cfg, None, functools.partial(pallas_tally.counts_fn, interpret=True))
+        cfg, None, functools.partial(pallas_tally.counts_fn, interpret=True),
+        n_rounds=n_rounds)
 
 
 @pytest.mark.parametrize("lo,hi", [(0, 5), (5, 11), (11, 16)])

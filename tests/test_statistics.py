@@ -132,7 +132,7 @@ def test_rabin_configuration_constant_rounds():
     `protocol="benor", coin="shared"` configuration (spec §5.3). Its defining
     property vs plain Ben-Or: expected O(1) rounds even at f = Θ(n), where the
     local coin saturates the cap."""
-    base = dict(protocol="benor", n=32, f=15, instances=400, adversary="crash",
+    base = dict(protocol="benor", n=32, f=15, instances=200, adversary="crash",
                 round_cap=64, seed=44)
     rabin = Simulator(SimConfig(coin="shared", **base), "numpy").run()
     benor = Simulator(SimConfig(coin="local", **base), "numpy").run()
