@@ -7,15 +7,23 @@ spec has a fourth bit-exact lowering for tests — it is *not* part of the
 advertised fast-path surface. The product urn path is the XLA lowering in
 ops/urn.py (backends/jax_backend.py default).
 
-**Measured (v5e, config 4): the XLA path wins by ~17×.** ops/urn.py's unrolled
-``fori_loop`` reaches ~280k instances/s while this kernel reaches ~13k,
-invariant to tile/block shape — the sequential in-kernel draw loop (two uint32
-multiplies per draw) lowers poorly under Mosaic compared to XLA's fusion of the
-same arithmetic. A known restructuring remains open if this ever needs to be a
-perf path (docs/NEXT.md item 2): the LCG states are affine in the start state
-(s_j = A^j·s_0 + C_j with compile-time A^j, C_j tables), and in the
-single-stratum case the urn size L−j is deterministic, so both multiplies
-vectorize over j and only a cheap compare/subtract scan stays sequential.
+**Measured (v5e, config 4): the XLA path wins by ~21×, and round 3 proved
+that is NOT a dependency-structure problem.** The single-stratum path below
+implements the affine-LCG restructuring that was designed for exactly this
+experiment (docs/NEXT.md item 2, VERDICT r2 #3): s_{j+1} = A^{j+1}·s_0 +
+C_{j+1} with compile-time tables and deterministic urn size L−j, so every
+multiply and range reduction is draw-independent and only a two-compare/
+two-subtract scan carries a dependency. Result (docs/PERF.md round 3): the
+sequential loop kernel ran ~13k inst/s, the affine kernel 12.5–13.1k across
+block shapes, and a diagnostic variant with the scan dependency severed
+entirely (independent picks — wrong results, timing only) 14.3k. Mosaic is
+op-*throughput*-bound on this scalar-dense integer program — ~8 vector ops ×
+f=170 draws per step at near-constant cost per emitted op — not
+latency-bound, so no restructuring of the draw recurrence can close the gap;
+XLA's fusion of the identical arithmetic (ops/urn.py) stays the product
+path. The affine form is kept as the cross-check kernel (it replaced the
+sequential single-stratum loop; the two-stratum sequential loop remains only
+for the adaptive adversary, where the urn size is pick-dependent).
 
 Design: holds the whole per-(instance-block, receiver-tile) urn state — LCG
 streams and the remaining-count planes — in VMEM/registers for all f draws:
@@ -106,6 +114,33 @@ def _urn_kernel(params_ref, v0_ref, v1_ref, silent_ref, inst_ref, ownv_ref,
     x1 = (rnd << u(16)) | (recv << u(6)) | u((step << 4) | prf.URN)
     s = _threefry2x32(k0, k1, jnp.broadcast_to(inst, recv.shape), x1)
 
+    if not adaptive and f > 0:
+        # Affine-LCG restructuring (docs/NEXT.md item 2, VERDICT r2 #3).
+        # s_{j+1} = A^{j+1}·s_0 + C_{j+1} with compile-time scalar tables, so
+        # every draw's LCG state, xorshift, and multiply-shift range reduction
+        # (single stratum ⇒ deterministic urn size L−j) is j-independent
+        # vector arithmetic; only the without-replacement compare/subtract
+        # scan — two compares, two masked subtracts per draw — carries a
+        # loop dependency. Algebraically draw-for-draw identical to the
+        # sequential form (uint32 wraparound throughout).
+        r0, r1 = rem[0], rem[1]
+        a_j, c_j, M = 1, 0, 1 << 32
+        for j in range(f):
+            a_j = (a_j * prf.URN_LCG_A) % M
+            c_j = (c_j * prf.URN_LCG_A + prf.URN_LCG_C) % M
+            sj = s * u(a_j) + u(c_j)
+            uu = sj ^ (sj >> u(16))
+            active = i32(j) < D
+            R_cur = (tot0 - i32(j)).astype(u)   # garbage if inactive (masked)
+            d = ((uu >> u(10)) * R_cur) >> u(22)
+            pick0 = d < r0.astype(u)
+            pick1 = ~pick0 & (d < (r0 + r1).astype(u))
+            r0 = r0 - (pick0 & active).astype(i32)
+            r1 = r1 - (pick1 & active).astype(i32)
+        c0_ref[...] = r0 + (own_val == 0).astype(i32)
+        c1_ref[...] = r1 + (own_val == 1).astype(i32)
+        return
+
     def draw(j, carry):
         s, r0, r1, r2 = carry
         s = s * u(prf.URN_LCG_A) + u(prf.URN_LCG_C)
@@ -175,7 +210,7 @@ def step_counts(cfg, inst_ids, rnd, step, v0c, v1c, silent,
     n_pad = -(-n // 128) * 128 if n > 8 else 8
     r_tiles = -(-n_recv // tile_r)
     r_pad = r_tiles * tile_r
-    block_b = 8
+    block_b = 32
     b_blocks = -(-B // block_b)
     B_pad = b_blocks * block_b
 
