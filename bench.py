@@ -14,9 +14,6 @@ from __future__ import annotations
 
 import json
 import sys
-import time
-
-import numpy as np
 
 
 TARGET_INST_PER_SEC = 100_000 / 60.0  # north-star: 100k instances < 60 s
@@ -67,7 +64,7 @@ def _prev_round_headline():
 def main() -> int:
     import os
 
-    from byzantinerandomizedconsensus_tpu import Simulator, preset
+    from byzantinerandomizedconsensus_tpu import preset
 
     from byzantinerandomizedconsensus_tpu.backends import get_backend
 
@@ -96,21 +93,12 @@ def main() -> int:
     if delivery is not None:
         overrides["delivery"] = delivery
     cfg = preset("config4", **overrides)
-    sim = Simulator(cfg, backend)
 
-    # Warm-up: compile the round kernel at the exact chunk shape the timed run uses
-    # (a smaller warm-up batch would compile a different program and leave the real
-    # compile inside the timed window).
-    chunk = min(get_backend(backend)._chunk_size(cfg), instances)
-    sim.run(np.arange(chunk, dtype=np.int64))
+    # Warm-up compile at the exact run shape + best-of-two timed runs — the
+    # shared measurement discipline (utils/timing.py; docs/PERF.md).
+    from byzantinerandomizedconsensus_tpu.utils.timing import timed_best_of
 
-    # Best of two timed runs: latency through the tunnelled TPU varies ±10-15%
-    # run-to-run, and the throughput of the program is the quantity of interest.
-    walls = []
-    for _ in range(2):
-        t0 = time.perf_counter()
-        res = sim.run()
-        walls.append(time.perf_counter() - t0)
+    res, walls = timed_best_of(get_backend(backend), cfg, repeats=2)
     wall = min(walls)
 
     inst_per_sec = instances / wall

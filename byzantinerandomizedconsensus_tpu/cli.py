@@ -211,13 +211,18 @@ def main(argv=None) -> int:
     sub.add_parser("slack",
                    help="slack-vs-rounds boundary artifact (tools/slack.py; "
                         "all further options pass through)")
+    sub.add_parser("product",
+                   help="five-preset as-shipped product-run artifact "
+                        "(tools/product.py; all further options pass through)")
 
     if argv is None:
         argv = sys.argv[1:]
-    if argv and argv[0] in ("accept", "slack"):
-        from byzantinerandomizedconsensus_tpu.tools import acceptance, slack
+    if argv and argv[0] in ("accept", "slack", "product"):
+        from byzantinerandomizedconsensus_tpu.tools import (
+            acceptance, product, slack)
 
-        tool = acceptance if argv[0] == "accept" else slack
+        tool = {"accept": acceptance, "slack": slack,
+                "product": product}[argv[0]]
         return tool.main(argv[1:])
     args = ap.parse_args(argv)
     if getattr(args, "backend", "").startswith("jax"):

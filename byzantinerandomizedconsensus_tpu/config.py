@@ -85,6 +85,9 @@ def _f_opt(n: int) -> int:
 PRESETS: dict[str, SimConfig] = {
     "config1": SimConfig(protocol="benor", n=4, f=1, instances=1, adversary="none", coin="local", delivery="urn"),
     "config2": SimConfig(protocol="benor", n=64, f=21, instances=10_000, adversary="crash", coin="local", delivery="urn"),
+    # config3's instance count is the one preset field BASELINE.json leaves
+    # unspecified ("—"); 1000 is our choice (big enough for stable histograms,
+    # small enough for the oracle-anchored checks), not a [B] requirement.
     "config3": SimConfig(protocol="bracha", n=256, f=85, instances=1_000, adversary="byzantine", coin="shared", delivery="urn"),
     "config4": SimConfig(protocol="bracha", n=512, f=170, instances=100_000, adversary="none", coin="shared", delivery="urn"),
 }

@@ -1,0 +1,112 @@
+"""Five-preset product-run artifact (VERDICT r2 #1; SURVEY.md §0).
+
+The north star defines capability-equivalence by the five benchmark configs
+(BASELINE.json:6-12), but through round 2 only config 4 had a timed end-to-end
+artifact on the device of record. This tool runs **every preset exactly as
+shipped** — no cap lowering, no instance trimming — plus one config-5 sweep
+point, on one backend, and writes a single artifact recording per config:
+backend, platform, wall-clock, instances/sec, and the full round/decision
+histograms (the bit-match surface of spec §1).
+
+CLI: ``python -m byzantinerandomizedconsensus_tpu.tools.product``
+(or ``cli.py product``); writes ``artifacts/product_r3.json`` by default.
+Wall-clock methodology matches bench.py: compile outside the timed window
+(one warm-up run at the exact chunk shape), best-of-two timed runs, tunnel
+variance ±10-15% (docs/PERF.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+
+from byzantinerandomizedconsensus_tpu.backends import get_backend
+from byzantinerandomizedconsensus_tpu.config import (
+    PRESETS, SWEEP_INSTANCES, sweep_point)
+from byzantinerandomizedconsensus_tpu.utils import metrics
+from byzantinerandomizedconsensus_tpu.utils.timing import timed_best_of
+
+# The config-5 representative point: benchmark n (the headline scale) under
+# the sweep's adaptive adversary; the full n-sweep artifact lives in
+# artifacts/sweep_urn* (utils/sweep.py).
+SWEEP_POINT_N = 512
+
+
+def run_config(cfg, backend: str, timed_repeats: int = 2) -> dict:
+    """One shipped config end-to-end: warm-up compile, then best-of-N
+    (utils/timing.py — the same methodology as bench.py)."""
+    res, walls = timed_best_of(get_backend(backend), cfg, timed_repeats)
+    s = metrics.summary(res)
+    s["round_histogram"] = metrics.round_histogram(res).tolist()
+    best = min(walls)
+    s.update(
+        backend=backend,
+        wall_s=round(best, 3),
+        walls_s=[round(w, 3) for w in walls],
+        instances_per_sec=round(cfg.instances / best, 1),
+    )
+    return s
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Run all five benchmark configs as shipped; write the "
+                    "product artifact")
+    ap.add_argument("--out", default="artifacts/product_r3.json")
+    ap.add_argument("--backend", default="jax",
+                    help="product backend for every leg (default jax)")
+    ap.add_argument("--configs", nargs="*",
+                    default=[*PRESETS, "config5"],
+                    choices=[*PRESETS, "config5"],
+                    help="subset to run (merged into an existing artifact)")
+    args = ap.parse_args(argv)
+
+    if args.backend.partition(":")[0].startswith("jax"):
+        from byzantinerandomizedconsensus_tpu.utils.devices import (
+            ensure_live_backend)
+
+        ensure_live_backend()  # never hang on a dead TPU tunnel
+        import jax
+
+        platform = jax.default_backend()
+    else:
+        platform = "host"  # cpu/numpy/native legs never touch a device
+    path = pathlib.Path(args.out)
+    art = json.loads(path.read_text()) if path.exists() else {}
+    art.setdefault(
+        "description",
+        "All five benchmark configs (BASELINE.json:6-12) run end-to-end AS "
+        "SHIPPED (tools/product.py): per config, wall-clock/instances-per-sec "
+        "(warmed, best-of-two) and the full round/decision histograms")
+    for name in args.configs:
+        if name == "config5":
+            cfg = sweep_point(SWEEP_POINT_N)
+            label = (f"config5@n{SWEEP_POINT_N} (sweep point, "
+                     f"{SWEEP_INSTANCES} instances; full sweep: "
+                     "artifacts/sweep_urn*)")
+        else:
+            cfg = PRESETS[name].validate()
+            label = name
+        print(f"{label}: n={cfg.n} f={cfg.f} x{cfg.instances} "
+              f"{cfg.adversary}/{cfg.coin} cap={cfg.round_cap}", flush=True)
+        entry = run_config(cfg, args.backend)
+        entry["platform"] = platform
+        art[name] = entry
+        print(json.dumps({k: entry[k] for k in
+                          ("wall_s", "instances_per_sec", "undecided_at_cap",
+                           "mean_rounds_decided")}), flush=True)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(art, indent=1, sort_keys=True) + "\n")
+    ran = {k: v for k, v in art.items() if k != "description"}
+    print(json.dumps({
+        "out": str(path),
+        "platform": platform,
+        "configs": sorted(ran),
+        "total_wall_s": round(sum(v["wall_s"] for v in ran.values()), 2),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,38 @@
+"""Shared wall-clock methodology for the headline bench and product artifacts.
+
+One implementation of the measurement discipline docs/PERF.md prescribes for
+the tunnelled TPU (bench.py and tools/product.py must not diverge):
+
+- compile OUTSIDE the timed window — one warm-up run at the exact chunk shape
+  the timed run uses (a smaller warm-up batch would compile a different
+  program and leave the real compile inside the timing);
+- best-of-N timed full runs (tunnel latency varies ±10-15% run-to-run and the
+  program's throughput is the quantity of interest);
+- rates computed from the unrounded minimum (rounding first can zero a
+  sub-millisecond leg).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def timed_best_of(be, cfg, repeats: int = 2):
+    """(result, walls) — warmed, ``repeats`` timed full runs of ``cfg``.
+
+    ``be`` is a backend instance. Backends without a ``_chunk_size`` (the
+    pure-host cpu/native paths) have nothing to compile, so they skip the
+    warm-up instead of paying a full extra run.
+    """
+    chunk_size = getattr(be, "_chunk_size", None)
+    if chunk_size is not None:
+        chunk = min(chunk_size(cfg), cfg.instances)
+        be.run(cfg, np.arange(chunk, dtype=np.int64))
+    walls, res = [], None
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        res = be.run(cfg)
+        walls.append(time.perf_counter() - t0)
+    return res, walls

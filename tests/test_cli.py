@@ -96,6 +96,22 @@ def test_accept_subcommand_passthrough(capsys, tmp_path):
     assert out["all_match"] is True
 
 
+def test_product_subcommand_passthrough(capsys, tmp_path):
+    """`cli product` runs shipped configs end-to-end and merges an artifact."""
+    # Partial-run merge: a pre-existing entry from another invocation (e.g.
+    # the TPU legs) must survive a later single-config run.
+    (tmp_path / "p.json").write_text(json.dumps(
+        {"config4": {"wall_s": 0.37, "instances_per_sec": 272479.6}}))
+    rc, out = _run_cli(capsys, [
+        "product", "--out", str(tmp_path / "p.json"), "--backend", "numpy",
+        "--configs", "config1"])
+    assert rc == 0 and out["configs"] == ["config1", "config4"]
+    art = json.loads((tmp_path / "p.json").read_text())
+    assert art["config1"]["round_cap"] == 256  # as shipped, never lowered
+    assert sum(art["config1"]["round_histogram"]) == 1
+    assert art["config4"]["instances_per_sec"] == 272479.6
+
+
 def test_slack_subcommand_passthrough(capsys, tmp_path):
     rc, out = _run_cli(capsys, [
         "slack", "--out", str(tmp_path / "s.json"),
