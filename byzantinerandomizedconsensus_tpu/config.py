@@ -21,6 +21,21 @@ DEFAULT_ROUND_CAP = 256
 
 @dataclasses.dataclass(frozen=True)
 class SimConfig:
+    """One simulation configuration (spec/PROTOCOL.md §7).
+
+    ⚠ ``delivery`` defaults to ``"keys"`` — the spec-§4 O(n²)-mask
+    *validation* model — while every benchmark preset and the CLI/bench
+    product surface pin ``delivery="urn"`` (spec §4b), the product
+    semantics and the fast path. The bare-constructor default is kept at
+    "keys" deliberately: ad-hoc ``SimConfig(...)`` users are usually doing
+    spec-§4 cross-model work, and flipping it now would silently change
+    the sampled delivery schedule (and thus the bit-match surface) of
+    every existing bare-constructor call site — tests, golden vectors,
+    fuzz harnesses — with no signature change to flag it. If you want the
+    benchmark semantics, go through ``preset(...)``/``sweep_point(...)``
+    or pass ``delivery="urn"`` explicitly.
+    """
+
     protocol: Protocol = "benor"
     n: int = 4
     f: int = 1
