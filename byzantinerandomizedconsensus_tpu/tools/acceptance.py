@@ -12,7 +12,7 @@ The Python object oracle (backends/cpu.py) is the semantic arbiter but costs
    sampled instances per preset x delivery (`check_at_scale`).
 
 `python -m byzantinerandomizedconsensus_tpu.tools.acceptance` writes/merges
-`artifacts/acceptance_r2.json`. Separate invocations merge into one artifact,
+`artifacts/acceptance_r3.json`. Separate invocations merge into one artifact,
 so the TPU legs (jax, jax_pallas) and the virtual-mesh sharded legs can be
 generated in different environments. tests/test_acceptance.py runs the same
 functions at reduced sample counts in CI.
@@ -222,7 +222,7 @@ def merge_artifact(path: pathlib.Path, anchor: dict | None,
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Generate/merge the at-scale acceptance artifact")
-    ap.add_argument("--out", default="artifacts/acceptance_r2.json")
+    ap.add_argument("--out", default="artifacts/acceptance_r3.json")
     ap.add_argument("--samples", type=int, default=1000)
     ap.add_argument("--presets", nargs="*", default=list(DEFAULT_PRESETS))
     ap.add_argument("--deliveries", nargs="*", default=list(DEFAULT_DELIVERIES),

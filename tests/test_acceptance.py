@@ -6,7 +6,7 @@ The anchoring chain: tests/test_native.py pins native to the Python object
 oracle across the protocol grid; test_bitmatch.py pins numpy/jax to the oracle
 on small configs and a few benchmark-n samples; here the (cheap) native core
 widens the benchmark-n sampled coverage by an order of magnitude in CI and by
-~10^3 in the artifact run (tools/acceptance.py, artifacts/acceptance_r2.json).
+~10^3 in the artifact run (tools/acceptance.py, artifacts/acceptance_r3.json).
 """
 
 import shutil
