@@ -110,6 +110,10 @@ PRESETS: dict[str, SimConfig] = {
 # Config 5 is a sweep (spec §7): bracha, adaptive adversary, shared coin.
 SWEEP_NS = (128, 256, 384, 512, 640, 768, 896, 1024)
 SWEEP_INSTANCES = 2_000
+# The single sweep point that stands in for config 5 wherever one config is
+# needed (tools/product.py, tools/acceptance.py): benchmark n, the headline
+# scale. Both tools import this so the two "config5" surfaces cannot diverge.
+SWEEP_POINT_N = 512
 
 
 def sweep_point(n: int, seed: int = 0, instances: int = SWEEP_INSTANCES) -> SimConfig:

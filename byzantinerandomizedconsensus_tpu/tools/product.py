@@ -23,14 +23,9 @@ import pathlib
 
 from byzantinerandomizedconsensus_tpu.backends import get_backend
 from byzantinerandomizedconsensus_tpu.config import (
-    PRESETS, SWEEP_INSTANCES, sweep_point)
+    PRESETS, SWEEP_INSTANCES, SWEEP_POINT_N, sweep_point)
 from byzantinerandomizedconsensus_tpu.utils import metrics
 from byzantinerandomizedconsensus_tpu.utils.timing import timed_best_of
-
-# The config-5 representative point: benchmark n (the headline scale) under
-# the sweep's adaptive adversary; the full n-sweep artifact lives in
-# artifacts/sweep_urn* (utils/sweep.py).
-SWEEP_POINT_N = 512
 
 
 def run_config(cfg, backend: str, timed_repeats: int = 2) -> dict:
