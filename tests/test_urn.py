@@ -165,6 +165,28 @@ def test_urn_counts_conservation():
     assert (c1 <= (values == 1).sum(-1)[:, None] + 1).all()
 
 
+def test_affine_lcg_tables_equal_iterated_lcg():
+    """The algebra behind the Pallas affine urn kernel (ops/pallas_urn.py,
+    spec §4b): s_{j+1} = A^{j+1}·s_0 + C_{j+1} mod 2^32 with the iteratively
+    built scalar tables must equal j+1 applications of the LCG, for every
+    draw index up to the benchmark f and arbitrary start states — pinned
+    directly so the kernel's compile-time tables carry an independent proof,
+    not just end-to-end bit-match evidence."""
+    from byzantinerandomizedconsensus_tpu.ops import prf
+
+    rng = np.random.default_rng(9)
+    s0 = rng.integers(0, 1 << 32, size=64, dtype=np.uint64)
+    M = 1 << 32
+    s_iter = s0.copy()
+    a_j, c_j = 1, 0
+    for j in range(preset("config4").f):
+        s_iter = (s_iter * prf.URN_LCG_A + prf.URN_LCG_C) % M
+        a_j = (a_j * prf.URN_LCG_A) % M
+        c_j = (c_j * prf.URN_LCG_A + prf.URN_LCG_C) % M
+        np.testing.assert_array_equal((s0 * a_j + c_j) % M, s_iter,
+                                      err_msg=f"draw {j}")
+
+
 def test_multiseed_run_large():
     """run_large shards across derived seeds; each shard reproduces exactly the
     standalone run of its derived config (spec §2 multi-seed contract)."""
