@@ -40,8 +40,9 @@ def _jax_pallas():
     """JAX backend with the Pallas kernels: the fused delivery+tally kernel is
     the TPU fast path for delivery='keys' (ops/pallas_tally.py); under
     delivery='urn' this selects the cross-check kernel (ops/pallas_urn.py),
-    which is ~17x slower than the default XLA urn path — use plain ``jax``
-    for urn performance."""
+    which is ~21x slower than the default XLA urn path (measured
+    op-throughput-bound, docs/PERF.md round 3) — use plain ``jax`` for urn
+    performance."""
     from byzantinerandomizedconsensus_tpu.backends.jax_backend import JaxBackend
 
     return JaxBackend(kernel="pallas")
