@@ -5,7 +5,8 @@ One round = 3 broadcast steps, each conceptually wrapped in Bracha reliable broa
 (echo > (n+f)/2, ready amplification at f+1, accept at 2f+1). RBC is simulated at the
 count level via its delivered guarantees under n > 3f (no equivocation within a step,
 all-or-nothing faulty outcomes) — see spec §5.2 for the adversary-completeness
-argument (SURVEY.md §7 hard-part 5). Thresholds: > n/2 absolute for decide-proposals,
+argument (SURVEY.md §7 hard-part 5), validated mechanically against the per-message
+echo/ready/accept oracle in spec/rbc_message.py (tests/test_rbc_message.py). Thresholds: > n/2 absolute for decide-proposals,
 2f+1 to decide, f+1 to adopt.
 """
 

@@ -1,0 +1,574 @@
+"""Message-level Bracha reliable-broadcast oracle — the spec §5.2 validation instrument.
+
+The production simulator models RBC at the *count level*: per (sender, step) the
+adversary picks an outcome in {silent, 0, 1, honest} and every correct receiver
+observes that common outcome, subject only to §4 delivery timing. That abstraction
+(SURVEY.md §7 hard-part 5) is the one assumption the cross-implementation bit-match
+web cannot check — all four backends (oracle, numpy, jax, C++) *share* it — so this
+module validates it from below with an independent per-message implementation of
+Bracha's echo/ready/accept protocol [Bracha, Information & Computation 75, 1987]:
+
+- :class:`Engine` simulates every protocol message (init/echo/ready) of up to n
+  concurrent RBC broadcasts under an adversarial message scheduler with eventual
+  delivery. Byzantine replicas send arbitrary scripted or reactive messages: full
+  per-receiver equivocation, targeted sends, threshold teasing, rushing.
+- The **quotient theorem** the count level relies on is asserted on every run:
+  at every delivery prefix no two correct receivers have accepted different values
+  from one sender (:meth:`Engine.check_safety`), and at quiescence acceptance is
+  all-or-nothing with one common value per sender, with protocol-honest senders
+  always accepted with the value they sent (:meth:`Engine.check_quiescence`).
+  Those two facts are exactly guarantees (1)/(2) of spec §5.2.
+- :func:`run_message_instance` re-runs the full §5.2 consensus round body on top
+  of message-level RBC — message-level §5.1b validation included — and must
+  reproduce the count-level oracle (backends/cpu.py) exactly: per-step RBC
+  outcomes equal the count-level wire, the per-receiver delivered sets equal the
+  §4 mask under the mask-realizing schedule, and the final (rounds, decision)
+  equals ``CpuBackend.run``.
+
+Driven by tests/test_rbc_message.py: achievability (every count-level knob has a
+message-level strategy realizing it, and only those outcomes ever occur), attack
+strategies (split-brain init/echo/ready equivocation under adversarial schedules,
+reactive rushing), the threshold boundary, and the instance-level oracle match at
+n ∈ {4, 7, 10, 13}.
+
+Pure scalar Python: this is an oracle-layer instrument (like spec/analytic_bracha),
+never a performance path.
+"""
+
+from __future__ import annotations
+
+import random
+from collections import namedtuple
+from typing import Callable, Iterable, Optional
+
+import numpy as np
+
+INIT, ECHO, READY = 0, 1, 2
+KIND_NAMES = ("init", "echo", "ready")
+
+# One point-to-point protocol message. ``inst`` identifies which sender's RBC
+# broadcast the message belongs to (Bracha tags messages with the originating
+# broadcast); ``src`` is authenticated by the channel, so INIT is only honored
+# when src == inst (a Byzantine replica cannot forge another's init).
+Msg = namedtuple("Msg", "inst kind value src dst")
+
+
+class _View:
+    """One receiver's Bracha bookkeeping for one RBC instance."""
+
+    __slots__ = ("echoed", "ready_sent", "accepted", "echo_from", "ready_from")
+
+    def __init__(self):
+        self.echoed = None      # value this receiver echoed (first init wins)
+        self.ready_sent = None  # the single ready value (Bracha: one per replica)
+        self.accepted = None    # accepted value; None until 2f+1 readys
+        self.echo_from = {}     # value -> set of distinct echo senders
+        self.ready_from = {}    # value -> set of distinct ready senders
+
+
+class Engine:
+    """n concurrent message-level RBC broadcasts under one adversarial scheduler.
+
+    Every replica — correct or faulty — runs the receiver bookkeeping (a faulty
+    replica's internal honest machine observes the same wire; that is the §6.3
+    convention the count-level model encodes). Rule-driven *sends* happen only for
+    (replica, inst) pairs in protocol mode: correct replicas everywhere, faulty
+    replicas only where a strategy marks them protocol-honest (the §6.3 b=3
+    outcome). All other faulty output is owned by the strategy via :meth:`inject`
+    (scripted) or :meth:`add_reactive` (rushing: observes every state-changing
+    delivery — forged inits and duplicate echo/ready deliveries are inert and
+    invisible to hooks).
+
+    Scheduling: each :meth:`run` step delivers one uniformly random pending
+    message (seeded ``rng``), or the minimum of ``priority`` when given. An
+    optional ``hold`` predicate models adversarial withholding: held messages are
+    deferred and re-examined whenever the pending queue drains — every message is
+    still delivered in the end (eventual delivery), which is what makes
+    quiescence-time assertions meaningful.
+    """
+
+    def __init__(self, n: int, f: int, faulty, rng: random.Random,
+                 priority: Optional[Callable[["Engine", Msg], tuple]] = None,
+                 hold: Optional[Callable[["Engine", Msg], bool]] = None,
+                 check_every: int = 0):
+        self.n, self.f = n, f
+        self.faulty = [bool(x) for x in faulty]
+        self.rng = rng
+        self.priority = priority
+        self.hold = hold
+        self.check_every = check_every
+        self.views = [[_View() for _inst in range(n)] for _recv in range(n)]
+        self.protocol_send = [[not self.faulty[j]] * n for j in range(n)]
+        self.pending: list[Msg] = []
+        self.held: list[Msg] = []
+        self.accept_order: list[list[tuple[int, int]]] = [[] for _ in range(n)]
+        self.honest_sent: dict[int, int] = {}   # inst -> value, protocol-honest senders
+        self.reactive: list[Callable[["Engine", Msg], Optional[Iterable[Msg]]]] = []
+        self.delivered = 0
+
+    # -- wiring ---------------------------------------------------------------
+    def mark_protocol_honest(self, replica: int, inst: int) -> None:
+        self.protocol_send[replica][inst] = True
+
+    def start_broadcast(self, inst: int, value: int) -> None:
+        """Protocol-honest INIT from ``inst`` for its own broadcast."""
+        assert self.protocol_send[inst][inst], "sender not in protocol mode"
+        self.honest_sent[inst] = int(value)
+        self._broadcast(inst, INIT, int(value), inst)
+
+    def inject(self, msgs: Iterable[Msg]) -> None:
+        self.pending.extend(msgs)
+
+    def add_reactive(self, hook) -> None:
+        self.reactive.append(hook)
+
+    def _broadcast(self, inst: int, kind: int, value: int, src: int) -> None:
+        self.pending.extend(Msg(inst, kind, value, src, d) for d in range(self.n))
+
+    # -- delivery -------------------------------------------------------------
+    def _pick(self) -> int:
+        if self.priority is None:
+            return self.rng.randrange(len(self.pending))
+        best_i, best_p = 0, None
+        for i, m in enumerate(self.pending):
+            p = self.priority(self, m)
+            if best_p is None or p < best_p:
+                best_i, best_p = i, p
+        return best_i
+
+    def _deliver(self, msg: Msg) -> None:
+        self.delivered += 1
+        n, f = self.n, self.f
+        view = self.views[msg.dst][msg.inst]
+        if msg.kind == INIT:
+            if msg.src != msg.inst:
+                return  # authenticated channels: forged init is inert
+            if view.echoed is None:
+                view.echoed = msg.value
+                if self.protocol_send[msg.dst][msg.inst]:
+                    self._broadcast(msg.inst, ECHO, msg.value, msg.dst)
+        elif msg.kind == ECHO:
+            s = view.echo_from.setdefault(msg.value, set())
+            if msg.src in s:
+                return
+            s.add(msg.src)
+            if 2 * len(s) > n + f and view.ready_sent is None:
+                view.ready_sent = msg.value
+                if self.protocol_send[msg.dst][msg.inst]:
+                    self._broadcast(msg.inst, READY, msg.value, msg.dst)
+        else:
+            s = view.ready_from.setdefault(msg.value, set())
+            if msg.src in s:
+                return
+            s.add(msg.src)
+            if len(s) >= f + 1 and view.ready_sent is None:
+                view.ready_sent = msg.value  # amplification
+                if self.protocol_send[msg.dst][msg.inst]:
+                    self._broadcast(msg.inst, READY, msg.value, msg.dst)
+            if len(s) >= 2 * f + 1 and view.accepted is None:
+                view.accepted = msg.value
+                self.accept_order[msg.dst].append((msg.inst, msg.value))
+        for hook in self.reactive:
+            extra = hook(self, msg)
+            if extra:
+                self.pending.extend(extra)
+
+    def run(self) -> None:
+        """Deliver every message (eventual delivery), honoring holds."""
+        while self.pending or self.held:
+            if not self.pending:
+                keep, release = [], []
+                for m in self.held:
+                    (keep if self.hold(self, m) else release).append(m)
+                if not release:
+                    raise AssertionError(
+                        "scheduler deadlock: only held messages remain")
+                self.held = keep
+                self.pending.extend(release)
+                continue
+            msg = self.pending.pop(self._pick())
+            if self.hold is not None and self.hold(self, msg):
+                self.held.append(msg)
+                continue
+            self._deliver(msg)
+            if self.check_every and self.delivered % self.check_every == 0:
+                self.check_safety()
+        self.check_safety()
+
+    # -- invariants (the quotient theorem) ------------------------------------
+    def check_safety(self) -> None:
+        """Prefix-closed safety: per sender, no two correct receivers accept
+        different values; no correct receiver accepts a value a protocol-honest
+        sender didn't send; no two correct replicas send different readys."""
+        for u in range(self.n):
+            acc, rdy = set(), set()
+            for v in range(self.n):
+                if self.faulty[v]:
+                    continue
+                view = self.views[v][u]
+                if view.accepted is not None:
+                    acc.add(view.accepted)
+                if view.ready_sent is not None:
+                    rdy.add(view.ready_sent)
+            assert len(acc) <= 1, f"split acceptance for sender {u}: {sorted(acc)}"
+            assert len(rdy) <= 1, f"split readys for sender {u}: {sorted(rdy)}"
+            if u in self.honest_sent:
+                assert acc <= {self.honest_sent[u]}, (
+                    f"honest sender {u} sent {self.honest_sent[u]}, accepted {sorted(acc)}")
+
+    def check_quiescence(self) -> list[Optional[int]]:
+        """At quiescence: acceptance is uniform across *all* bookkeeping receivers
+        (faulty replicas' internal honest machines included — §6.3), and every
+        protocol-honest sender is accepted with the value it sent. Returns the
+        common outcome per sender (None = silent)."""
+        assert not self.pending and not self.held
+        outs: list[Optional[int]] = []
+        for u in range(self.n):
+            vals = {self.views[v][u].accepted for v in range(self.n)}
+            assert len(vals) == 1, (
+                f"acceptance not all-or-nothing for sender {u}: "
+                f"{[self.views[v][u].accepted for v in range(self.n)]}")
+            w = next(iter(vals))
+            if u in self.honest_sent:
+                assert w == self.honest_sent[u], (
+                    f"honest sender {u}: sent {self.honest_sent[u]}, outcome {w}")
+            outs.append(w)
+        return outs
+
+    def outcomes(self) -> list[Optional[int]]:
+        return self.check_quiescence()
+
+
+# -- scripted Byzantine strategies (the count-level knobs, and attacks on them) --
+
+def scripted_push(eng: Engine, s: int, value: int, targets=None,
+                  self_support: bool = False) -> None:
+    """Faulty sender ``s`` pushes ``value``: INIT to ``targets`` (default: all);
+    optionally adds its own echo+ready support. With targets ⊇ the correct set
+    this realizes the count-level outcome ``value`` (2(n−f) > n+f ⟺ n > 3f)."""
+    tg = range(eng.n) if targets is None else targets
+    msgs = [Msg(s, INIT, value, s, d) for d in tg]
+    if self_support:
+        msgs += [Msg(s, ECHO, value, s, d) for d in range(eng.n)]
+        msgs += [Msg(s, READY, value, s, d) for d in range(eng.n)]
+    eng.inject(msgs)
+
+
+def scripted_tease(eng: Engine, s: int, value: int, k: int,
+                   helpers: Iterable[int] = ()) -> None:
+    """INIT ``value`` to the first ``k`` correct replicas only, with ``helpers``
+    (other faulty replicas) echoing ``value`` to everyone. Drives the echo count
+    to exactly k + |helpers|: the outcome is ``value`` iff 2(k+|helpers|) > n+f,
+    else silent — the threshold boundary probe."""
+    correct = [j for j in range(eng.n) if not eng.faulty[j]]
+    msgs = [Msg(s, INIT, value, s, d) for d in correct[:k]]
+    for h in helpers:
+        msgs += [Msg(s, ECHO, value, h, d) for d in range(eng.n)]
+    eng.inject(msgs)
+
+
+def scripted_split(eng: Engine, s: int, part0, part1,
+                   helpers: Iterable[int] = (), dual_ready: bool = False) -> None:
+    """Split-brain attack: INIT 0 to ``part0``, INIT 1 to ``part1``; helpers echo
+    0 to part0 / 1 to part1 (full equivocation), optionally dual-ready both
+    values everywhere. The outcome is schedule-dependent — exactly the freedom
+    the count-level knob quotients — but must never split acceptance."""
+    msgs = [Msg(s, INIT, 0, s, d) for d in part0]
+    msgs += [Msg(s, INIT, 1, s, d) for d in part1]
+    for h in helpers:
+        msgs += [Msg(s, ECHO, 0, h, d) for d in part0]
+        msgs += [Msg(s, ECHO, 1, h, d) for d in part1]
+        if dual_ready:
+            msgs += [Msg(s, READY, 0, h, d) for d in range(eng.n)]
+            msgs += [Msg(s, READY, 1, h, d) for d in range(eng.n)]
+    eng.inject(msgs)
+
+
+def reactive_tipper(helpers: Iterable[int]):
+    """Rushing adversary: whenever a correct replica is one echo short of the
+    ready quorum for some value, every helper immediately echoes *the other*
+    value to it — trying to race the replica's single ready to the wrong side
+    and split the network."""
+    helpers = list(helpers)
+
+    def hook(eng: Engine, msg: Msg):
+        if msg.kind != ECHO:
+            return None
+        view = eng.views[msg.dst][msg.inst]
+        if view.ready_sent is not None:
+            return None
+        need = (eng.n + eng.f) // 2 + 1  # smallest c with 2c > n+f
+        extra = []
+        for value, senders in view.echo_from.items():
+            if len(senders) == need - 1:
+                other = 1 - value if value in (0, 1) else 0
+                extra += [Msg(msg.inst, ECHO, other, h, msg.dst) for h in helpers
+                          if h not in view.echo_from.get(other, set())]
+        return extra
+    return hook
+
+
+# -- schedulers ---------------------------------------------------------------
+
+def priority_value_first(value: int):
+    """Deliver messages carrying ``value`` before everything else (random within
+    a class): steers which side of a split-brain attack reaches quorum first."""
+    def pri(eng: Engine, m: Msg):
+        return (0 if m.value == value else 1, eng.rng.random())
+    return pri
+
+
+def priority_starve(receivers) -> Callable:
+    """Deliver messages to ``receivers`` last — models a partition that heals."""
+    rs = set(receivers)
+
+    def pri(eng: Engine, m: Msg):
+        return (1 if m.dst in rs else 0, eng.rng.random())
+    return pri
+
+
+# -- full consensus instance on message-level RBC (the oracle match) -----------
+
+def _make_mask_hold(mask) -> Callable[[Engine, Msg], bool]:
+    """Scheduler realizing the §4 delivery mask at message level: the final
+    (accept-causing) READY of every non-target (receiver, sender) pair is
+    withheld until the receiver's target accepts have all fired, so each
+    receiver's first n−f−1 valid non-own accepts are exactly its mask row.
+    Withholding only ever *defers* — :meth:`Engine.run` flushes all holds, so
+    eventual delivery (and with it the §5.2 totality guarantee) is preserved."""
+    targets = [set(int(u) for u in np.flatnonzero(row)) for row in mask]
+
+    def hold(eng: Engine, msg: Msg) -> bool:
+        if msg.kind != READY:
+            return False
+        v, u = msg.dst, msg.inst
+        if u in targets[v]:
+            return False
+        view = eng.views[v][u]
+        if view.accepted is not None:
+            return False
+        s = view.ready_from.get(msg.value, set())
+        if msg.src in s or len(s) + 1 < 2 * eng.f + 1:
+            return False  # not the accept-causing delivery
+        return not all(w == v or eng.views[v][w].accepted is not None
+                       for w in targets[v])
+    return hold
+
+
+def _realize_faulty_sender(eng: Engine, rng: random.Random, u: int,
+                           wire_silent: bool, wire_value: int, honest_value: int) -> None:
+    """Realize one count-level knob (silent, or common value ``wire_value``) for
+    faulty sender ``u`` at message level, choosing a random realization variant.
+    The asserted outcome is variant-invariant — that invariance is itself part of
+    what the integration run validates."""
+    n, f = eng.n, eng.f
+    if wire_silent:
+        if rng.random() < 0.5:
+            return  # say nothing at all
+        # below-threshold tease: k correct inits, no helpers — k ≤ (n+f)//2
+        # by construction of the draw, so 2k ≤ n+f and no ready can fire
+        k = rng.randrange(0, min(n - f, (n + f) // 2) + 1)
+        scripted_tease(eng, u, rng.choice((0, 1)), k)
+        return
+    variant = rng.randrange(3 if wire_value != honest_value else 4)
+    if variant == 3:
+        # §6.3 b=3: behave honestly this step — full protocol participation
+        eng.mark_protocol_honest(u, u)
+        eng.start_broadcast(u, wire_value)
+    elif variant == 2:
+        correct = [j for j in range(n) if not eng.faulty[j]]
+        scripted_push(eng, u, wire_value, targets=correct, self_support=False)
+    else:
+        scripted_push(eng, u, wire_value, self_support=bool(variant))
+
+
+def run_message_instance(cfg, instance: int, rng: random.Random,
+                         realize_rng: Optional[random.Random] = None):
+    """Run one full §5.2 consensus instance on message-level RBC and assert,
+    step by step, that it reproduces the count-level model exactly.
+
+    Per (round, step): every sender's RBC is simulated message-by-message (the
+    count-level adversary knob realized by a random message-level strategy); the
+    engine invariants prove the quotient; the common outcomes are asserted equal
+    to the count-level wire ``(values, silent)`` from ``Adversary.inject``;
+    receiver-local §5.1b validation over the accepted outcomes is asserted equal
+    to the global count-level predicate; and under the mask-realizing schedule
+    each receiver's wait-quota (first n−f valid accepts, own message in-head)
+    is asserted equal to the §4 delivery mask row. State then evolves through the
+    same ``Replica`` machine as backends/cpu.py; the caller compares the returned
+    ``(rounds, decision)`` with ``CpuBackend.run``.
+    """
+    from byzantinerandomizedconsensus_tpu.backends.cpu import CpuBackend
+    from byzantinerandomizedconsensus_tpu.core.adversary import make_adversary
+    from byzantinerandomizedconsensus_tpu.core.network import Network
+    from byzantinerandomizedconsensus_tpu.core.replica import Replica
+    from byzantinerandomizedconsensus_tpu.ops import prf
+
+    cfg = cfg.validate()
+    assert cfg.protocol == "bracha" and cfg.delivery == "keys", \
+        "message-level validation targets the bracha §4-mask model"
+    if realize_rng is None:
+        realize_rng = random.Random(rng.randrange(1 << 30))
+    n, f = cfg.n, cfg.f
+    est0 = CpuBackend._initial_estimates(cfg, instance)
+    reps = [Replica(cfg, j, int(est0[j])) for j in range(n)]
+    net = Network(cfg, cfg.seed, instance)
+    adv = make_adversary(cfg, cfg.seed, instance)
+    faulty = adv.faulty
+    correct = [j for j in range(n) if not faulty[j]]
+
+    for r in range(cfg.round_cap):
+        g_prev = None       # count-level live-valid counts of the previous step
+        g_prev_msg = None   # same, recomputed from message-level outcomes
+        for t in range(cfg.steps_per_round):
+            honest = np.array([rep.send_value(t) for rep in reps], dtype=np.uint8)
+            values, silent, bias = adv.inject(r, t, honest)
+            invalid = np.zeros(n, dtype=bool)
+            if t > 0:
+                invalid = CpuBackend._invalid(cfg, t, values, g_prev)
+            silent_all = silent | invalid
+            g_prev = (int(np.count_nonzero(~silent_all & (values == 0))),
+                      int(np.count_nonzero(~silent_all & (values == 1))))
+
+            # ---- message level: n concurrent RBCs under the mask schedule ----
+            mask = net.delivery_mask(r, t, silent_all, bias)
+            eng = Engine(n, f, faulty, rng=rng, hold=_make_mask_hold(mask))
+            for u in range(n):
+                if not faulty[u]:
+                    eng.start_broadcast(u, int(honest[u]))
+                else:
+                    _realize_faulty_sender(eng, realize_rng, u, bool(silent[u]),
+                                           int(values[u]), int(honest[u]))
+            eng.run()
+            out = eng.check_quiescence()
+
+            # RBC outcomes == the count-level wire (the §5.2 abstraction, leg 1)
+            for u in range(n):
+                expect = None if silent[u] else int(values[u])
+                assert out[u] == expect, (
+                    f"sender {u} outcome {out[u]} != count-level {expect} "
+                    f"(r={r} t={t} inst={instance})")
+
+            # receiver-local §5.1b validation over accepted outcomes == the
+            # global count-level predicate (leg 2)
+            if t > 0:
+                out_vals = np.array([2 if o is None else o for o in out],
+                                    dtype=np.uint8)
+                inv_msg = CpuBackend._invalid(cfg, t, out_vals, g_prev_msg)
+                live = ~silent
+                assert np.array_equal(inv_msg[live], invalid[live]), (
+                    f"message-level validation diverged (r={r} t={t})")
+            g_prev_msg = (
+                sum(1 for u in range(n)
+                    if out[u] == 0 and not silent_all[u]),
+                sum(1 for u in range(n)
+                    if out[u] == 1 and not silent_all[u]))
+            assert g_prev_msg == g_prev
+
+            # wait-quota == the §4 mask row (leg 3): first n−f−1 valid non-own
+            # accepts in message-arrival order, plus the own message in-head
+            for v in range(n):
+                seq = [u for (u, _w) in eng.accept_order[v]
+                       if u != v and not silent_all[u]]
+                quota = {v} | set(seq[: n - f - 1])
+                assert quota == set(int(u) for u in np.flatnonzero(mask[v])), (
+                    f"delivered set diverged at receiver {v} (r={r} t={t})")
+
+            vmat = np.broadcast_to(values, (n, n))
+            for rep in reps:
+                rep.on_deliver(t, vmat[rep.index], mask[rep.index])
+
+        if cfg.coin == "shared":
+            shared = int(prf.prf_bit(cfg.seed, instance, r, prf.COIN_STEP, 0, 0,
+                                     prf.SHARED_COIN, xp=np))
+            coin = [shared] * n
+        else:
+            replica = np.arange(n, dtype=np.uint32)
+            coin = prf.prf_bit(cfg.seed, instance, r, prf.COIN_STEP, replica, 0,
+                               prf.LOCAL_COIN, xp=np)
+        for rep in reps:
+            rep.end_round(int(coin[rep.index]))
+        if all(reps[j].decided for j in correct):
+            vals = {reps[j].decided_val for j in correct}
+            assert len(vals) == 1, f"Agreement violation: {sorted(vals)}"
+            return r + 1, reps[correct[0]].decided_val
+    # Agreement binds partial decided sets at the cap too (as in CpuBackend).
+    vals = {reps[j].decided_val for j in correct if reps[j].decided}
+    assert len(vals) <= 1, f"Agreement violation at round cap: {sorted(vals)}"
+    return cfg.round_cap, 2
+
+
+def run_message_instance_free(cfg, instance: int, rng: random.Random,
+                              realize_rng: Optional[random.Random] = None):
+    """Message-level consensus with NO count-level scheduling input at all: wait
+    quotas are each receiver's first n−f−1 valid non-own accepts in raw
+    message-arrival order under a free random schedule, and §5.1b validation is
+    computed from message-level outcomes only. The delivered sets therefore
+    differ from the §4 mask — per-instance results are *not* comparable to the
+    count-level oracle — but the protocol's Agreement (asserted here) and
+    Validity/liveness (asserted by the caller) must survive, which is the
+    semantic-soundness half of the §5.2 abstraction argument."""
+    from byzantinerandomizedconsensus_tpu.backends.cpu import CpuBackend
+    from byzantinerandomizedconsensus_tpu.core.adversary import make_adversary
+    from byzantinerandomizedconsensus_tpu.core.replica import Replica
+    from byzantinerandomizedconsensus_tpu.ops import prf
+
+    cfg = cfg.validate()
+    assert cfg.protocol == "bracha"
+    if realize_rng is None:
+        realize_rng = random.Random(rng.randrange(1 << 30))
+    n, f = cfg.n, cfg.f
+    est0 = CpuBackend._initial_estimates(cfg, instance)
+    reps = [Replica(cfg, j, int(est0[j])) for j in range(n)]
+    adv = make_adversary(cfg, cfg.seed, instance)
+    faulty = adv.faulty
+    correct = [j for j in range(n) if not faulty[j]]
+
+    def check_agreement():
+        vals = {reps[j].decided_val for j in correct if reps[j].decided}
+        assert len(vals) <= 1, f"Agreement violation: {sorted(vals)}"
+
+    for r in range(cfg.round_cap):
+        g_msg = None
+        for t in range(cfg.steps_per_round):
+            honest = np.array([rep.send_value(t) for rep in reps], dtype=np.uint8)
+            values, silent, _bias = adv.inject(r, t, honest)
+            eng = Engine(n, f, faulty, rng=rng)
+            for u in range(n):
+                if not faulty[u]:
+                    eng.start_broadcast(u, int(honest[u]))
+                else:
+                    _realize_faulty_sender(eng, realize_rng, u, bool(silent[u]),
+                                           int(values[u]), int(honest[u]))
+            eng.run()
+            out = eng.check_quiescence()
+            out_vals = np.array([2 if o is None else o for o in out], dtype=np.uint8)
+            dead = np.array([o is None for o in out], dtype=bool)
+            invalid = np.zeros(n, dtype=bool)
+            if t > 0:
+                invalid = CpuBackend._invalid(cfg, t, out_vals, g_msg)
+            skip = dead | invalid
+            g_msg = (int(np.count_nonzero(~skip & (out_vals == 0))),
+                     int(np.count_nonzero(~skip & (out_vals == 1))))
+            mask = np.zeros((n, n), dtype=bool)
+            for v in range(n):
+                seq = [u for (u, _w) in eng.accept_order[v] if u != v and not skip[u]]
+                mask[v, [v] + seq[: n - f - 1]] = True
+            vmat = np.broadcast_to(values, (n, n))
+            for rep in reps:
+                rep.on_deliver(t, vmat[rep.index], mask[rep.index])
+        if cfg.coin == "shared":
+            shared = int(prf.prf_bit(cfg.seed, instance, r, prf.COIN_STEP, 0, 0,
+                                     prf.SHARED_COIN, xp=np))
+            coin = [shared] * n
+        else:
+            replica = np.arange(n, dtype=np.uint32)
+            coin = prf.prf_bit(cfg.seed, instance, r, prf.COIN_STEP, replica, 0,
+                               prf.LOCAL_COIN, xp=np)
+        for rep in reps:
+            rep.end_round(int(coin[rep.index]))
+        check_agreement()
+        if all(reps[j].decided for j in correct):
+            return r + 1, reps[correct[0]].decided_val
+    check_agreement()
+    return cfg.round_cap, 2
