@@ -25,40 +25,23 @@ def _prev_round_headline():
     The driver records bench output per round; comparing against the previous
     round's artifact is the perf-regression guard (VERDICT r2 #4): tunnel
     variance is ±10-15% (docs/PERF.md), so |vs_prev_round - 1| > 0.15 means a
-    real change, not noise, and must be explained in PERF.md.
-
-    "Previous round" is the round VERDICT.md judged (the latest artifact can
-    be the CURRENT round's, written by the driver after its bench capture — a
-    rerun comparing against it would always read ~1.0 and mask regressions).
-    Without a parseable VERDICT the latest artifact is used.
+    real change, not noise, and must be explained in PERF.md. Round anchoring
+    and the unparseable-VERDICT warning live in utils/rounds.py.
     """
-    import pathlib
-    import re
+    from byzantinerandomizedconsensus_tpu.utils.rounds import prev_round_artifact
 
-    root = pathlib.Path(__file__).resolve().parent
-    cap = None  # highest round number eligible as "previous"
-    try:
-        m = re.search(r"VERDICT\s*[—-]+\s*round\s+(\d+)",
-                      (root / "VERDICT.md").read_text())
-        cap = int(m.group(1)) if m else None
-    except OSError:
-        pass
-    best, best_round = None, -1
-    for p in root.glob("BENCH_r*.json"):
-        m = re.match(r"BENCH_r(\d+)\.json", p.name)
-        if not m:
-            continue
-        rnd = int(m.group(1))
-        if rnd <= best_round or (cap is not None and rnd > cap):
-            continue
+    def _value(doc):
         try:
-            doc = json.loads(p.read_text())
-            val = doc.get("parsed", doc).get("value")
-            if val:
-                best, best_round = (p.name, float(val)), rnd
-        except (OSError, ValueError, AttributeError):
-            continue
-    return best
+            return float(doc.get("parsed", doc).get("value"))
+        except (AttributeError, TypeError, ValueError):
+            return None
+
+    # Fall back to older rounds past dead captures (no usable value).
+    found = prev_round_artifact("BENCH", usable=lambda d: _value(d) is not None)
+    if not found:
+        return None
+    name, _rnd, doc = found
+    return (name, _value(doc))
 
 
 def main() -> int:
@@ -94,11 +77,11 @@ def main() -> int:
         overrides["delivery"] = delivery
     cfg = preset("config4", **overrides)
 
-    # Warm-up compile at the exact run shape + best-of-two timed runs — the
+    # Warm-up compile at the exact run shape + best-of-five timed runs — the
     # shared measurement discipline (utils/timing.py; docs/PERF.md).
-    from byzantinerandomizedconsensus_tpu.utils.timing import timed_best_of
+    from byzantinerandomizedconsensus_tpu.utils.timing import spread, timed_best_of
 
-    res, walls = timed_best_of(get_backend(backend), cfg, repeats=2)
+    res, walls = timed_best_of(get_backend(backend), cfg)
     wall = min(walls)
 
     inst_per_sec = instances / wall
@@ -116,6 +99,7 @@ def main() -> int:
             "instances": instances,
             "wall_s": round(wall, 2),
             "walls_s": [round(w, 3) for w in walls],
+            "walls_spread": round(spread(walls), 3),
             "mean_rounds_to_decision": round(float(res.rounds.mean()), 4),
             "undecided": undecided,
         },

@@ -37,6 +37,12 @@ class SimResult:
 class SimulatorBackend(abc.ABC):
     name: str = "?"
 
+    #: True when the first run at a shape pays a compile (jit backends); the
+    #: timing helper (utils/timing.py) uses this to decide whether a warm-up
+    #: run is needed before the timed window (ADVICE r3: the numpy backend has
+    #: a ``_chunk_size`` but nothing to compile and must not pay a warm-up).
+    needs_warmup: bool = False
+
     @abc.abstractmethod
     def run(self, cfg: SimConfig, inst_ids: Optional[np.ndarray] = None) -> SimResult:
         """Simulate the given instances (default: all of them) to termination."""
@@ -102,6 +108,8 @@ class JitChunkedBackend(SimulatorBackend):
     #: "pallas" kernels need concrete PRF key words in-kernel; everything else
     #: takes the key dynamically so one program serves every seed.
     kernel: str = "xla"
+
+    needs_warmup = True  # first run at a shape compiles an XLA program
 
     def __init__(self, chunk_bytes: int, max_chunk: int):
         self.chunk_bytes = chunk_bytes

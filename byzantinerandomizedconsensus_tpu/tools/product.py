@@ -9,10 +9,17 @@ backend, platform, wall-clock, instances/sec, and the full round/decision
 histograms (the bit-match surface of spec §1).
 
 CLI: ``python -m byzantinerandomizedconsensus_tpu.tools.product``
-(or ``cli.py product``); writes ``artifacts/product_r3.json`` by default.
-Wall-clock methodology matches bench.py: compile outside the timed window
-(one warm-up run at the exact chunk shape), best-of-two timed runs, tunnel
-variance ±10-15% (docs/PERF.md).
+(or ``cli.py product``); writes ``artifacts/product_r{N}.json`` by default,
+with N = the build round in progress (utils/rounds.py). Wall-clock
+methodology matches bench.py: compile outside the timed window (one warm-up
+run at the exact chunk shape), best-of-five timed runs with the spread on
+record, tunnel variance ±10-15% (docs/PERF.md).
+
+Regression guard (VERDICT r3 #5): every preset entry carries
+``vs_prev_round`` against the previous round's product artifact (same
+VERDICT-anchored round numbering bench.py uses), so a silent throughput
+regression in any preset — not just the config-4 headline — shows up in the
+artifact diff and falls under PERF.md's explain-or-noise rule.
 """
 
 from __future__ import annotations
@@ -25,10 +32,13 @@ from byzantinerandomizedconsensus_tpu.backends import get_backend
 from byzantinerandomizedconsensus_tpu.config import (
     PRESETS, SWEEP_INSTANCES, SWEEP_POINT_N, sweep_point)
 from byzantinerandomizedconsensus_tpu.utils import metrics
-from byzantinerandomizedconsensus_tpu.utils.timing import timed_best_of
+from byzantinerandomizedconsensus_tpu.utils.rounds import (
+    prev_round_artifact, this_round)
+from byzantinerandomizedconsensus_tpu.utils.timing import (
+    DEFAULT_REPEATS, spread, timed_best_of)
 
 
-def run_config(cfg, backend: str, timed_repeats: int = 2) -> dict:
+def run_config(cfg, backend: str, timed_repeats: int = DEFAULT_REPEATS) -> dict:
     """One shipped config end-to-end: warm-up compile, then best-of-N
     (utils/timing.py — the same methodology as bench.py)."""
     res, walls = timed_best_of(get_backend(backend), cfg, timed_repeats)
@@ -39,6 +49,7 @@ def run_config(cfg, backend: str, timed_repeats: int = 2) -> dict:
         backend=backend,
         wall_s=round(best, 3),
         walls_s=[round(w, 3) for w in walls],
+        walls_spread=round(spread(walls), 3),
         instances_per_sec=round(cfg.instances / best, 1),
     )
     return s
@@ -48,7 +59,10 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Run all five benchmark configs as shipped; write the "
                     "product artifact")
-    ap.add_argument("--out", default="artifacts/product_r3.json")
+    rnd = this_round()
+    ap.add_argument("--out",
+                    default=f"artifacts/product_r{rnd}.json" if rnd
+                    else "artifacts/product.json")
     ap.add_argument("--backend", default="jax",
                     help="product backend for every leg (default jax)")
     ap.add_argument("--configs", nargs="*",
@@ -73,7 +87,11 @@ def main(argv=None) -> int:
         "description",
         "All five benchmark configs (BASELINE.json:6-12) run end-to-end AS "
         "SHIPPED (tools/product.py): per config, wall-clock/instances-per-sec "
-        "(warmed, best-of-two) and the full round/decision histograms")
+        "(warmed, best-of-N with the walls_s spread recorded) and the full "
+        "round/decision histograms")
+    prev = prev_round_artifact(
+        "product", subdir="artifacts",
+        usable=lambda d: any(k.startswith("config") for k in d))
     for name in args.configs:
         if name == "config5":
             cfg = sweep_point(SWEEP_POINT_N)
@@ -87,18 +105,30 @@ def main(argv=None) -> int:
               f"{cfg.adversary}/{cfg.coin} cap={cfg.round_cap}", flush=True)
         entry = run_config(cfg, args.backend)
         entry["platform"] = platform
+        # Per-preset regression guard (VERDICT r3 #5): like-for-like only —
+        # skip the comparison when the previous entry ran elsewhere.
+        prev_entry = prev[2].get(name, {}) if prev else {}
+        if (prev_entry.get("instances_per_sec")
+                and prev_entry.get("platform") == platform
+                and prev_entry.get("backend") == args.backend):
+            entry["vs_prev_round"] = round(
+                entry["instances_per_sec"] / prev_entry["instances_per_sec"], 3)
+            entry["prev_round_artifact"] = prev[0]
         art[name] = entry
         print(json.dumps({k: entry[k] for k in
                           ("wall_s", "instances_per_sec", "undecided_at_cap",
-                           "mean_rounds_decided")}), flush=True)
+                           "mean_rounds_decided", "vs_prev_round")
+                          if k in entry}), flush=True)
     path.parent.mkdir(parents=True, exist_ok=True)
     path.write_text(json.dumps(art, indent=1, sort_keys=True) + "\n")
-    ran = {k: v for k, v in art.items() if k != "description"}
     print(json.dumps({
         "out": str(path),
         "platform": platform,
-        "configs": sorted(ran),
-        "total_wall_s": round(sum(v["wall_s"] for v in ran.values()), 2),
+        "configs": sorted(k for k in art if k != "description"),
+        # wall-clocks from THIS invocation only: merged entries may come from
+        # other platforms/invocations and older formats (ADVICE r3)
+        "total_wall_s_this_run": round(
+            sum(art[k].get("wall_s", 0) for k in args.configs), 2),
     }))
     return 0
 

@@ -1,0 +1,47 @@
+"""Round-anchoring helper (utils/rounds.py): the vs_prev_round regression-guard
+bookkeeping shared by bench.py and tools/product.py (VERDICT r2 #4, r3 #5;
+ADVICE r3 on the unparseable-VERDICT fallback)."""
+
+import json
+
+from byzantinerandomizedconsensus_tpu.utils import rounds
+
+
+def _value(doc):
+    try:
+        return float(doc.get("parsed", doc).get("value"))
+    except (AttributeError, TypeError, ValueError):
+        return None
+
+
+def test_prev_round_skips_dead_capture(tmp_path):
+    (tmp_path / "VERDICT.md").write_text("# VERDICT — round 3\n")
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps({"parsed": {"value": 111.0}}))
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps({"rc": 1}))  # dead capture
+    got = rounds.prev_round_artifact("BENCH", root=tmp_path,
+                                     usable=lambda d: _value(d) is not None)
+    assert got[:2] == ("BENCH_r02.json", 2)
+
+
+def test_prev_round_never_exceeds_verdict_round(tmp_path):
+    # BENCH_r04 is the CURRENT round's capture — must not self-compare.
+    (tmp_path / "VERDICT.md").write_text("# VERDICT — round 3\n")
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps({"parsed": {"value": 5.0}}))
+    (tmp_path / "BENCH_r04.json").write_text(json.dumps({"parsed": {"value": 9.0}}))
+    got = rounds.prev_round_artifact("BENCH", root=tmp_path)
+    assert got[:2] == ("BENCH_r03.json", 3)
+
+
+def test_unparseable_verdict_omits_comparison(tmp_path, capsys):
+    (tmp_path / "VERDICT.md").write_text("garbled heading\n")
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps({"parsed": {"value": 5.0}}))
+    assert rounds.prev_round_artifact("BENCH", root=tmp_path) is None
+    assert "unparseable" in capsys.readouterr().err
+    assert rounds.this_round(tmp_path) is None
+
+
+def test_round_numbering(tmp_path):
+    assert rounds.this_round(tmp_path) == 1          # no VERDICT: round 1
+    (tmp_path / "VERDICT.md").write_text("# VERDICT — round 3\n")
+    assert rounds.verdict_round(tmp_path) == (True, 3)
+    assert rounds.this_round(tmp_path) == 4
