@@ -63,16 +63,8 @@ class SimulatorBackend(abc.ABC):
         compile of the throwaway concat program on first use.)
         """
         import jax
-        import jax.numpy as jnp
 
-        pending = []
-        for lo in range(0, len(ids), chunk):
-            hi = min(lo + chunk, len(ids))
-            cids = ids[lo:hi]
-            if len(cids) < chunk:
-                cids = np.concatenate([cids, np.full(chunk - len(cids), cids[-1])])
-            pending.append(fn(jnp.asarray(cids, dtype=jnp.uint32), *extra_args))
-
+        pending = SimulatorBackend._dispatch_chunks(fn, ids, chunk, extra_args)
         fetched = jax.device_get(pending)
         rounds_out = np.empty(len(ids), dtype=np.int32)
         decision_out = np.empty(len(ids), dtype=np.uint8)
@@ -82,6 +74,26 @@ class SimulatorBackend(abc.ABC):
             rounds_out[lo:hi] = r[: hi - lo]
             decision_out[lo:hi] = d[: hi - lo]
         return rounds_out, decision_out
+
+    @staticmethod
+    def _dispatch_chunks(fn, ids: np.ndarray, chunk: int, extra_args=()) -> list:
+        """Async-dispatch ``fn`` over fixed-size chunks; no results fetched.
+
+        The tail chunk is padded (repeated last id) to the compiled shape so
+        exactly one program per config is compiled; callers discard padded
+        rows. This is *the* dispatch loop of the product path — profiling
+        tools (tools/roofline.py) call it too, so what they measure is what
+        ships."""
+        import jax.numpy as jnp
+
+        pending = []
+        for lo in range(0, len(ids), chunk):
+            hi = min(lo + chunk, len(ids))
+            cids = ids[lo:hi]
+            if len(cids) < chunk:
+                cids = np.concatenate([cids, np.full(chunk - len(cids), cids[-1])])
+            pending.append(fn(jnp.asarray(cids, dtype=jnp.uint32), *extra_args))
+        return pending
 
     @staticmethod
     def _resolve_inst_ids(cfg: SimConfig, inst_ids) -> np.ndarray:

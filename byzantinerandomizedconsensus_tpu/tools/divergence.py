@@ -1,0 +1,164 @@
+"""Keys-vs-urn per-instance divergence map (spec §4b "cross-model divergence").
+
+The two delivery models (spec §4 keys, §4b urn) are different exact samplers of
+the same delivery-distribution family, so per-instance outcomes *should* diverge
+wherever scheduling freedom can cross a quorum margin — and round 3 found they
+never did at any committed comparison point (all of which were config-5-family
+points: bracha + adaptive). This tool maps where the divergence actually lives,
+so the cross-model statistical tests (tests/test_urn.py) are demonstrably run
+on samples with discriminating power (VERDICT r3 missing #3 / next #3).
+
+Measured structure (artifacts/divergence_r4.json; pinned as regression tests in
+tests/test_divergence.py):
+
+- **Divergent regime** — every non-adaptive adversary at small/medium n:
+  uniform (or value-mixed) scheduling strata leave the drop split across value
+  classes to the sampler, and near-threshold margins let it matter. E.g. plain
+  Ben-Or n=4 f=1 local coin: 48% of instances differ in rounds-to-decision;
+  n=16 f=7: 80%. Statistics still agree (same distribution family) — that
+  agreement is now evidenced by samples that *do* disagree per-instance.
+- **Delivery-robust regime** — the config-5 family (bracha + adaptive): at
+  every point measured (n = 16 … 512, both coins, multiple seeds) per-instance
+  outcomes are *identical*. Two mechanisms, documented in spec §4b: steps with
+  a binary wire alphabet have value-homogeneous bias strata, making delivered
+  counts closed-form deterministic (asserted exactly in
+  tests/test_divergence.py); the one ⊥-bearing step's jitter is confined to
+  the biased stratum's ⊥/minority split, which the minority-push adversary
+  itself keeps clear of the f+1 adopt margin.
+
+CLI: ``python -m byzantinerandomizedconsensus_tpu.tools.divergence``
+(``--full`` adds the large-n config-5-family rows on an accelerated backend).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import pathlib
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu.config import SimConfig
+from byzantinerandomizedconsensus_tpu.core.simulator import Simulator
+
+# (cfg, regime) rows. Regimes are the measured classification above; a row's
+# placement is an expectation the artifact records, not an input to it.
+_BASE = dict(round_cap=64)
+GRID: tuple[tuple[SimConfig, str], ...] = (
+    (SimConfig(protocol="benor", n=4, f=1, adversary="none", coin="local",
+               seed=0, **_BASE), "divergent"),
+    (SimConfig(protocol="benor", n=4, f=1, adversary="none", coin="shared",
+               seed=0, **_BASE), "divergent"),
+    (SimConfig(protocol="benor", n=16, f=7, adversary="none", coin="local",
+               seed=2, **_BASE), "divergent"),
+    (SimConfig(protocol="benor", n=64, f=21, adversary="crash", coin="local",
+               seed=3, round_cap=96), "divergent"),
+    (SimConfig(protocol="bracha", n=10, f=3, adversary="byzantine", coin="local",
+               seed=4, **_BASE), "divergent"),
+    (SimConfig(protocol="bracha", n=10, f=3, adversary="byzantine", coin="shared",
+               seed=4, **_BASE), "divergent"),
+    (SimConfig(protocol="benor", n=11, f=2, adversary="adaptive", coin="local",
+               seed=3, **_BASE), "divergent"),
+    (SimConfig(protocol="bracha", n=16, f=5, adversary="adaptive", coin="local",
+               seed=5, **_BASE), "robust"),
+    (SimConfig(protocol="bracha", n=16, f=5, adversary="adaptive", coin="shared",
+               seed=11, **_BASE), "robust"),
+)
+
+# Large-n config-5-family rows (--full): the round-3 "identical at every sweep
+# point" finding, re-established by the committed artifact. Keys delivery at
+# these n is the O(n²) path — run on an accelerated backend.
+FULL_GRID: tuple[tuple[SimConfig, str], ...] = (
+    (SimConfig(protocol="bracha", n=97, f=32, adversary="adaptive", coin="local",
+               seed=0, round_cap=128), "robust"),
+    (SimConfig(protocol="bracha", n=98, f=32, adversary="adaptive", coin="local",
+               seed=0, round_cap=128), "robust"),
+    (SimConfig(protocol="bracha", n=512, f=170, adversary="adaptive",
+               coin="shared", seed=0, round_cap=128), "robust"),
+)
+
+
+def compare_row(cfg: SimConfig, instances: int, backend: str) -> dict:
+    """Run ``cfg`` at both deliveries; return the per-instance comparison."""
+    cfg = dataclasses.replace(cfg, instances=instances).validate()
+    res = {}
+    for delivery in ("keys", "urn"):
+        c = dataclasses.replace(cfg, delivery=delivery)
+        res[delivery] = Simulator(c, backend).run()
+
+    k, u = res["keys"], res["urn"]
+    row = {
+        "protocol": cfg.protocol, "n": cfg.n, "f": cfg.f,
+        "adversary": cfg.adversary, "coin": cfg.coin, "seed": cfg.seed,
+        "round_cap": cfg.round_cap, "instances": instances,
+        "frac_rounds_differ": float((k.rounds != u.rounds).mean()),
+        "frac_decision_differ": float((k.decision != u.decision).mean()),
+    }
+    for name, r in (("keys", k), ("urn", u)):
+        row[f"mean_rounds_{name}"] = float(r.rounds.mean())
+        row[f"p1_{name}"] = float((r.decision == 1).mean())
+        row[f"capped_{name}"] = float((r.decision == 2).mean())
+    return row
+
+
+def run_divergence(instances: int = 400, backend: str = "numpy",
+                   full: bool = False, full_backend: str = "jax",
+                   full_instances: int = 2000, progress=print) -> dict:
+    rows = []
+    for cfg, regime in GRID:
+        row = compare_row(cfg, instances, backend)
+        row.update(regime=regime, backend=backend)
+        progress(json.dumps(row))
+        rows.append(row)
+    if full:
+        for cfg, regime in FULL_GRID:
+            row = compare_row(cfg, full_instances, full_backend)
+            row.update(regime=regime, backend=full_backend)
+            progress(json.dumps(row))
+            rows.append(row)
+    div = [r for r in rows if r["regime"] == "divergent"]
+    rob = [r for r in rows if r["regime"] == "robust"]
+    return {
+        "rows": rows,
+        "summary": {
+            "divergent_rows": len(div),
+            "robust_rows": len(rob),
+            "min_frac_rounds_differ_divergent":
+                min(r["frac_rounds_differ"] for r in div),
+            "max_frac_rounds_differ_robust":
+                max(r["frac_rounds_differ"] for r in rob),
+            "max_abs_mean_rounds_gap":
+                max(abs(r["mean_rounds_keys"] - r["mean_rounds_urn"])
+                    for r in rows),
+        },
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="keys-vs-urn divergence map")
+    ap.add_argument("--out", default="artifacts/divergence_r4.json")
+    ap.add_argument("--instances", type=int, default=400)
+    ap.add_argument("--backend", default="numpy")
+    ap.add_argument("--full", action="store_true",
+                    help="add large-n config-5-family rows (accelerated backend)")
+    ap.add_argument("--full-backend", default="jax")
+    ap.add_argument("--full-instances", type=int, default=2000)
+    args = ap.parse_args(argv)
+
+    if args.full:
+        from byzantinerandomizedconsensus_tpu.utils.devices import ensure_live_backend
+
+        ensure_live_backend()
+    result = run_divergence(instances=args.instances, backend=args.backend,
+                            full=args.full, full_backend=args.full_backend,
+                            full_instances=args.full_instances)
+    out = pathlib.Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(result, indent=1, sort_keys=True) + "\n")
+    print(json.dumps({"out": str(out), **result["summary"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -51,18 +51,22 @@ OPS_PER_DRAW = 20
 VPU_PEAK_BAND = (1.0e12, 4.0e12)
 
 
-def parse_trace(trace_dir) -> dict:
+def parse_trace(trace_dir, exclude=frozenset()) -> dict:
     """Device busy time + top device ops from the newest trace.json.gz under
-    ``trace_dir``. Durations are summed per op name over device-pid complete
-    events; ``device_busy_s`` sums the top-level jit program executions (child
-    events nest inside them, so summing everything would double-count)."""
+    ``trace_dir``, ignoring files in ``exclude`` (pre-existing traces from
+    earlier runs in a reused dir — a failed capture must surface as an error,
+    never silently reparse a stale trace). Durations are summed per op name
+    over device-pid complete events; ``device_busy_s`` sums the top-level jit
+    program executions (child events nest inside them, so summing everything
+    would double-count)."""
     import collections
     import gzip
 
-    paths = sorted(pathlib.Path(trace_dir).rglob("*.trace.json.gz"),
+    paths = sorted((p for p in pathlib.Path(trace_dir).rglob("*.trace.json.gz")
+                    if p not in exclude),
                    key=lambda p: p.stat().st_mtime)
     if not paths:
-        return {"error": "no trace.json.gz produced"}
+        return {"error": "no new trace.json.gz produced by this run"}
     with gzip.open(paths[-1]) as fh:
         doc = json.load(fh)
     ev = doc.get("traceEvents", [])
@@ -133,21 +137,15 @@ def main(argv=None) -> int:
           f"(best {wall:.3f}s of {[round(w, 3) for w in walls]})", flush=True)
 
     # -- leg 2: dispatch / execute / fetch decomposition (warmed) --------------
+    # Exactly the product dispatch path: same chunk sizing (incl. _clamp_chunk)
+    # and the shared _dispatch_chunks loop the backend itself runs.
     ids = np.arange(cfg.instances, dtype=np.int64)
-    chunk = min(be._chunk_size(cfg), cfg.instances)
+    chunk = be._clamp_chunk(cfg, min(be._chunk_size(cfg), max(1, len(ids))))
     fn = be._fn(cfg)
     extra = be._extra_args(cfg)
 
     def dispatch_all():
-        import jax.numpy as jnp
-        pending = []
-        for lo in range(0, len(ids), chunk):
-            hi = min(lo + chunk, len(ids))
-            cids = ids[lo:hi]
-            if len(cids) < chunk:
-                cids = np.concatenate([cids, np.full(chunk - len(cids), cids[-1])])
-            pending.append(fn(jnp.asarray(cids, dtype=jnp.uint32), *extra))
-        return pending
+        return be._dispatch_chunks(fn, ids, chunk, extra)
 
     decomp = {"note": ("async dispatch overlaps device execution and result "
                        "transfer; wait_after_dispatch_s upper-bounds "
@@ -175,9 +173,11 @@ def main(argv=None) -> int:
     trace_dir = args.trace or "/tmp/roofline_trace"
     from byzantinerandomizedconsensus_tpu.utils import profiling
     try:
+        pre = frozenset(pathlib.Path(trace_dir).rglob("*.trace.json.gz")) \
+            if pathlib.Path(trace_dir).exists() else frozenset()
         with profiling.trace(trace_dir):
             jax.block_until_ready(dispatch_all())
-        trace_note = parse_trace(trace_dir)
+        trace_note = parse_trace(trace_dir, exclude=pre)
         trace_note["dir"] = trace_dir
     except Exception as e:  # tunnel profilers can be unsupported
         trace_note = {"dir": trace_dir, "error": repr(e)}
