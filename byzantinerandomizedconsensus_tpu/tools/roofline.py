@@ -51,19 +51,20 @@ OPS_PER_DRAW = 20
 VPU_PEAK_BAND = (1.0e12, 4.0e12)
 
 
-def parse_trace(trace_dir, exclude=frozenset()) -> dict:
+def parse_trace(trace_dir, min_mtime: float = 0.0) -> dict:
     """Device busy time + top device ops from the newest trace.json.gz under
-    ``trace_dir``, ignoring files in ``exclude`` (pre-existing traces from
-    earlier runs in a reused dir — a failed capture must surface as an error,
-    never silently reparse a stale trace). Durations are summed per op name
-    over device-pid complete events; ``device_busy_s`` sums the top-level jit
-    program executions (child events nest inside them, so summing everything
-    would double-count)."""
+    ``trace_dir`` written at/after ``min_mtime`` (pre-existing traces from
+    earlier runs in a reused dir are stale — a failed capture must surface as
+    an error, never silently reparse one; mtime, not path identity, because a
+    fresh capture may legitimately overwrite a previous run's path). Durations
+    are summed per op name over device-pid complete events; ``device_busy_s``
+    sums the top-level jit program executions (child events nest inside them,
+    so summing everything would double-count)."""
     import collections
     import gzip
 
     paths = sorted((p for p in pathlib.Path(trace_dir).rglob("*.trace.json.gz")
-                    if p not in exclude),
+                    if p.stat().st_mtime >= min_mtime),
                    key=lambda p: p.stat().st_mtime)
     if not paths:
         return {"error": "no new trace.json.gz produced by this run"}
@@ -173,11 +174,12 @@ def main(argv=None) -> int:
     trace_dir = args.trace or "/tmp/roofline_trace"
     from byzantinerandomizedconsensus_tpu.utils import profiling
     try:
-        pre = frozenset(pathlib.Path(trace_dir).rglob("*.trace.json.gz")) \
-            if pathlib.Path(trace_dir).exists() else frozenset()
+        capture_start = time.time()
         with profiling.trace(trace_dir):
             jax.block_until_ready(dispatch_all())
-        trace_note = parse_trace(trace_dir, exclude=pre)
+        # 2 s slack absorbs coarse filesystem mtime granularity; captures take
+        # longer than that to go stale, and stale dirs are hours old.
+        trace_note = parse_trace(trace_dir, min_mtime=capture_start - 2.0)
         trace_note["dir"] = trace_dir
     except Exception as e:  # tunnel profilers can be unsupported
         trace_note = {"dir": trace_dir, "error": repr(e)}
