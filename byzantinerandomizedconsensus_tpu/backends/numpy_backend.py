@@ -36,6 +36,17 @@ class NumpyBackend(SimulatorBackend):
         res, _, _ = self._run_impl(cfg, inst_ids, collect_state=False)
         return res
 
+    def run_with_adversary(self, cfg: SimConfig, adv: AdversaryModel,
+                           inst_ids: Optional[np.ndarray] = None) -> SimResult:
+        """``run`` with a caller-supplied adversary model.
+
+        Experiment surface (tools/schedstrength.py): lets measurement harnesses
+        swap in AdversaryModel subclasses (e.g. alternative scheduling-bias
+        rules) without forking the round loop. Product configs never need this
+        — ``run`` always uses the spec §6 model."""
+        res, _, _ = self._run_impl(cfg, inst_ids, collect_state=False, adv=adv)
+        return res
+
     def run_with_state(self, cfg: SimConfig,
                        inst_ids: Optional[np.ndarray] = None):
         """``run`` plus the FULL final per-replica state and the faulty mask.
@@ -51,11 +62,12 @@ class NumpyBackend(SimulatorBackend):
         """
         return self._run_impl(cfg, inst_ids, collect_state=True)
 
-    def _run_impl(self, cfg: SimConfig, inst_ids, collect_state: bool):
+    def _run_impl(self, cfg: SimConfig, inst_ids, collect_state: bool, adv=None):
         cfg = cfg.validate()
         ids = self._resolve_inst_ids(cfg, inst_ids)
         round_body = benor.round_body if cfg.protocol == "benor" else bracha.round_body
-        adv = AdversaryModel(cfg)
+        if adv is None:
+            adv = AdversaryModel(cfg)
         chunk = self._chunk_size(cfg)
 
         rounds_out = np.full(len(ids), cfg.round_cap, dtype=np.int32)

@@ -1,0 +1,156 @@
+"""How strong is the adaptive adversary's class-structured scheduling bias?
+(spec §6.4; SURVEY.md §3.5; VERDICT r3 weak #5 / next #8.)
+
+The shipped adaptive adversary biases delivery by receiver *class*
+(`pref_v = 0 if v < ⌈n/2⌉ else 1`) — a structure chosen so the urn model's
+scheduling strata stay count-level (spec §4b). This tool measures how much
+stalling power that choice gives up against schedulers that use the full
+per-receiver freedom of the keys model, holding the value attack (minority
+push) fixed and swapping only the bias rule:
+
+- ``none``     — no scheduling bias at all (uniform delivery); isolates the
+  value attack.
+- ``class``    — the shipped spec §6.4 rule (the urn-compatible quotient):
+  a static index split; each half of the receivers is echo-chambered toward
+  a different fixed value.
+- ``echo``     — per-receiver *state*-greedy: each receiver hears messages
+  matching its own current wire value first. The natural per-receiver rule
+  the class quotient cannot express.
+- ``anti``     — per-receiver anti-echo: messages *disagreeing* with the
+  receiver's value arrive first (push every receiver off its value).
+- ``minority`` — global-minority-first: every receiver hears the current
+  honest-minority value's messages first, balancing delivered counts to
+  starve quorums. Receiver-independent, so expressible at class granularity
+  too — included as the strongest balance-forcing rule.
+
+Runs the keys model (numpy backend — the only path with per-receiver bias
+freedom) over one full slack cycle (s = n − 3f ∈ {1, 2, 3}) with the local
+coin, where stalling power is visible as mean rounds / capped fraction; the
+shared coin is the no-stalling-power control (slack tool).
+
+Measured results: artifacts/sched_strength_r4.json, quoted in spec §6.4.
+
+CLI: ``python -m byzantinerandomizedconsensus_tpu.tools.schedstrength``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import pathlib
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu.backends.numpy_backend import NumpyBackend
+from byzantinerandomizedconsensus_tpu.config import SimConfig
+from byzantinerandomizedconsensus_tpu.models.adversaries import AdversaryModel
+
+BIAS_MODES = ("none", "class", "echo", "anti", "minority")
+
+
+class ScheduledAdaptive(AdversaryModel):
+    """Adaptive adversary with a pluggable scheduling-bias rule (keys model).
+
+    The value attack (minority push, spec §6.4) is inherited unchanged; only
+    the bias matrix handed to the §4 delivery mask is swapped. Keys-delivery
+    only: per-receiver bias has no urn-model representation (that quotient is
+    exactly what this experiment quantifies)."""
+
+    def __init__(self, cfg, bias_mode: str):
+        if cfg.adversary != "adaptive" or cfg.delivery != "keys":
+            raise ValueError("ScheduledAdaptive needs adversary='adaptive', "
+                             "delivery='keys'")
+        if bias_mode not in BIAS_MODES:
+            raise ValueError(f"unknown bias_mode {bias_mode!r}")
+        super().__init__(cfg)
+        self.bias_mode = bias_mode
+
+    def inject(self, seed, inst_ids, rnd, t, honest_values, setup, xp=np,
+               recv_ids=None):
+        values, silent, bias = super().inject(
+            seed, inst_ids, rnd, t, honest_values, setup, xp=xp,
+            recv_ids=recv_ids)
+        if self.bias_mode == "class":
+            return values, silent, bias
+        B, n = honest_values.shape
+        if self.bias_mode == "none":
+            return values, silent, xp.zeros((B, 1, n), dtype=xp.uint32)
+        vv = values[:, None, :]           # (B, 1, send)
+        if self.bias_mode in ("echo", "anti"):
+            # echo: receiver v prefers senders matching its own wire value
+            # (values[:, v]); anti: the exact complement — disagreeing (and,
+            # for non-⊥ receivers, ⊥) senders arrive first.
+            own = values[:, :, None]      # (B, recv, 1)
+            agree = (vv == own)
+            pref = agree if self.bias_mode == "echo" else ~agree
+            return values, silent, (~pref).astype(xp.uint32)
+        # minority: every receiver hears the current honest-minority value
+        # first (⊥ senders last), balancing delivered counts against quorums.
+        faulty = setup["faulty"]
+        live = ~faulty & (values != 2)
+        h1 = (live & (values == 1)).sum(-1, dtype=xp.int32)
+        h0 = (live & (values == 0)).sum(-1, dtype=xp.int32)
+        minority = xp.where(h1 <= h0, xp.uint8(1), xp.uint8(0))
+        pref = (vv == minority[:, None, None])
+        return values, silent, (~pref).astype(xp.uint32)
+
+
+def run_strength(ns, instances: int = 400, round_cap: int = 128,
+                 coin: str = "local", seed: int = 0, progress=print) -> dict:
+    """{mode: {n: summary}} over the slack cycle, keys delivery, numpy."""
+    be = NumpyBackend()
+    out: dict = {}
+    for mode in BIAS_MODES:
+        out[mode] = {}
+        for n in ns:
+            f = (n - 1) // 3
+            cfg = SimConfig(protocol="bracha", n=n, f=f, instances=instances,
+                            adversary="adaptive", coin=coin, seed=seed,
+                            round_cap=round_cap, delivery="keys").validate()
+            res = be.run_with_adversary(cfg, ScheduledAdaptive(cfg, mode))
+            capped = int((res.decision == 2).sum())
+            row = {
+                "f": f, "slack": n - 3 * f, "instances": instances,
+                "round_cap": round_cap, "coin": coin,
+                "mean_rounds": round(float(res.rounds.mean()), 3),
+                "capped_fraction": round(capped / instances, 4),
+            }
+            out[mode][str(n)] = row
+            progress(json.dumps({"mode": mode, "n": n, **row}))
+    return out
+
+
+def main(argv=None) -> int:
+    from byzantinerandomizedconsensus_tpu.utils.rounds import default_artifact
+
+    ap = argparse.ArgumentParser(
+        description="adaptive scheduling-bias strength comparison")
+    ap.add_argument("--out", default=default_artifact("sched_strength"))
+    ap.add_argument("--ns", nargs="*", type=int, default=[31, 32, 33])
+    ap.add_argument("--instances", type=int, default=400)
+    ap.add_argument("--round-cap", type=int, default=128)
+    ap.add_argument("--coin", choices=["local", "shared"], default="local")
+    ap.add_argument("--merge", action="store_true",
+                    help="merge results into an existing --out instead of "
+                         "overwriting (adds per-n columns)")
+    args = ap.parse_args(argv)
+
+    result = run_strength(tuple(args.ns), instances=args.instances,
+                          round_cap=args.round_cap, coin=args.coin)
+    out = pathlib.Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    if args.merge and out.exists():
+        old = json.loads(out.read_text())
+        for mode, rows in result.items():
+            old.setdefault(mode, {}).update(rows)
+        result = old
+    out.write_text(json.dumps(result, indent=1, sort_keys=True) + "\n")
+    print(json.dumps({"out": str(out), "capped": {
+        m: {n: r["capped_fraction"] for n, r in sorted(rows.items(), key=lambda kv: int(kv[0]))}
+        for m, rows in result.items()}}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
