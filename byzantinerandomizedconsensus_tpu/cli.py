@@ -58,8 +58,13 @@ def _config_from(args) -> SimConfig:
     ] if v is not None}
     if args.preset:
         return preset(args.preset, **overrides)
+    # Ad-hoc runs get the product scheduling model (urn, spec §4b), same as
+    # every preset — the CLI never silently selects the §4 validation model;
+    # pass --delivery keys to get it. (SimConfig's *dataclass* default stays
+    # "keys" for code-level spec-§4 work — see its docstring.)
     defaults = dict(protocol="benor", n=4, f=1, instances=1, adversary="none",
-                    coin="local", seed=0, round_cap=256, init="random")
+                    coin="local", seed=0, round_cap=256, init="random",
+                    delivery="urn")
     defaults.update(overrides)
     return SimConfig(**defaults).validate()
 

@@ -24,16 +24,19 @@ class SimConfig:
     """One simulation configuration (spec/PROTOCOL.md §7).
 
     ⚠ ``delivery`` defaults to ``"keys"`` — the spec-§4 O(n²)-mask
-    *validation* model — while every benchmark preset and the CLI/bench
-    product surface pin ``delivery="urn"`` (spec §4b), the product
-    semantics and the fast path. The bare-constructor default is kept at
-    "keys" deliberately: ad-hoc ``SimConfig(...)`` users are usually doing
-    spec-§4 cross-model work, and flipping it now would silently change
-    the sampled delivery schedule (and thus the bit-match surface) of
-    every existing bare-constructor call site — tests, golden vectors,
-    fuzz harnesses — with no signature change to flag it. If you want the
-    benchmark semantics, go through ``preset(...)``/``sweep_point(...)``
-    or pass ``delivery="urn"`` explicitly.
+    *validation* model. **Every user-facing surface defaults to the
+    product model instead**: the presets, ``sweep_point(...)``, bench.py,
+    and the CLI (including ad-hoc ``cli run`` without ``--preset``) all
+    pin or default ``delivery="urn"`` (spec §4b) — the "keys" default is
+    reachable only by constructing ``SimConfig`` in code. That bare-
+    constructor default is kept at "keys" deliberately: in-repo
+    constructor call sites are overwhelmingly spec-§4 cross-model work
+    (tests, golden vectors, fuzz harnesses), and flipping it would
+    silently change the sampled delivery schedule (and thus the
+    bit-match surface) of ~100 such sites with no signature change to
+    flag it. If you want the benchmark semantics in code, go through
+    ``preset(...)``/``sweep_point(...)`` or pass ``delivery="urn"``
+    explicitly.
     """
 
     protocol: Protocol = "benor"
