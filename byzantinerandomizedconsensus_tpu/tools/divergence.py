@@ -136,13 +136,10 @@ def run_divergence(instances: int = 400, backend: str = "numpy",
 
 
 def main(argv=None) -> int:
-    from byzantinerandomizedconsensus_tpu.utils.rounds import this_round
+    from byzantinerandomizedconsensus_tpu.utils.rounds import default_artifact
 
     ap = argparse.ArgumentParser(description="keys-vs-urn divergence map")
-    rnd = this_round()
-    ap.add_argument("--out",
-                    default=f"artifacts/divergence_r{rnd}.json" if rnd
-                    else "artifacts/divergence.json")
+    ap.add_argument("--out", default=default_artifact("divergence"))
     ap.add_argument("--instances", type=int, default=400)
     ap.add_argument("--backend", default="numpy")
     ap.add_argument("--full", action="store_true",

@@ -33,7 +33,7 @@ from byzantinerandomizedconsensus_tpu.config import (
     PRESETS, SWEEP_INSTANCES, SWEEP_POINT_N, sweep_point)
 from byzantinerandomizedconsensus_tpu.utils import metrics
 from byzantinerandomizedconsensus_tpu.utils.rounds import (
-    prev_round_artifact, this_round)
+    default_artifact, prev_round_artifact)
 from byzantinerandomizedconsensus_tpu.utils.timing import (
     DEFAULT_REPEATS, spread, timed_best_of)
 
@@ -59,10 +59,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Run all five benchmark configs as shipped; write the "
                     "product artifact")
-    rnd = this_round()
-    ap.add_argument("--out",
-                    default=f"artifacts/product_r{rnd}.json" if rnd
-                    else "artifacts/product.json")
+    ap.add_argument("--out", default=default_artifact("product"))
     ap.add_argument("--backend", default="jax",
                     help="product backend for every leg (default jax)")
     ap.add_argument("--configs", nargs="*",

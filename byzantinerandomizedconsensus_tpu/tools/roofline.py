@@ -35,7 +35,7 @@ import numpy as np
 
 from byzantinerandomizedconsensus_tpu.backends import get_backend
 from byzantinerandomizedconsensus_tpu.config import preset
-from byzantinerandomizedconsensus_tpu.utils.rounds import this_round
+from byzantinerandomizedconsensus_tpu.utils.rounds import default_artifact
 from byzantinerandomizedconsensus_tpu.utils.timing import spread, timed_best_of
 
 # uint32 VPU ops per draw-lane iteration of ops/urn.py::step_single, counted
@@ -51,20 +51,31 @@ OPS_PER_DRAW = 20
 VPU_PEAK_BAND = (1.0e12, 4.0e12)
 
 
-def parse_trace(trace_dir, min_mtime: float = 0.0) -> dict:
+def trace_snapshot(trace_dir) -> dict:
+    """{path: mtime} of every trace file currently under ``trace_dir`` — taken
+    *before* a capture so parse_trace can tell this run's output apart from
+    leftovers in a reused dir."""
+    d = pathlib.Path(trace_dir)
+    if not d.exists():
+        return {}
+    return {p: p.stat().st_mtime for p in d.rglob("*.trace.json.gz")}
+
+
+def parse_trace(trace_dir, before: dict | None = None) -> dict:
     """Device busy time + top device ops from the newest trace.json.gz under
-    ``trace_dir`` written at/after ``min_mtime`` (pre-existing traces from
-    earlier runs in a reused dir are stale — a failed capture must surface as
-    an error, never silently reparse one; mtime, not path identity, because a
-    fresh capture may legitimately overwrite a previous run's path). Durations
+    ``trace_dir`` that this run produced: a file counts iff it is a new path
+    or its mtime changed vs the ``before`` snapshot (trace_snapshot). A failed
+    capture must surface as an error, never silently reparse a stale trace —
+    and an overwrite of a previous run's path still counts as fresh. Durations
     are summed per op name over device-pid complete events; ``device_busy_s``
     sums the top-level jit program executions (child events nest inside them,
     so summing everything would double-count)."""
     import collections
     import gzip
 
+    before = before or {}
     paths = sorted((p for p in pathlib.Path(trace_dir).rglob("*.trace.json.gz")
-                    if p.stat().st_mtime >= min_mtime),
+                    if p not in before or p.stat().st_mtime != before[p]),
                    key=lambda p: p.stat().st_mtime)
     if not paths:
         return {"error": "no new trace.json.gz produced by this run"}
@@ -113,10 +124,7 @@ def executed_draw_work(res, chunk: int, cfg) -> dict:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    rnd = this_round()
-    ap.add_argument("--out",
-                    default=f"artifacts/roofline_r{rnd}.json" if rnd
-                    else "artifacts/roofline.json")
+    ap.add_argument("--out", default=default_artifact("roofline"))
     ap.add_argument("--instances", type=int, default=100_000)
     ap.add_argument("--backend", default="jax")
     ap.add_argument("--trace", default=None,
@@ -174,12 +182,10 @@ def main(argv=None) -> int:
     trace_dir = args.trace or "/tmp/roofline_trace"
     from byzantinerandomizedconsensus_tpu.utils import profiling
     try:
-        capture_start = time.time()
+        before = trace_snapshot(trace_dir)
         with profiling.trace(trace_dir):
             jax.block_until_ready(dispatch_all())
-        # 2 s slack absorbs coarse filesystem mtime granularity; captures take
-        # longer than that to go stale, and stale dirs are hours old.
-        trace_note = parse_trace(trace_dir, min_mtime=capture_start - 2.0)
+        trace_note = parse_trace(trace_dir, before=before)
         trace_note["dir"] = trace_dir
     except Exception as e:  # tunnel profilers can be unsupported
         trace_note = {"dir": trace_dir, "error": repr(e)}

@@ -45,3 +45,21 @@ def test_round_numbering(tmp_path):
     (tmp_path / "VERDICT.md").write_text("# VERDICT — round 3\n")
     assert rounds.verdict_round(tmp_path) == (True, 3)
     assert rounds.this_round(tmp_path) == 4
+
+
+def test_default_artifact_matches_prev_round_lookup(tmp_path):
+    """The shared --out default and prev_round_artifact's glob must agree —
+    a tool writing this round's default name must be found as 'previous
+    round' by the next round's guard."""
+    (tmp_path / "VERDICT.md").write_text("# VERDICT — round 3\n")
+    name = rounds.default_artifact("product", root=tmp_path)
+    assert name == "artifacts/product_r4.json"
+    art = tmp_path / name
+    art.parent.mkdir()
+    art.write_text(json.dumps({"x": 1}))
+    (tmp_path / "VERDICT.md").write_text("# VERDICT — round 4\n")  # next round
+    got = rounds.prev_round_artifact("product", root=tmp_path, subdir="artifacts")
+    assert got[:2] == ("product_r4.json", 4)
+    # unparseable VERDICT: unstamped fallback name
+    (tmp_path / "VERDICT.md").write_text("garbled\n")
+    assert rounds.default_artifact("product", root=tmp_path) == "artifacts/product.json"
