@@ -92,8 +92,15 @@ class CpuBackend(SimulatorBackend):
                             vbc.append(np.where(adv.faulty, vh, honest).astype(np.uint8))
                     else:
                         vbc = [values, values]
+                    if cfg.adversary == "adaptive":
+                        strata, minority = "class", 0
+                    elif cfg.adversary == "adaptive_min":
+                        strata = "minority"
+                        minority = adv.observed_minority(honest)
+                    else:
+                        strata, minority = "none", 0
                     c0, c1 = net.urn_counts(r, t, vbc, silent,
-                                            adaptive=cfg.adversary == "adaptive")
+                                            strata=strata, minority=minority)
                     for rep in replicas:
                         rep.on_counts(t, int(c0[rep.index]), int(c1[rep.index]))
                 else:

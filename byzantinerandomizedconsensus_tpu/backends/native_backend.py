@@ -22,7 +22,7 @@ from byzantinerandomizedconsensus_tpu.backends.base import SimResult, SimulatorB
 from byzantinerandomizedconsensus_tpu.config import SimConfig
 
 _PROTO = {"benor": 0, "bracha": 1}
-_ADV = {"none": 0, "crash": 1, "byzantine": 2, "adaptive": 3}
+_ADV = {"none": 0, "crash": 1, "byzantine": 2, "adaptive": 3, "adaptive_min": 4}
 _COIN = {"local": 0, "shared": 1}
 _INIT = {"random": 0, "all0": 1, "all1": 2, "split": 3}
 _DELIVERY = {"keys": 0, "urn": 1}

@@ -26,7 +26,8 @@ def _add_config_args(p: argparse.ArgumentParser, default_backend: str = "cpu") -
     p.add_argument("-n", type=int, default=None)
     p.add_argument("-f", type=int, default=None)
     p.add_argument("--instances", type=int, default=None)
-    p.add_argument("--adversary", choices=["none", "crash", "byzantine", "adaptive"],
+    p.add_argument("--adversary",
+                   choices=["none", "crash", "byzantine", "adaptive", "adaptive_min"],
                    default=None)
     p.add_argument("--coin", choices=["local", "shared"], default=None)
     p.add_argument("--seed", type=int, default=None)

@@ -8,7 +8,7 @@ from typing import Literal
 from byzantinerandomizedconsensus_tpu.ops import prf
 
 Protocol = Literal["benor", "bracha"]
-AdversaryKind = Literal["none", "crash", "byzantine", "adaptive"]
+AdversaryKind = Literal["none", "crash", "byzantine", "adaptive", "adaptive_min"]
 CoinKind = Literal["local", "shared"]
 InitKind = Literal["random", "all0", "all1", "split"]
 DeliveryKind = Literal["keys", "urn"]
@@ -63,7 +63,7 @@ class SimConfig:
     @property
     def lying_adversary(self) -> bool:
         """Selects Ben-Or Protocol B thresholds (spec §5.1)."""
-        return self.adversary in ("byzantine", "adaptive")
+        return self.adversary in ("byzantine", "adaptive", "adaptive_min")
 
     def validate(self) -> "SimConfig":
         if self.delivery not in ("keys", "urn"):

@@ -85,18 +85,38 @@ class AdaptiveAdversary(Adversary):
 
     kind = "adaptive"
 
-    def inject(self, rnd, t, honest_values):
-        cfg = self.cfg
-        n = cfg.n
+    def observed_minority(self, honest_values) -> int:
+        """spec §6.4: minority among live honest non-⊥ votes this step (ties → 1)."""
         honest = ~self.faulty
         nonbot = honest_values != 2
         h1 = int(np.count_nonzero(honest & nonbot & (honest_values == 1)))
         h0 = int(np.count_nonzero(honest & nonbot & (honest_values == 0)))
-        minority = 1 if h1 <= h0 else 0
+        return 1 if h1 <= h0 else 0
+
+    def inject(self, rnd, t, honest_values):
+        cfg = self.cfg
+        n = cfg.n
+        minority = self.observed_minority(honest_values)
         values = np.where(self.faulty, minority, honest_values).astype(np.uint8)
         pref = (np.arange(n) >= (n + 1) // 2).astype(np.uint8)[:, None]
         vv = values[None, :]
         bias = ((vv == 2) | (vv != pref)).astype(np.uint32)
+        return values, np.zeros(n, dtype=bool), bias
+
+
+class AdaptiveMinAdversary(AdaptiveAdversary):
+    """spec §6.4b — same value attack as §6.4, but the scheduling bias is
+    global-minority-first: every receiver hears minority-value senders first
+    (receiver-independent, hence also urn-expressible)."""
+
+    kind = "adaptive_min"
+
+    def inject(self, rnd, t, honest_values):
+        n = self.cfg.n
+        minority = self.observed_minority(honest_values)
+        values = np.where(self.faulty, minority, honest_values).astype(np.uint8)
+        vv = values[None, :]
+        bias = ((vv == 2) | (vv != np.uint8(minority))).astype(np.uint32)  # (1, n)
         return values, np.zeros(n, dtype=bool), bias
 
 
@@ -105,6 +125,7 @@ ADVERSARIES = {
     "crash": CrashAdversary,
     "byzantine": ByzantineAdversary,
     "adaptive": AdaptiveAdversary,
+    "adaptive_min": AdaptiveMinAdversary,
 }
 
 

@@ -50,14 +50,17 @@ class Network:
         return vmat, self.delivery_mask(rnd, t, silent, bias)
 
     def urn_counts(self, rnd: int, t: int, vals_by_class, silent: np.ndarray,
-                   adaptive: bool):
+                   strata: str = "none", minority: int = 0):
         """Per-receiver delivered counts (c0, c1) via the §4b urn process.
 
         ``vals_by_class``: pair of (n,) wire-value arrays, one per receiver class
-        (identical objects when the adversary doesn't equivocate). Scalar
-        python-int implementation, independent of ops/urn.py, per the spec's
-        D-iteration form (unused LCG draws are never generated, which is
-        equivalent to the vectorized f-iteration masked form).
+        (identical objects when the adversary doesn't equivocate). ``strata``
+        selects the bias rule: "none" | "class" (spec §6.4, adaptive) |
+        "minority" (spec §6.4b, adaptive_min — ``minority`` is the observed
+        minority value this step). Scalar python-int implementation, independent
+        of ops/urn.py, per the spec's D-iteration form (unused LCG draws are
+        never generated, which is equivalent to the vectorized f-iteration
+        masked form).
         """
         n, f = self.cfg.n, self.cfg.f
         half = (n + 1) // 2
@@ -72,8 +75,13 @@ class Network:
                 if u != v and not silent[u]:
                     rem[int(vals[u])] += 1
             drops = max(0, sum(rem) - k)
-            # biased(w, h): only the adaptive adversary biases scheduling.
-            st = [h != 0, h != 1, True] if adaptive else [False, False, False]
+            # biased(w, h) per spec §4b / §6.4b.
+            if strata == "class":
+                st = [h != 0, h != 1, True]
+            elif strata == "minority":
+                st = [minority != 0, minority != 1, True]
+            else:
+                st = [False, False, False]
             s = int(prf.prf_u32(self.seed, self.instance, rnd, t,
                                 np.uint32(v), 0, prf.URN, xp=np))
             for _ in range(drops):

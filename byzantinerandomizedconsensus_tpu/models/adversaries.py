@@ -37,6 +37,17 @@ def faulty_mask(cfg, seed, inst_ids, xp=np):
     return key <= kth[..., None]
 
 
+def observed_minority(honest_values, faulty, xp=np):
+    """(B,) uint8 — the spec §6.4 observation: minority value among live honest
+    non-⊥ votes this step (ties → 1). Shared by the adaptive/adaptive_min value
+    attack, the §6.4b bias rule, and the urn/Pallas stratum derivations."""
+    honest_live = ~faulty
+    nonbot = honest_values != 2
+    h1 = (honest_live & nonbot & (honest_values == 1)).sum(-1, dtype=xp.int32)
+    h0 = (honest_live & nonbot & (honest_values == 0)).sum(-1, dtype=xp.int32)
+    return xp.where(h1 <= h0, xp.uint8(1), xp.uint8(0))
+
+
 def crash_rounds(cfg, seed, inst_ids, xp=np):
     """(B, n) int32 crash round per replica (only meaningful where faulty; spec §3.3)."""
     replica = xp.arange(cfg.n, dtype=xp.uint32)[None, :]
@@ -113,22 +124,23 @@ class AdversaryModel:
                               xp.broadcast_to(honest_values[:, None, :], (B, R, n)).astype(xp.uint8))
             return values, zero_silent, no_bias
 
-        if cfg.adversary == "adaptive":
-            # spec §6.4 — observe honest votes, push the minority value, bias delivery.
-            honest_live = ~faulty
-            nonbot = honest_values != 2
-            h1 = (honest_live & nonbot & (honest_values == 1)).sum(-1, dtype=xp.int32)
-            h0 = (honest_live & nonbot & (honest_values == 0)).sum(-1, dtype=xp.int32)
-            minority = xp.where(h1 <= h0, xp.uint8(1), xp.uint8(0))
+        if cfg.adversary in ("adaptive", "adaptive_min"):
+            # spec §6.4/§6.4b — observe honest votes, push the minority value,
+            # bias delivery (by receiver class, or globally minority-first).
+            minority = observed_minority(honest_values, faulty, xp=xp)
             values = xp.where(faulty, minority[:, None], honest_values).astype(xp.uint8)
             if cfg.delivery == "urn":
                 # §4b: scheduling strata are derived inside the urn from the
                 # wire values — the (B, R, n) bias matrix is never needed.
                 return values, zero_silent, no_bias
-            # Receiver v prefers value 0 iff v < n/2; senders whose wire value matches
-            # the receiver's preference get bias 0 (delivered first), others bias 1.
-            pref = (recv_ids.astype(xp.int32) >= (n + 1) // 2)[None, :, None].astype(xp.uint8)
             vv = values[:, None, :]
+            if cfg.adversary == "adaptive_min":
+                # §6.4b: receiver-independent — minority-value senders first.
+                bias = ((vv == 2) | (vv != minority[:, None, None])).astype(xp.uint32)
+                return values, zero_silent, bias
+            # §6.4: receiver v prefers value 0 iff v < n/2; senders whose wire value
+            # matches the receiver's preference get bias 0 (delivered first).
+            pref = (recv_ids.astype(xp.int32) >= (n + 1) // 2)[None, :, None].astype(xp.uint8)
             bias = ((vv == 2) | (vv != pref)).astype(xp.uint32)
             return values, zero_silent, bias
 

@@ -118,7 +118,6 @@ def counts_nosort(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
 
     from byzantinerandomizedconsensus_tpu.ops import tally
 
-    del faulty, honest  # dense-path semantics take inject's outputs verbatim
     n = cfg.n
     B = values.shape[0]
     if recv_ids is None:
@@ -129,6 +128,12 @@ def counts_nosort(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
         pref = (recv.astype(jnp.int32) >= (n + 1) // 2)[None, :, None].astype(jnp.uint8)
         vv = values[:, None, :] if values.ndim == 2 else values
         bias = ((vv == 2) | (vv != pref)).astype(jnp.uint32)
+    elif cfg.adversary == "adaptive_min":
+        from byzantinerandomizedconsensus_tpu.models.adversaries import observed_minority
+
+        minority = observed_minority(honest, faulty, xp=jnp)  # (B,)
+        vv = values[:, None, :] if values.ndim == 2 else values
+        bias = ((vv == 2) | (vv != minority[:, None, None])).astype(jnp.uint32)
     else:
         bias = jnp.zeros((B, 1, n), dtype=jnp.uint32)
     combined = combined_keys(cfg, seed, inst_ids, rnd, t, silent, bias, xp=jnp,

@@ -132,7 +132,7 @@ def _smallest_k_mask(combined_u32, k: int):
 
 def _step_kernel(params_ref, ids_ref, values_ref, silent_ref, faulty_ref,
                  c0_ref, c1_ref, *, seed, step, n, n_deliver, tile_r, block_b,
-                 byz_equiv, adaptive, adv_bracha_byz):
+                 byz_equiv, adaptive, adaptive_min, adv_bracha_byz):
     """One (instance-block, receiver-tile) grid cell. Shapes (padded sender
     axis S): values/silent/faulty (block_b, S) i32; outputs c0/c1
     (block_b, TR) i32. The ``block_b`` instance rows are processed by an
@@ -174,6 +174,16 @@ def _step_kernel(params_ref, ids_ref, values_ref, silent_ref, faulty_ref,
             # spec §6.4 delivery bias, recomputed in-register from wire values.
             pref = (recv >= u((n + 1) // 2)).astype(jnp.int32)
             bias = ((vals == 2) | (vals != pref)).astype(jnp.uint32)
+        elif adaptive_min:
+            # spec §6.4b minority-first bias: minority recomputed in-register
+            # from the honest (non-faulty) wire values (padded senders carry
+            # value 2 and never count).
+            faulty = faulty_ref[i, :].astype(jnp.int32)[None, :]
+            hon = (faulty == 0) & (values != 2)
+            h1 = jnp.sum((hon & (values == 1)).astype(jnp.int32))
+            h0 = jnp.sum((hon & (values == 0)).astype(jnp.int32))
+            minority = jnp.where(h1 <= h0, jnp.int32(1), jnp.int32(0))
+            bias = ((vals == 2) | (vals != minority)).astype(jnp.uint32)
         else:
             bias = jnp.zeros((tile_r, S), dtype=jnp.uint32)
 
@@ -268,6 +278,7 @@ def step_counts(cfg, inst_ids, rnd, step, values, silent, faulty,
 
     byz_equiv = cfg.adversary == "byzantine" and cfg.protocol != "bracha"
     adaptive = cfg.adversary == "adaptive"
+    adaptive_min = cfg.adversary == "adaptive_min"
 
     def _pad(x, fill):
         return _pad_axis(_pad_axis(x, -1, n_pad, fill), 0, B_pad, fill)
@@ -285,7 +296,8 @@ def step_counts(cfg, inst_ids, rnd, step, values, silent, faulty,
     kernel = functools.partial(
         _step_kernel, seed=cfg.seed, step=step, n=n,
         n_deliver=n - cfg.f, tile_r=tile_r, block_b=block_b,
-        byz_equiv=byz_equiv, adaptive=adaptive, adv_bracha_byz=False,
+        byz_equiv=byz_equiv, adaptive=adaptive, adaptive_min=adaptive_min,
+        adv_bracha_byz=False,
     )
     c0, c1 = pl.pallas_call(
         kernel,

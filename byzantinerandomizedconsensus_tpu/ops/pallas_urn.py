@@ -23,7 +23,7 @@ latency-bound, so no restructuring of the draw recurrence can close the gap;
 XLA's fusion of the identical arithmetic (ops/urn.py) stays the product
 path. The affine form is kept as the cross-check kernel (it replaced the
 sequential single-stratum loop; the two-stratum sequential loop remains only
-for the adaptive adversary, where the urn size is pick-dependent).
+for the adaptive family, where the urn size is pick-dependent).
 
 Design: holds the whole per-(instance-block, receiver-tile) urn state — LCG
 streams and the remaining-count planes — in VMEM/registers for all f draws:
@@ -38,8 +38,10 @@ bit-exact against the CPU oracle in tests/test_urn.py (interpret mode on CPU;
 the same kernel lowers to Mosaic on TPU).
 
 Supports every adversary: two-faced equivocation arrives as two per-class value
-rows (values for receiver class 0 / class 1); adaptive strata are derived
-in-kernel from the receiver class. Per-receiver values never materialise.
+rows (values for receiver class 0 / class 1); scheduling strata are derived
+in-kernel — from the receiver class (adaptive, spec §6.4) or from the
+in-register minority observation over the honest wire values (adaptive_min,
+spec §6.4b, using the faulty plane). Per-receiver values never materialise.
 """
 
 from __future__ import annotations
@@ -55,18 +57,23 @@ from byzantinerandomizedconsensus_tpu.ops import prf, urn as urn_mod
 from byzantinerandomizedconsensus_tpu.ops.pallas_tally import _threefry2x32
 
 
-def _urn_kernel(params_ref, v0_ref, v1_ref, silent_ref, inst_ref, ownv_ref,
-                ownlive_ref, c0_ref, c1_ref, *, seed, step, n, f, tile_r,
-                block_b, adaptive):
+def _urn_kernel(params_ref, v0_ref, v1_ref, silent_ref, *rest, seed, step, n,
+                f, tile_r, block_b, strata):
     """One (instance-block, receiver-tile) grid cell.
 
     Inputs (padded sender axis S): v0/v1 (block_b, S) i32 — wire values toward
     receiver class 0/1 (same array content unless two-faced); silent
     (block_b, S) i32; inst (block_b, 128) i32 (instance id, lane-broadcast);
     ownv/ownlive (block_b, tile_r) i32 — the receiver's own wire value and
-    liveness, gathered by the caller (robust at shard boundaries). Outputs
-    c0/c1 (block_b, tile_r) i32. Receiver indices are global: params[1]
-    carries the shard offset (0 unsharded)."""
+    liveness, gathered by the caller (robust at shard boundaries); for
+    strata == "minority" a (block_b, S) faulty plane precedes inst (it is
+    only an input at all in that mode — the benchmark kernels never pay its
+    DMA). Outputs c0/c1 (block_b, tile_r) i32. Receiver indices are global:
+    params[1] carries the shard offset (0 unsharded)."""
+    if strata == "minority":
+        faulty_ref, inst_ref, ownv_ref, ownlive_ref, c0_ref, c1_ref = rest
+    else:
+        inst_ref, ownv_ref, ownlive_ref, c0_ref, c1_ref = rest
     k0, k1 = prf.seed_key(seed)
     k0, k1 = int(k0), int(k1)
     rnd = params_ref[0].astype(jnp.uint32)
@@ -103,8 +110,19 @@ def _urn_kernel(params_ref, v0_ref, v1_ref, silent_ref, inst_ref, ownv_ref,
         m_sel = jnp.where(h_lane, m1, m0)
         rem.append(m_sel - (live_at & (own_val == w)).astype(i32))
 
-    if adaptive:
+    adaptive = strata in ("class", "minority")  # two-stratum draw path
+    if strata == "class":
         st = [h_lane, ~h_lane, jnp.full(h_lane.shape, True)]
+    elif strata == "minority":
+        # spec §6.4b: minority recomputed in-kernel from the honest wire
+        # values (v0 == honest on non-faulty rows; padded senders carry 2).
+        fa = faulty_ref[...].astype(i32)
+        hon = (fa == 0) & (v0 != 2) & in_n
+        h1 = jnp.sum((hon & (v0 == 1)).astype(i32), axis=1, keepdims=True)
+        h0 = jnp.sum((hon & (v0 == 0)).astype(i32), axis=1, keepdims=True)
+        minority = jnp.where(h1 <= h0, i32(1), i32(0))     # (block_b, 1)
+        st = [minority != 0, minority != 1,
+              jnp.full(minority.shape, True)]
     else:
         st = [jnp.full(h_lane.shape, False)] * 3
 
@@ -183,7 +201,7 @@ def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
         n_recv, recv_offset = cfg.n, 0
     else:
         n_recv, recv_offset = recv_ids.shape[0], recv_ids[0]
-    return step_counts(cfg, inst_ids, rnd, t, v0c, v1c, silent,
+    return step_counts(cfg, inst_ids, rnd, t, v0c, v1c, silent, faulty,
                        n_recv=n_recv, recv_offset=recv_offset,
                        interpret=interpret)
 
@@ -192,7 +210,7 @@ def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
     jax.jit,
     static_argnames=("cfg", "step", "n_recv", "interpret"),
 )
-def step_counts(cfg, inst_ids, rnd, step, v0c, v1c, silent,
+def step_counts(cfg, inst_ids, rnd, step, v0c, v1c, silent, faulty=None,
                 n_recv=None, recv_offset=0, interpret: bool = False):
     """Fused (c0, c1) for one broadcast step under urn delivery.
 
@@ -232,6 +250,8 @@ def step_counts(cfg, inst_ids, rnd, step, v0c, v1c, silent,
     inst2d = jnp.broadcast_to(
         inst_ids.astype(jnp.int32)[:, None], (B, 128))
 
+    strata = {"adaptive": "class", "adaptive_min": "minority"}.get(
+        cfg.adversary, "none")
     v0c = _pad(v0c, 2)
     v1c = _pad(v1c, 2)
     silent_p = _pad(silent.astype(jnp.int32), 1)
@@ -243,21 +263,32 @@ def step_counts(cfg, inst_ids, rnd, step, v0c, v1c, silent,
 
     from byzantinerandomizedconsensus_tpu.ops.pallas_tally import align_vma
 
-    args, _vma = align_vma([params, v0c, v1c, silent_p, inst2d, ownv, ownlive])
+    # The faulty plane is an input only under minority strata (spec §6.4b) —
+    # the benchmark kernels never pay its DMA or VMEM footprint.
+    plane = pl.BlockSpec((block_b, n_pad), lambda b, r: (b, 0))
+    if strata == "minority":
+        if faulty is None:
+            faulty = jnp.zeros((B, n), dtype=jnp.int32)
+        faulty_in = [_pad(faulty.astype(jnp.int32), 0)]
+        faulty_spec = [plane]
+    else:
+        faulty_in, faulty_spec = [], []
+    args, _vma = align_vma([params, v0c, v1c, silent_p, *faulty_in, inst2d,
+                            ownv, ownlive])
 
     kernel = functools.partial(
         _urn_kernel, seed=cfg.seed, step=step, n=n, f=cfg.f,
-        tile_r=tile_r, block_b=block_b,
-        adaptive=cfg.adversary == "adaptive",
+        tile_r=tile_r, block_b=block_b, strata=strata,
     )
     c0, c1 = pl.pallas_call(
         kernel,
         grid=(b_blocks, r_tiles),
         in_specs=[
             pl.BlockSpec((2,), lambda b, r: (0,), memory_space=pltpu.SMEM),
-            pl.BlockSpec((block_b, n_pad), lambda b, r: (b, 0)),
-            pl.BlockSpec((block_b, n_pad), lambda b, r: (b, 0)),
-            pl.BlockSpec((block_b, n_pad), lambda b, r: (b, 0)),
+            plane,
+            plane,
+            plane,
+            *faulty_spec,
             pl.BlockSpec((block_b, 128), lambda b, r: (b, 0)),
             pl.BlockSpec((block_b, tile_r), lambda b, r: (b, r)),
             pl.BlockSpec((block_b, tile_r), lambda b, r: (b, r)),

@@ -87,11 +87,21 @@ def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
         M_sel = xp.where(h_lane, M1[w][:, None], M0[w][:, None])
         m.append((M_sel - (live_at & (own_val == w)).astype(i32)).astype(i32))
 
-    # Stratum flags per value, per lane class (spec §4b): only the adaptive
-    # adversary biases scheduling; biased(w, h) = (w == 2) | (w != h).
+    # Stratum flags per value (spec §4b): only the adaptive family biases
+    # scheduling. "adaptive": biased(w, h) = (w == 2) | (w != h), per lane
+    # class. "adaptive_min" (§6.4b): biased(w) = (w == 2) | (w != minority),
+    # receiver-independent — (B, 1) planes broadcast over lanes.
+    adaptive = cfg.adversary in ("adaptive", "adaptive_min")
     if cfg.adversary == "adaptive":
         st = [h_lane != (w == 1) if w < 2 else xp.broadcast_to(True, h_lane.shape)
               for w in (0, 1, 2)]
+        st = [xp.asarray(s, dtype=bool) for s in st]
+    elif cfg.adversary == "adaptive_min":
+        from byzantinerandomizedconsensus_tpu.models.adversaries import observed_minority
+
+        minority = observed_minority(honest, faulty, xp=xp)[:, None]  # (B, 1)
+        st = [minority != 0, minority != 1,
+              xp.broadcast_to(xp.asarray(True), minority.shape)]
         st = [xp.asarray(s, dtype=bool) for s in st]
     else:
         st = [xp.zeros((1, 1), dtype=bool)] * 3
@@ -102,8 +112,6 @@ def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
     inst = xp.asarray(inst_ids, dtype=xp.uint32)[:, None]
     s0 = prf.prf_u32(seed, inst, rnd, t, recv[None, :], 0, prf.URN, xp=xp)
     s0 = xp.broadcast_to(s0, (B, recv.shape[0])).astype(u32)
-
-    adaptive = cfg.adversary == "adaptive"
 
     def step(j, carry):
         """General (two-stratum) draw — spec §4b verbatim."""

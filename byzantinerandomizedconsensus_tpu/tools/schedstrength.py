@@ -21,7 +21,10 @@ push) fixed and swapping only the bias rule:
 - ``minority`` — global-minority-first: every receiver hears the current
   honest-minority value's messages first, balancing delivered counts to
   starve quorums. Receiver-independent, so expressible at class granularity
-  too — included as the strongest balance-forcing rule.
+  too — included as the strongest balance-forcing rule. **Shipped** as
+  ``adversary="adaptive_min"`` (spec §6.4b) after this measurement found it
+  weakly dominant; tests/test_adaptive_min.py pins the shipped variant
+  bit-equal to this experiment arm.
 
 Runs the keys model (numpy backend — the only path with per-receiver bias
 freedom) over one full slack cycle (s = n − 3f ∈ {1, 2, 3}) with the local

@@ -89,7 +89,8 @@ inline uint32_t prf_bit(Key k, uint32_t instance, uint32_t rnd, uint32_t step,
 // ------------------------------------------------------------------- config
 
 enum Protocol { kBenor = 0, kBracha = 1 };
-enum AdversaryKind { kNone = 0, kCrash = 1, kByzantine = 2, kAdaptive = 3 };
+enum AdversaryKind { kNone = 0, kCrash = 1, kByzantine = 2, kAdaptive = 3,
+                     kAdaptiveMin = 4 };
 enum CoinKind { kLocal = 0, kShared = 1 };
 enum InitKind { kRandom = 0, kAll0 = 1, kAll1 = 2, kSplit = 3 };
 enum DeliveryKind { kKeys = 0, kUrnDelivery = 1 };
@@ -108,7 +109,8 @@ struct Cfg {
 };
 
 inline bool lying_adversary(const Cfg& c) {
-  return c.adversary == kByzantine || c.adversary == kAdaptive;
+  return c.adversary == kByzantine || c.adversary == kAdaptive ||
+         c.adversary == kAdaptiveMin;
 }
 
 // ------------------------------------------------------------ per-thread state
@@ -183,6 +185,17 @@ void setup_instance(const Cfg& cfg, Key k, uint32_t inst, Scratch& s) {
   }
 }
 
+// spec §6.4: minority among live honest non-bot votes this step (ties -> 1).
+inline uint8_t observed_minority(const Scratch& s, int n) {
+  int h0 = 0, h1 = 0;
+  for (int j = 0; j < n; ++j) {
+    if (s.faulty[j] || s.honest[j] == 2) continue;
+    if (s.honest[j] == 1) ++h1;
+    else ++h0;
+  }
+  return (h1 <= h0) ? 1 : 0;
+}
+
 // ------------------------------------------------- adversary inject (spec §6)
 
 void inject(const Cfg& cfg, Key k, uint32_t inst, uint32_t rnd, uint32_t t,
@@ -247,13 +260,7 @@ void inject(const Cfg& cfg, Key k, uint32_t inst, uint32_t rnd, uint32_t t,
       return;
     case kAdaptive: {
       // spec §6.4 — observe honest votes, push the minority value, bias delivery.
-      int h0 = 0, h1 = 0;
-      for (int j = 0; j < n; ++j) {
-        if (s.faulty[j] || s.honest[j] == 2) continue;
-        if (s.honest[j] == 1) ++h1;
-        else ++h0;
-      }
-      const uint8_t minority = (h1 <= h0) ? 1 : 0;
+      const uint8_t minority = observed_minority(s, n);
       for (int j = 0; j < n; ++j)
         if (s.faulty[j]) s.values[j] = minority;
       if (cfg.delivery == kUrnDelivery) return;  // strata derived in-urn (§4b)
@@ -266,6 +273,23 @@ void inject(const Cfg& cfg, Key k, uint32_t inst, uint32_t rnd, uint32_t t,
           row[j] = uint8_t(vv == 2 || vv != pref);
         }
       }
+      return;
+    }
+    case kAdaptiveMin: {
+      // spec §6.4b — same value attack; global-minority-first scheduling.
+      const uint8_t minority = observed_minority(s, n);
+      for (int j = 0; j < n; ++j)
+        if (s.faulty[j]) s.values[j] = minority;
+      if (cfg.delivery == kUrnDelivery) return;  // strata derived in-urn (§4b)
+      // Receiver-independent bias: compute one row, replicate it.
+      s.bias_per_recv = true;
+      uint8_t* row0 = s.bias.data();
+      for (int j = 0; j < n; ++j) {
+        const uint8_t vv = s.values[j];
+        row0[j] = uint8_t(vv == 2 || vv != minority);
+      }
+      for (int v = 1; v < n; ++v)
+        std::memcpy(&s.bias[size_t(v) * n], row0, size_t(n));
       return;
     }
   }
@@ -341,6 +365,8 @@ void urn_deliver_and_tally(const Cfg& cfg, Key k, uint32_t inst, uint32_t rnd,
   const int half = (n + 1) / 2;
   const int quota = n - f - 1;
   const bool adaptive = cfg.adversary == kAdaptive;
+  const bool adaptive_min = cfg.adversary == kAdaptiveMin;
+  const uint8_t minority = adaptive_min ? observed_minority(s, n) : 0;
   for (int v = 0; v < n; ++v) {
     const int h = (v >= half) ? 1 : 0;
     const uint8_t* vals =
@@ -350,7 +376,10 @@ void urn_deliver_and_tally(const Cfg& cfg, Key k, uint32_t inst, uint32_t rnd,
       if (j != v && !s.silent[j]) ++rem[vals[j]];
     const int total = rem[0] + rem[1] + rem[2];
     const int drops = std::max(0, total - quota);
-    const bool st[3] = {adaptive && h != 0, adaptive && h != 1, adaptive};
+    // biased(w) per spec §4b (class rule) / §6.4b (minority-first).
+    const bool st[3] = {(adaptive && h != 0) || (adaptive_min && minority != 0),
+                        (adaptive && h != 1) || (adaptive_min && minority != 1),
+                        adaptive || adaptive_min};
     uint32_t state = prf_u32(k, inst, rnd, t, uint32_t(v), 0, kUrn);
     for (int dr = 0; dr < drops; ++dr) {
       state = state * kUrnLcgA + kUrnLcgC;

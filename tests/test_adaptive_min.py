@@ -1,0 +1,116 @@
+"""adaptive_min (spec §6.4b): the measured-strongest count-level scheduler as a
+product adversary.
+
+Round 4's scheduler-strength map (tools/schedstrength.py, spec §6.4) found
+global-minority-first delivery weakly dominates the shipped class rule at every
+measured point and is receiver-independent — i.e. expressible in the §4b urn
+model. This file pins the shipped variant: 4-way bit-match across
+implementation stacks on both delivery models, exact equivalence with the
+experiment arm that motivated it, sharded-path equality, protocol properties,
+and the stalling power that justifies shipping it.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from byzantinerandomizedconsensus_tpu import SimConfig, Simulator
+
+CONFIGS = [
+    SimConfig(protocol="bracha", n=16, f=5, instances=40, adversary="adaptive_min",
+              coin="shared", round_cap=64, seed=9, delivery="urn"),
+    SimConfig(protocol="bracha", n=16, f=5, instances=24, adversary="adaptive_min",
+              coin="local", round_cap=32, seed=9, delivery="urn"),
+    SimConfig(protocol="benor", n=11, f=2, instances=40, adversary="adaptive_min",
+              coin="local", round_cap=64, seed=3, delivery="urn"),
+    SimConfig(protocol="bracha", n=16, f=5, instances=24, adversary="adaptive_min",
+              coin="local", round_cap=32, seed=9, delivery="keys"),
+    SimConfig(protocol="benor", n=11, f=2, instances=24, adversary="adaptive_min",
+              coin="shared", round_cap=64, seed=3, delivery="keys"),
+]
+
+# Pallas legs run on the shared-coin configs (few rounds — interpret-mode
+# cost scales with executed steps), one per delivery model; the in-kernel
+# §6.4b minority derivation runs every step either way.
+_PALLAS_IDX = {0, 4}
+
+
+@pytest.mark.parametrize(
+    "idx,cfg", list(enumerate(CONFIGS)),
+    ids=lambda x: f"{x.protocol}-{x.coin}-{x.delivery}" if isinstance(x, SimConfig) else None)
+def test_bitmatch_across_stacks(idx, cfg):
+    """cpu oracle == numpy == jax == native (and the Pallas kernels on the two
+    configs that exercise their in-kernel minority derivation)."""
+    ref = Simulator(cfg, "cpu").run()
+    backends = ["numpy", "jax", "native"]
+    if idx in _PALLAS_IDX:
+        backends.append("jax_pallas")
+    for backend in backends:
+        got = Simulator(cfg, backend).run()
+        np.testing.assert_array_equal(ref.rounds, got.rounds, err_msg=backend)
+        np.testing.assert_array_equal(ref.decision, got.decision, err_msg=backend)
+
+
+def test_equals_schedstrength_minority_arm():
+    """The shipped adversary IS the experiment arm that motivated it: an
+    adaptive_min keys run bit-equals ScheduledAdaptive(bias_mode='minority')
+    run on the otherwise-identical adaptive config (the adversary kind enters
+    no PRF stream, so the trajectories must be identical draw-for-draw)."""
+    from byzantinerandomizedconsensus_tpu.backends.numpy_backend import NumpyBackend
+    from byzantinerandomizedconsensus_tpu.tools.schedstrength import ScheduledAdaptive
+
+    cfg_min = SimConfig(protocol="bracha", n=16, f=5, instances=60,
+                        adversary="adaptive_min", coin="local", round_cap=32,
+                        seed=0, delivery="keys").validate()
+    cfg_cls = dataclasses.replace(cfg_min, adversary="adaptive")
+    shipped = Simulator(cfg_min, "numpy").run()
+    arm = NumpyBackend().run_with_adversary(
+        cfg_cls, ScheduledAdaptive(cfg_cls, "minority"))
+    np.testing.assert_array_equal(shipped.rounds, arm.rounds)
+    np.testing.assert_array_equal(shipped.decision, arm.decision)
+
+
+def test_sharded_bitmatch():
+    from byzantinerandomizedconsensus_tpu.parallel.mesh import make_mesh
+    from byzantinerandomizedconsensus_tpu.parallel.sharded import JaxShardedBackend
+
+    cfg = CONFIGS[0]
+    ref = Simulator(cfg, "cpu").run()
+    got = JaxShardedBackend(mesh=make_mesh(n_data=4, n_model=2)).run(cfg)
+    np.testing.assert_array_equal(ref.rounds, got.rounds)
+    np.testing.assert_array_equal(ref.decision, got.decision)
+
+
+def test_agreement_and_validity():
+    """Agreement is asserted inside every cpu-oracle run (backends/cpu.py);
+    validity via unanimous starts — the §6.4b liveness argument's base case."""
+    for cfg in CONFIGS[:2]:
+        for init, expect in (("all0", 0), ("all1", 1)):
+            c = dataclasses.replace(cfg, init=init, instances=20)
+            r = Simulator(c, "cpu").run()
+            decided = r.decision != 2
+            assert np.all(r.decision[decided] == expect), (cfg, init)
+
+
+def test_stalling_power_anchor():
+    """Why it ships: at the n=16 local-coin anchor adaptive_min stalls ≥90% of
+    instances to the cap — the §6.4 measured map's 'weakly dominates every
+    rule' row, pinned at product scale (numpy, deterministic)."""
+    cfg = SimConfig(protocol="bracha", n=16, f=5, instances=80,
+                    adversary="adaptive_min", coin="local", round_cap=32,
+                    seed=0, delivery="urn").validate()
+    res = Simulator(cfg, "numpy").run()
+    assert float((res.decision == 2).mean()) >= 0.9
+    # and the shared coin (the stub of BASELINE.json:10) still defeats it
+    fast = Simulator(dataclasses.replace(cfg, coin="shared"), "numpy").run()
+    assert float(fast.rounds.mean()) < 4
+
+
+def test_validate_bounds():
+    """adaptive_min is a lying adversary: benor needs n > 5f (Protocol B)."""
+    with pytest.raises(ValueError):
+        SimConfig(protocol="benor", n=10, f=2, adversary="adaptive_min").validate()
+    SimConfig(protocol="benor", n=11, f=2, adversary="adaptive_min").validate()
+    with pytest.raises(ValueError):
+        SimConfig(protocol="bracha", n=9, f=3, adversary="adaptive_min").validate()
