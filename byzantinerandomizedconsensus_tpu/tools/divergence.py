@@ -64,6 +64,19 @@ GRID: tuple[tuple[SimConfig, str], ...] = (
                seed=5, **_BASE), "robust"),
     (SimConfig(protocol="bracha", n=16, f=5, adversary="adaptive", coin="shared",
                seed=11, **_BASE), "robust"),
+    # adaptive_min (spec §6.4b) is delivery-robust by the same two mechanisms
+    # as the class rule — its bias is a function of the wire value alone, so
+    # binary-alphabet steps have value-homogeneous strata, and the ⊥-bearing
+    # step's ⊥/majority drop split stays inside dead margins (measured; note
+    # even benor+adaptive_min is robust where benor+adaptive diverges — the
+    # receiver-independent bias removes the class/value misalignment that
+    # made the n=11 class-rule row divergent).
+    (SimConfig(protocol="bracha", n=16, f=5, adversary="adaptive_min",
+               coin="local", seed=5, **_BASE), "robust"),
+    (SimConfig(protocol="bracha", n=16, f=5, adversary="adaptive_min",
+               coin="shared", seed=11, **_BASE), "robust"),
+    (SimConfig(protocol="benor", n=11, f=2, adversary="adaptive_min",
+               coin="local", seed=3, **_BASE), "robust"),
 )
 
 # Large-n config-5-family rows (--full): the round-3 "identical at every sweep

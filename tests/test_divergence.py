@@ -42,14 +42,23 @@ def test_divergence_exists_and_statistics_accept(cfg, min_frac):
     assert abs(row["p1_keys"] - row["p1_urn"]) < 0.08, row
 
 
-@pytest.mark.parametrize("coin,seed", [("local", 5), ("local", 99), ("shared", 11)])
-def test_config5_family_delivery_robust(coin, seed):
-    """bracha + adaptive (the config-5 pairing): per-instance outcomes are
-    *identical* across the delivery models — the round-3 finding, pinned.
+@pytest.mark.parametrize("adversary,protocol,n,f,coin,seed", [
+    ("adaptive", "bracha", 16, 5, "local", 5),
+    ("adaptive", "bracha", 16, 5, "local", 99),
+    ("adaptive", "bracha", 16, 5, "shared", 11),
+    # adaptive_min (spec §6.4b) is robust under BOTH protocols — including
+    # benor, where the class rule diverges (its bias is a pure function of
+    # the wire value, so strata stay value-homogeneous on binary steps).
+    ("adaptive_min", "bracha", 16, 5, "local", 5),
+    ("adaptive_min", "benor", 11, 2, "local", 3),
+])
+def test_config5_family_delivery_robust(adversary, protocol, n, f, coin, seed):
+    """The adaptive family: per-instance outcomes are *identical* across the
+    delivery models — the round-3 finding, pinned and extended to §6.4b.
     Spec §4b explains the two mechanisms (homogeneous strata on binary-alphabet
-    steps; dead-margin ⊥/minority jitter on step 2)."""
-    cfg = SimConfig(protocol="bracha", n=16, f=5, instances=200,
-                    adversary="adaptive", coin=coin, seed=seed, round_cap=64)
+    steps; dead-margin ⊥-jitter on the remaining step)."""
+    cfg = SimConfig(protocol=protocol, n=n, f=f, instances=200,
+                    adversary=adversary, coin=coin, seed=seed, round_cap=64)
     keys = Simulator(cfg, "numpy").run()
     urn = Simulator(dataclasses.replace(cfg, delivery="urn"), "numpy").run()
     np.testing.assert_array_equal(keys.rounds, urn.rounds)
