@@ -124,23 +124,74 @@ def run_strength(ns, instances: int = 400, round_cap: int = 128,
     return out
 
 
+def run_shipped(ns, instances: int = 2000, round_cap: int = 128,
+                coin: str = "local", backend: str = "jax",
+                delivery: str = "urn", seed: int = 0, progress=print) -> dict:
+    """The *shipped* adversaries (spec §6.4 class / §6.4b minority-first)
+    through an ordinary product backend — validates the experiment-harness
+    findings on the product path (urn delivery, accelerated backend) instead
+    of the keys/numpy harness the bias variants require."""
+    from byzantinerandomizedconsensus_tpu.backends import get_backend
+
+    be = get_backend(backend)
+    out: dict = {}
+    for adv in ("adaptive", "adaptive_min"):
+        out[adv] = {}
+        for n in ns:
+            f = (n - 1) // 3
+            cfg = SimConfig(protocol="bracha", n=n, f=f, instances=instances,
+                            adversary=adv, coin=coin, seed=seed,
+                            round_cap=round_cap, delivery=delivery).validate()
+            res = be.run(cfg)
+            capped = int((res.decision == 2).sum())
+            row = {
+                "f": f, "slack": n - 3 * f, "instances": instances,
+                "round_cap": round_cap, "coin": coin,
+                "backend": backend, "delivery": delivery,
+                "mean_rounds": round(float(res.rounds.mean()), 3),
+                "capped_fraction": round(capped / instances, 4),
+            }
+            out[adv][str(n)] = row
+            progress(json.dumps({"adversary": adv, "n": n, **row}))
+    return out
+
+
 def main(argv=None) -> int:
     from byzantinerandomizedconsensus_tpu.utils.rounds import default_artifact
 
     ap = argparse.ArgumentParser(
         description="adaptive scheduling-bias strength comparison")
-    ap.add_argument("--out", default=default_artifact("sched_strength"))
+    ap.add_argument("--out", default=None)
     ap.add_argument("--ns", nargs="*", type=int, default=[31, 32, 33])
-    ap.add_argument("--instances", type=int, default=400)
+    ap.add_argument("--instances", type=int, default=None)
     ap.add_argument("--round-cap", type=int, default=128)
     ap.add_argument("--coin", choices=["local", "shared"], default="local")
     ap.add_argument("--merge", action="store_true",
                     help="merge results into an existing --out instead of "
                          "overwriting (adds per-n columns)")
+    ap.add_argument("--shipped", action="store_true",
+                    help="run the shipped adaptive/adaptive_min adversaries "
+                         "through a product backend (urn) instead of the "
+                         "keys/numpy bias-variant harness")
+    ap.add_argument("--backend", default="jax",
+                    help="backend for --shipped (default jax)")
     args = ap.parse_args(argv)
+    if args.out is None:
+        args.out = default_artifact(
+            "sched_strength_shipped" if args.shipped else "sched_strength")
+    if args.instances is None:
+        args.instances = 2000 if args.shipped else 400
 
-    result = run_strength(tuple(args.ns), instances=args.instances,
-                          round_cap=args.round_cap, coin=args.coin)
+    if args.shipped:
+        from byzantinerandomizedconsensus_tpu.utils.devices import ensure_live_backend
+
+        ensure_live_backend()
+        result = run_shipped(tuple(args.ns), instances=args.instances,
+                             round_cap=args.round_cap, coin=args.coin,
+                             backend=args.backend)
+    else:
+        result = run_strength(tuple(args.ns), instances=args.instances,
+                              round_cap=args.round_cap, coin=args.coin)
     out = pathlib.Path(args.out)
     out.parent.mkdir(parents=True, exist_ok=True)
     if args.merge and out.exists():

@@ -32,6 +32,14 @@ GOLDEN_CONFIGS = {
     "urn_bracha_adaptive": SimConfig(protocol="bracha", n=13, f=4, instances=100,
                                      adversary="adaptive", coin="shared",
                                      round_cap=64, seed=6, delivery="urn"),
+    # adaptive_min (spec §6.4b, added round 4) — both delivery models.
+    "bracha_adaptive_min": SimConfig(protocol="bracha", n=13, f=4, instances=100,
+                                     adversary="adaptive_min", coin="shared",
+                                     round_cap=64, seed=7),
+    "urn_bracha_adaptive_min": SimConfig(protocol="bracha", n=13, f=4,
+                                         instances=100, adversary="adaptive_min",
+                                         coin="shared", round_cap=64, seed=8,
+                                         delivery="urn"),
 }
 
 PATH = pathlib.Path(__file__).parent / "golden.npz"
