@@ -79,6 +79,14 @@ def test_invariants_urn_at_benchmark_n(n, f, adversary):
     _assert_invariants(cfg, res, state, faulty)
 
 
+def test_invariants_urn_adaptive_min_at_scale():
+    """adaptive_min (spec §6.4b) holds the direct invariants at scale too;
+    n=256 keeps the fast-suite cost of the extra adversary modest (the n=512
+    shape is covered for the grid above)."""
+    cfg, res, state, faulty = _run(256, 85, "adaptive_min", "urn", instances=200)
+    _assert_invariants(cfg, res, state, faulty)
+
+
 @pytest.mark.parametrize("n,f,adversary,instances", [
     (256, 85, "byzantine", 64),
     (256, 85, "adaptive", 64),

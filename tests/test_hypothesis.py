@@ -11,12 +11,13 @@ from byzantinerandomizedconsensus_tpu.ops import prf
 @st.composite
 def sim_configs(draw):
     protocol = draw(st.sampled_from(["benor", "bracha"]))
-    adversary = draw(st.sampled_from(["none", "crash", "byzantine", "adaptive"]))
+    adversary = draw(st.sampled_from(
+        ["none", "crash", "byzantine", "adaptive", "adaptive_min"]))
     coin = draw(st.sampled_from(["local", "shared"]))
     n = draw(st.integers(min_value=4, max_value=24))
     if protocol == "bracha":
         fmax = (n - 1) // 3
-    elif adversary in ("byzantine", "adaptive"):
+    elif adversary in ("byzantine", "adaptive", "adaptive_min"):
         fmax = (n - 1) // 5
     else:
         fmax = (n - 1) // 2
