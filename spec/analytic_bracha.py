@@ -1,4 +1,4 @@
-"""Exact rounds-to-decision law for Bracha n=4, f=1, Byzantine adversary.
+"""Exact rounds-to-decision law for Bracha n=4, f=1 — Byzantine and adaptive_min.
 
 Second closed-form anchor (VERDICT r2 #8), companion to spec/analytic.py's
 Ben-Or chain: Bracha's three-step round with §5.1b message validation is the
@@ -68,6 +68,16 @@ K = N - F - 1        # 2: delivered others on top of own
 OUT_SILENT, OUT_ZERO, OUT_ONE, OUT_HONEST = range(4)
 BOT = 2
 
+# Third anchor (round 4): adversary="adaptive_min" (spec §6.4b) on the same
+# chain skeleton. The injection is *deterministic* given the honest profile
+# (faulty sends the observed minority, never silent), and delivery gains the
+# minority-first strata: the single drop at L = 3 comes uniformly from the
+# biased stratum (value != minority, or bot) when it is nonempty. The §4/§4b
+# law equality argument extends: under the keys model biased messages carry
+# bit 30, so the largest combined key — the dropped one — is uniform over the
+# biased stratum by key exchangeability within it; exactly the urn's
+# stratum-first class-proportional draw.
+
 
 def _valid(step: int, value: int, g) -> bool:
     """spec §5.1b at n=4, f=1. ``g`` = (G_0, G_1) of the previous step."""
@@ -99,6 +109,24 @@ def _wire(step_vals, o):
     return vals, silent
 
 
+def _observed_minority(step_vals):
+    """spec §6.4: minority among the correct replicas' non-bot values this
+    step (ties -> 1). ``step_vals`` are the honest machine values, faulty
+    first — the observation excludes index 0."""
+    h1 = sum(1 for v in step_vals[1:] if v == 1)
+    h0 = sum(1 for v in step_vals[1:] if v == 0)
+    return 1 if h1 <= h0 else 0
+
+
+def _wire_adaptive_min(step_vals):
+    """spec §6.4b injection: deterministic — faulty sends the minority, never
+    silent. Returns (vals, silent, minority)."""
+    minority = _observed_minority(step_vals)
+    vals = list(step_vals)
+    vals[0] = minority
+    return vals, [False] * N, minority
+
+
 def _apply_validation(step, vals, silent, g_prev):
     """Silence invalid senders (spec §5.2: merged into the silent set before
     the delivery draw). Correct senders must never be invalid (§5.1b claim)."""
@@ -118,26 +146,36 @@ def _live_counts(vals, silent):
             sum(1 for u in range(N) if not silent[u] and vals[u] == 1))
 
 
-def _deliver_dist(own_val, others):
+def _deliver_dist(own_val, others, minority=None):
     """{(c0, c1): p} — delivered counts at one receiver (spec §4b).
 
     ``others``: [cnt_0, cnt_1, cnt_⊥] of live other senders. L ≤ 3 others;
-    at L = 3 one uniformly chosen message is dropped (class probability
-    proportional to remaining class count — the single-stratum urn), at
-    L ≤ 2 everything live is delivered. Own message always on top.
+    at L = 3 one message is dropped, at L ≤ 2 everything live is delivered.
+    Own message always on top. ``minority=None``: unbiased — the drop is
+    uniform over live others (class probability proportional to class count —
+    the single-stratum urn). ``minority`` set (spec §6.4b): the drop comes
+    from the biased stratum (value != minority, or bot) when nonempty,
+    uniformly within it.
     """
     L = sum(others)
     own = (1 if own_val == 0 else 0, 1 if own_val == 1 else 0)
     if L <= K:
         return {(others[0] + own[0], others[1] + own[1]): 1.0}
+    if minority is None:
+        pool = [0, 1, 2]
+    else:
+        pool = [w for w in (0, 1, 2) if (w == 2 or w != minority) and others[w]]
+        if not pool:          # no biased message live: uniform over the rest
+            pool = [0, 1, 2]
+    tot = sum(others[w] for w in pool)
     out = {}
-    for w in range(3):
+    for w in pool:
         if others[w] == 0:
             continue
         rem = list(others)
         rem[w] -= 1
         key = (rem[0] + own[0], rem[1] + own[1])
-        out[key] = out.get(key, 0.0) + others[w] / L
+        out[key] = out.get(key, 0.0) + others[w] / tot
     return out
 
 
@@ -172,7 +210,7 @@ def _product_over_receivers(recv_dists):
     return out
 
 
-def _round_transitions(state, coin):
+def _round_transitions(state, coin, adversary="byzantine"):
     """{(next_state, all_correct_decided): prob} for one round."""
     f_state, c_states = state
     states = [f_state] + list(c_states)          # index 0 = faulty
@@ -180,10 +218,20 @@ def _round_transitions(state, coin):
     decided = [s[1] for s in states]
     out = {}
 
-    for o_vec in itertools.product(range(4), repeat=3):
-        p_o = 0.25 ** 3
+    if adversary == "byzantine":
+        o_vecs = [(o, 0.25 ** 3) for o in itertools.product(range(4), repeat=3)]
+    else:                 # adaptive_min: deterministic injection per step
+        o_vecs = [((None,) * 3, 1.0)]
+
+    def wire(step_vals, o):
+        if adversary == "byzantine":
+            vals, silent = _wire(step_vals, o)
+            return vals, silent, None
+        return _wire_adaptive_min(step_vals)
+
+    for o_vec, p_o in o_vecs:
         # ---- step 0: honest values are the (frozen) estimates.
-        vals0, silent0 = _wire(ests, o_vec[0])
+        vals0, silent0, min0 = wire(ests, o_vec[0])
         g0 = _live_counts(vals0, silent0)
         # Per-receiver m distribution.
         m_dists = []
@@ -193,13 +241,13 @@ def _round_transitions(state, coin):
                 if u != v and not silent0[u]:
                     others[vals0[u]] += 1
             dist_v = {}
-            for cnts, pc in _deliver_dist(vals0[v], others).items():
+            for cnts, pc in _deliver_dist(vals0[v], others, min0).items():
                 m = _derive(0, cnts)
                 dist_v[m] = dist_v.get(m, 0.0) + pc
             m_dists.append(dist_v)
         for m_prof, p_m in _product_over_receivers(m_dists).items():
             # ---- step 1: honest values are the m's; validation vs g0.
-            vals1, silent1 = _wire(m_prof, o_vec[1])
+            vals1, silent1, min1 = wire(m_prof, o_vec[1])
             silent1 = _apply_validation(1, vals1, silent1, g0)
             g1 = _live_counts(vals1, silent1)
             d_dists = []
@@ -209,13 +257,13 @@ def _round_transitions(state, coin):
                     if u != v and not silent1[u]:
                         others[vals1[u]] += 1
                 dist_v = {}
-                for cnts, pc in _deliver_dist(vals1[v], others).items():
+                for cnts, pc in _deliver_dist(vals1[v], others, min1).items():
                     d = _derive(1, cnts)
                     dist_v[d] = dist_v.get(d, 0.0) + pc
                 d_dists.append(dist_v)
             for d_prof, p_d in _product_over_receivers(d_dists).items():
                 # ---- step 2: honest values are the d's; validation vs g1.
-                vals2, silent2 = _wire(d_prof, o_vec[2])
+                vals2, silent2, min2 = wire(d_prof, o_vec[2])
                 silent2 = _apply_validation(2, vals2, silent2, g1)
                 act_dists = []
                 for v in range(N):
@@ -224,7 +272,7 @@ def _round_transitions(state, coin):
                         if u != v and not silent2[u]:
                             others[vals2[u]] += 1
                     dist_v = {}
-                    for cnts, pc in _deliver_dist(vals2[v], others).items():
+                    for cnts, pc in _deliver_dist(vals2[v], others, min2).items():
                         act = _derive(2, cnts)
                         dist_v[act] = dist_v.get(act, 0.0) + pc
                     act_dists.append(dist_v)
@@ -264,8 +312,8 @@ def _round_transitions(state, coin):
     return out
 
 
-@lru_cache(maxsize=4)
-def rounds_law(coin: str = "shared"):
+@lru_cache(maxsize=8)
+def rounds_law(coin: str = "shared", adversary: str = "byzantine"):
     """Solve the chain exactly: returns (E_by_state, P1_by_state) where
     E is E[rounds to all-correct-decided | state] and P1 the probability the
     correct replicas' common decision is 1."""
@@ -278,7 +326,7 @@ def rounds_law(coin: str = "shared"):
         s = todo.pop()
         if s in trans:
             continue
-        t = _round_transitions(s, coin)
+        t = _round_transitions(s, coin, adversary)
         trans[s] = t
         for (ns, done) in t:
             if not done and ns not in trans:
@@ -308,10 +356,11 @@ def rounds_law(coin: str = "shared"):
             {s: float(P1[idx[s]]) for s in states})
 
 
-@lru_cache(maxsize=4)
-def expected_rounds_bracha_n4(coin: str = "shared") -> float:
+@lru_cache(maxsize=8)
+def expected_rounds_bracha_n4(coin: str = "shared",
+                              adversary: str = "byzantine") -> float:
     """E[rounds], initial estimates iid uniform (incl. the faulty one)."""
-    E, _ = rounds_law(coin)
+    E, _ = rounds_law(coin, adversary)
     tot = 0.0
     for bits in itertools.product((0, 1), repeat=N):
         s = ((bits[0], False), tuple(sorted((e, False) for e in bits[1:])))
@@ -319,14 +368,15 @@ def expected_rounds_bracha_n4(coin: str = "shared") -> float:
     return tot / 2 ** N
 
 
-@lru_cache(maxsize=4)
-def p_decide_one_bracha_n4(coin: str = "shared") -> float:
+@lru_cache(maxsize=8)
+def p_decide_one_bracha_n4(coin: str = "shared",
+                           adversary: str = "byzantine") -> float:
     """P[common decision = 1], initial estimates iid uniform. Exactly 1/2:
     at n=4 the delivered step-0/1 count is always 3 (odd — the m/d ties→1
     rules never fire) and a step-2 tie forces c ≤ 1 (the coin branch), so
     every ties→1 rule is outcome-irrelevant and the chain is 0↔1 symmetric
     (spec §8b). At larger n the tie-breaks do bias toward 1."""
-    _, P1 = rounds_law(coin)
+    _, P1 = rounds_law(coin, adversary)
     tot = 0.0
     for bits in itertools.product((0, 1), repeat=N):
         s = ((bits[0], False), tuple(sorted((e, False) for e in bits[1:])))
@@ -335,8 +385,11 @@ def p_decide_one_bracha_n4(coin: str = "shared") -> float:
 
 
 if __name__ == "__main__":
-    for coin in ("shared", "local"):
-        E, P1 = rounds_law(coin)
-        print(f"coin={coin}: reachable undecided states: {len(E)}")
-        print(f"  E[rounds]  (uniform init) = {expected_rounds_bracha_n4(coin):.6f}")
-        print(f"  P[decide 1](uniform init) = {p_decide_one_bracha_n4(coin):.6f}")
+    for adversary in ("byzantine", "adaptive_min"):
+        for coin in ("shared", "local"):
+            E, P1 = rounds_law(coin, adversary)
+            print(f"{adversary}/{coin}: reachable undecided states: {len(E)}")
+            print(f"  E[rounds]  (uniform init) = "
+                  f"{expected_rounds_bracha_n4(coin, adversary):.6f}")
+            print(f"  P[decide 1](uniform init) = "
+                  f"{p_decide_one_bracha_n4(coin, adversary):.6f}")

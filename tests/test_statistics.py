@@ -127,6 +127,41 @@ def test_bracha_decision_split_matches_exact_chain():
     assert abs(p_decide_one_bracha_n4("local") - 0.5) < 1e-9
 
 
+def test_mean_rounds_matches_exact_adaptive_min_chain():
+    """Third closed-form anchor (spec §8c, round 4): Bracha n=4 f=1 under
+    adaptive_min. Deterministic minority injection + minority-first biased
+    delivery collapse the chain to 8 undecided states with exact rational
+    constants — E[rounds] = 1.75 (shared) / 4.0 (local), both delivery models
+    (the local value, 3.05× the Byzantine anchor's 1.313, is the closed-form
+    statement of §6.4's measured small-n dominance). P[decide 1] = 1/2 exactly
+    (the §8b symmetry argument carries over)."""
+    from spec.analytic_bracha import (
+        expected_rounds_bracha_n4, p_decide_one_bracha_n4)
+
+    pinned = {"shared": 1.75, "local": 4.0}
+    for coin, want in pinned.items():
+        assert abs(expected_rounds_bracha_n4(coin, "adaptive_min") - want) < 1e-9, \
+            f"enumeration drifted from the pinned spec §8c value ({coin})"
+        assert abs(p_decide_one_bracha_n4(coin, "adaptive_min") - 0.5) < 1e-9
+    for coin, want in pinned.items():
+        for delivery in ("urn", "keys"):
+            cfg = SimConfig(protocol="bracha", n=4, f=1, instances=8000,
+                            adversary="adaptive_min", coin=coin, round_cap=64,
+                            seed=47, delivery=delivery)
+            res = Simulator(cfg, "numpy").run()
+            r = res.rounds.astype(np.float64)
+            sem = r.std(ddof=1) / np.sqrt(len(r))
+            z = (r.mean() - want) / sem
+            assert abs(z) < 4.5, (
+                f"{coin}/{delivery}: mean {r.mean():.4f} vs exact "
+                f"{want} (z={z:+.2f})")
+            d = res.decision
+            assert (d != 2).all()
+            assert _chi2_fair(int((d == 0).sum()),
+                              int((d == 1).sum())) < CHI2_1DOF_P001, \
+                f"{coin}/{delivery}: decision split off 1/2"
+
+
 def test_rabin_configuration_constant_rounds():
     """Rabin (FOCS 1983) = Ben-Or's rounds + a common lottery coin — the
     `protocol="benor", coin="shared"` configuration (spec §5.3). Its defining
