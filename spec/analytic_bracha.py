@@ -218,6 +218,10 @@ def _round_transitions(state, coin, adversary="byzantine"):
     decided = [s[1] for s in states]
     out = {}
 
+    if adversary not in ("byzantine", "adaptive_min"):
+        # "adaptive" (the class rule) is NOT enumerated here — a typo must not
+        # silently return the adaptive_min chain's constants for it.
+        raise ValueError(f"no exact chain for adversary {adversary!r}")
     if adversary == "byzantine":
         o_vecs = [(o, 0.25 ** 3) for o in itertools.product(range(4), repeat=3)]
     else:                 # adaptive_min: deterministic injection per step
