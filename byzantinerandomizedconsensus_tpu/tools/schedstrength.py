@@ -156,6 +156,36 @@ def run_shipped(ns, instances: int = 2000, round_cap: int = 128,
     return out
 
 
+def plot_strength(panels, path) -> None:
+    """Grouped-bar capped-fraction figure: one panel per artifact, one bar
+    group per n (slack labeled), one bar per mode/adversary."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, axes = plt.subplots(1, len(panels), figsize=(6.4 * len(panels), 4.2),
+                             squeeze=False)
+    for ax, (title, doc) in zip(axes[0], panels):
+        modes = sorted(doc)
+        ns = sorted({n for rows in doc.values() for n in rows}, key=int)
+        width = 0.8 / len(modes)
+        for k, mode in enumerate(modes):
+            xs = [i + k * width for i in range(len(ns))]
+            ys = [doc[mode].get(n, {}).get("capped_fraction", 0.0) for n in ns]
+            ax.bar(xs, ys, width=width, label=mode)
+        slack = {n: doc[modes[0]][n]["slack"] for n in ns if n in doc[modes[0]]}
+        ax.set_xticks([i + 0.4 - width / 2 for i in range(len(ns))])
+        ax.set_xticklabels([f"n={n}\ns={slack.get(n, '?')}" for n in ns])
+        ax.set_ylim(0, 1.05)
+        ax.set_ylabel("capped fraction")
+        ax.set_title(title)
+        ax.legend(fontsize=8)
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+
+
 def main(argv=None) -> int:
     from byzantinerandomizedconsensus_tpu.utils.rounds import default_artifact
 
@@ -175,6 +205,8 @@ def main(argv=None) -> int:
                          "keys/numpy bias-variant harness")
     ap.add_argument("--backend", default="jax",
                     help="backend for --shipped (default jax)")
+    ap.add_argument("--fig", default=None,
+                    help="also write a grouped-bar capped-fraction figure")
     args = ap.parse_args(argv)
     if args.out is None:
         args.out = default_artifact(
@@ -200,7 +232,14 @@ def main(argv=None) -> int:
             old.setdefault(mode, {}).update(rows)
         result = old
     out.write_text(json.dumps(result, indent=1, sort_keys=True) + "\n")
-    print(json.dumps({"out": str(out), "capped": {
+    if args.fig:
+        try:
+            title = ("shipped adversaries (product path)" if args.shipped
+                     else "bias-rule harness (keys/numpy)")
+            plot_strength([(title, result)], args.fig)
+        except ImportError:
+            print("matplotlib unavailable; skipped figure")
+    print(json.dumps({"out": str(out), "fig": args.fig, "capped": {
         m: {n: r["capped_fraction"] for n, r in sorted(rows.items(), key=lambda kv: int(kv[0]))}
         for m, rows in result.items()}}))
     return 0
