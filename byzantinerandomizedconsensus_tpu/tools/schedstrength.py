@@ -39,7 +39,6 @@ CLI: ``python -m byzantinerandomizedconsensus_tpu.tools.schedstrength``.
 from __future__ import annotations
 
 import argparse
-import dataclasses
 import json
 import pathlib
 
