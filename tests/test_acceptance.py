@@ -96,6 +96,23 @@ def test_max_n_sharded_vs_native():
     assert (ref.decision != 2).all(), "shared coin should decide well before the cap"
 
 
+@pytest.mark.slow
+def test_max_n_adaptive_min_vs_native():
+    """n=1024 under the §6.4b adversary: the minority observation, urn strata,
+    and replica-sharded path at the packing limit, bit-matched against native."""
+    import dataclasses
+
+    from byzantinerandomizedconsensus_tpu.config import sweep_point
+
+    cfg = dataclasses.replace(sweep_point(1024, instances=48),
+                              adversary="adaptive_min", round_cap=64).validate()
+    ref = get_backend("native").run(cfg)
+    got = get_backend("jax_sharded:4").run(cfg)
+    np.testing.assert_array_equal(ref.rounds, got.rounds)
+    np.testing.assert_array_equal(ref.decision, got.decision)
+    assert (ref.decision != 2).all()
+
+
 def test_artifact_merge_roundtrip(tmp_path):
     """Separate tool invocations (TPU legs, virtual-mesh legs) must merge into
     one artifact without clobbering each other's backend entries."""
