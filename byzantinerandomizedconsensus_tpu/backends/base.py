@@ -19,6 +19,16 @@ import numpy as np
 from byzantinerandomizedconsensus_tpu.config import SimConfig
 
 
+def check_pallas_delivery(cfg: SimConfig) -> None:
+    """Reject kernel='pallas' for deliveries the Pallas kernels don't
+    implement — fail loudly rather than fall back silently (ADVICE r1).
+    Shared by JaxBackend and JaxShardedBackend so the guard can't drift."""
+    if cfg.delivery == "urn2":
+        raise ValueError(
+            "kernel='pallas' implements the §4b sampler only; "
+            "delivery='urn2' supports kernel='xla'")
+
+
 @dataclasses.dataclass
 class SimResult:
     """Per-instance outputs (spec §1): the bit-match surface."""

@@ -65,7 +65,7 @@ class CpuBackend(SimulatorBackend):
         adv = make_adversary(cfg, cfg.seed, instance)
         correct = [j for j in range(cfg.n) if not adv.faulty[j]]
 
-        two_faced = cfg.delivery == "urn" and cfg.adversary == "byzantine" \
+        two_faced = cfg.count_level and cfg.adversary == "byzantine" \
             and cfg.protocol != "bracha"
 
         for r in range(cfg.round_cap):
@@ -80,7 +80,7 @@ class CpuBackend(SimulatorBackend):
                     live = ~silent
                     g_prev = (int(np.count_nonzero(live & (values == 0))),
                               int(np.count_nonzero(live & (values == 1))))
-                if cfg.delivery == "urn":
+                if cfg.count_level:
                     if two_faced:
                         # §4b two-faced equivocation, independent of ops/urn.py.
                         send = np.arange(cfg.n, dtype=np.uint32)
@@ -99,8 +99,10 @@ class CpuBackend(SimulatorBackend):
                         minority = adv.observed_minority(honest)
                     else:
                         strata, minority = "none", 0
-                    c0, c1 = net.urn_counts(r, t, vbc, silent,
-                                            strata=strata, minority=minority)
+                    counts = net.urn_counts if cfg.delivery == "urn" \
+                        else net.urn2_counts
+                    c0, c1 = counts(r, t, vbc, silent,
+                                    strata=strata, minority=minority)
                     for rep in replicas:
                         rep.on_counts(t, int(c0[rep.index]), int(c1[rep.index]))
                 else:

@@ -22,7 +22,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from byzantinerandomizedconsensus_tpu.backends.base import JitChunkedBackend
+from byzantinerandomizedconsensus_tpu.backends.base import (
+    JitChunkedBackend, check_pallas_delivery)
 from byzantinerandomizedconsensus_tpu.config import SimConfig
 from byzantinerandomizedconsensus_tpu.models import benor, bracha, state as state_mod
 from byzantinerandomizedconsensus_tpu.models.adversaries import AdversaryModel
@@ -82,7 +83,7 @@ class JaxBackend(JitChunkedBackend):
         self.kernel = kernel
 
     def _chunk_size(self, cfg: SimConfig) -> int:
-        if cfg.delivery == "urn":
+        if cfg.count_level:
             # No O(B·n²) transient at all — state is O(B·n). Measured optimum
             # at n=512 on v5e is ~2k instances/chunk: beyond that the
             # while-loop straggler cost (whole chunk pays max rounds) outweighs
@@ -99,18 +100,20 @@ class JaxBackend(JitChunkedBackend):
 
     def _make_fn(self, cfg: SimConfig):
         counts_fn = None
-        if cfg.delivery == "urn":
-            # counts_fn=None routes the round bodies to ops/urn.py (XLA);
-            # kernel='pallas' swaps in the VMEM-resident urn kernel. Other
-            # kernels are keys-only — fail loudly so an A/B invocation can't
-            # silently measure the default path (ADVICE r1).
+        if cfg.count_level:
+            # counts_fn=None routes the round bodies to ops/urn.py or
+            # ops/urn2.py (XLA); kernel='pallas' swaps in the VMEM-resident
+            # urn kernel (§4b only). Other kernels are keys-only — fail loudly
+            # so an A/B invocation can't silently measure the default path
+            # (ADVICE r1).
             if self.kernel == "xla_nosort":
                 raise ValueError(
                     "kernel='xla_nosort' applies to delivery='keys' only; "
-                    "delivery='urn' supports kernel='xla' or 'pallas'")
+                    "count-level deliveries support kernel='xla' or 'pallas'")
             if self.kernel == "pallas":
                 from byzantinerandomizedconsensus_tpu.ops import pallas_urn
 
+                check_pallas_delivery(cfg)
                 interpret = jax.default_backend() != "tpu"
                 counts_fn = partial(pallas_urn.counts_fn, interpret=interpret)
             return jax.jit(partial(_run_chunk, cfg, counts_fn=counts_fn))

@@ -25,8 +25,8 @@ class NumpyBackend(SimulatorBackend):
         self.chunk_bytes = chunk_bytes
 
     def _chunk_size(self, cfg: SimConfig) -> int:
-        if cfg.delivery == "urn":
-            # O(B·n) state only (spec §4b): ~16 live int32 per-lane planes
+        if cfg.count_level:
+            # O(B·n) state only (spec §4b/§4b-v2): ~16 live int32 per-lane planes
             # (class counts, picks, carry) — keep honoring the memory cap.
             return max(1, min(1 << 14, self.chunk_bytes // (cfg.n * 64)))
         per_inst = cfg.n * cfg.n * 4 * 4  # ~4 live (B,n,n) u32-sized transients

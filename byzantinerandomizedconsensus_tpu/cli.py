@@ -33,10 +33,12 @@ def _add_config_args(p: argparse.ArgumentParser, default_backend: str = "cpu") -
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--round-cap", type=int, default=None)
     p.add_argument("--init", choices=["random", "all0", "all1", "split"], default=None)
-    p.add_argument("--delivery", choices=["keys", "urn"], default=None,
-                   help="scheduling model: urn (spec §4b, count-level — the product "
-                        "path, pinned by all presets) | keys (spec §4, O(n²) mask — "
-                        "the validation model)")
+    p.add_argument("--delivery", choices=["keys", "urn", "urn2"], default=None,
+                   help="scheduling model: urn (spec §4b, sequential count-level "
+                        "draws) | urn2 (spec §4b-v2, direct count inversion) — "
+                        "the count-level pair; presets pin the A/B-measured "
+                        "product one | keys (spec §4, O(n²) mask — the "
+                        "validation model)")
     p.add_argument("--backend", default=default_backend,
                    help="cpu (oracle) | numpy | native[:threads] | jax | jax_cpu "
                         "| jax_pallas | jax_sharded[:n_model]")
@@ -49,6 +51,18 @@ def _positive_int(text: str) -> int:
     return value
 
 
+def _announce_default_delivery() -> str:
+    """One-line stderr notice when --delivery is defaulted (ADVICE r4): the
+    product model can change between rounds, silently changing the results of
+    previously-issued command lines. Returns the product model."""
+    from byzantinerandomizedconsensus_tpu.config import PRODUCT_DELIVERY
+
+    print(f"[cli] --delivery not given: using the product scheduling model "
+          f"'{PRODUCT_DELIVERY}' (pass --delivery keys|urn|urn2 to pin)",
+          file=sys.stderr)
+    return PRODUCT_DELIVERY
+
+
 def _config_from(args) -> SimConfig:
     # Every explicitly-passed flag applies — also on top of a preset.
     overrides = {k: v for k, v in [
@@ -59,13 +73,15 @@ def _config_from(args) -> SimConfig:
     ] if v is not None}
     if args.preset:
         return preset(args.preset, **overrides)
-    # Ad-hoc runs get the product scheduling model (urn, spec §4b), same as
-    # every preset — the CLI never silently selects the §4 validation model;
-    # pass --delivery keys to get it. (SimConfig's *dataclass* default stays
-    # "keys" for code-level spec-§4 work — see its docstring.)
+    # Ad-hoc runs get the product scheduling model, same as every preset — the
+    # CLI never silently selects the §4 validation model; pass --delivery keys
+    # to get it. (SimConfig's *dataclass* default stays "keys" for code-level
+    # spec-§4 work — see its docstring.)
+    delivery = args.delivery if args.delivery is not None \
+        else _announce_default_delivery()
     defaults = dict(protocol="benor", n=4, f=1, instances=1, adversary="none",
                     coin="local", seed=0, round_cap=256, init="random",
-                    delivery="urn")
+                    delivery=delivery)
     defaults.update(overrides)
     return SimConfig(**defaults).validate()
 
@@ -155,12 +171,14 @@ def cmd_sweep(args) -> int:
             print("--plot requires matplotlib, which is not installed",
                   file=sys.stderr)
             return 2
+    delivery = args.delivery if args.delivery is not None \
+        else _announce_default_delivery()
     out = sweep.run_sweep(
         pathlib.Path(args.out), backend=args.backend,
         ns=tuple(int(x) for x in args.ns) if args.ns else sweep.SWEEP_NS,
         instances=args.instances, seed=args.seed,
         shard_instances=args.shard_instances, coin=args.coin,
-        delivery=args.delivery, round_cap=args.round_cap,
+        delivery=delivery, round_cap=args.round_cap,
         progress=lambda msg: print(msg, file=sys.stderr),
     )
     print(json.dumps(out))
@@ -204,7 +222,7 @@ def main(argv=None) -> int:
     p_sw.add_argument("--seed", type=int, default=0)
     p_sw.add_argument("--round-cap", type=int, default=None)
     p_sw.add_argument("--coin", choices=["local", "shared"], default="shared")
-    p_sw.add_argument("--delivery", choices=["keys", "urn"], default="urn")
+    p_sw.add_argument("--delivery", choices=["keys", "urn", "urn2"], default=None)
     p_sw.add_argument("--plot", default=None, metavar="FILE",
                       help="render the round-distribution figure (png/svg)")
     p_sw.set_defaults(fn=cmd_sweep)

@@ -11,7 +11,7 @@ Protocol = Literal["benor", "bracha"]
 AdversaryKind = Literal["none", "crash", "byzantine", "adaptive", "adaptive_min"]
 CoinKind = Literal["local", "shared"]
 InitKind = Literal["random", "all0", "all1", "split"]
-DeliveryKind = Literal["keys", "urn"]
+DeliveryKind = Literal["keys", "urn", "urn2"]
 
 # Single source for the default round cap. checkpoint.shard_name encodes only
 # NON-default caps (legacy shard names imply this value), so every site that
@@ -27,16 +27,16 @@ class SimConfig:
     *validation* model. **Every user-facing surface defaults to the
     product model instead**: the presets, ``sweep_point(...)``, bench.py,
     and the CLI (including ad-hoc ``cli run`` without ``--preset``) all
-    pin or default ``delivery="urn"`` (spec §4b) — the "keys" default is
-    reachable only by constructing ``SimConfig`` in code. That bare-
-    constructor default is kept at "keys" deliberately: in-repo
-    constructor call sites are overwhelmingly spec-§4 cross-model work
-    (tests, golden vectors, fuzz harnesses), and flipping it would
-    silently change the sampled delivery schedule (and thus the
-    bit-match surface) of ~100 such sites with no signature change to
-    flag it. If you want the benchmark semantics in code, go through
-    ``preset(...)``/``sweep_point(...)`` or pass ``delivery="urn"``
-    explicitly.
+    pin or default ``delivery=PRODUCT_DELIVERY`` (a count-level model,
+    §4b/§4b-v2) — the "keys" default is reachable only by constructing
+    ``SimConfig`` in code. That bare-constructor default is kept at
+    "keys" deliberately: in-repo constructor call sites are
+    overwhelmingly spec-§4 cross-model work (tests, golden vectors, fuzz
+    harnesses), and flipping it would silently change the sampled
+    delivery schedule (and thus the bit-match surface) of ~100 such
+    sites with no signature change to flag it. If you want the benchmark
+    semantics in code, go through ``preset(...)``/``sweep_point(...)``
+    or pass ``delivery=config.PRODUCT_DELIVERY`` explicitly.
     """
 
     protocol: Protocol = "benor"
@@ -49,10 +49,12 @@ class SimConfig:
     round_cap: int = DEFAULT_ROUND_CAP
     crash_window: int = 4
     init: InitKind = "random"
-    # Scheduling model. "urn" (spec §4b, count-level, O(n·f)) is the product
-    # semantics — all benchmark presets pin it. "keys" (spec §4, the O(n²)
-    # permutation-key mask) is the validation model: an independent exact
-    # sampler of the same delivery-distribution family, kept as the
+    # Scheduling model. The count-level samplers "urn" (spec §4b, sequential
+    # draws) and "urn2" (spec §4b-v2, direct count inversion) are the
+    # TPU-native models; the benchmark presets pin whichever the measured A/B
+    # made the product path (docs/PERF.md round 5). "keys" (spec §4, the
+    # O(n²) permutation-key mask) is the validation model: an independent
+    # exact sampler of the same delivery-distribution family, kept as the
     # SimConfig default for ad-hoc spec-§4 work and cross-model checks.
     delivery: DeliveryKind = "keys"
 
@@ -61,13 +63,21 @@ class SimConfig:
         return 2 if self.protocol == "benor" else 3
 
     @property
+    def count_level(self) -> bool:
+        """True for the count-domain delivery models (§4b "urn", §4b-v2
+        "urn2"): no O(n²) mask object exists, adversary structure is class-
+        granular, and memory is O(B·n)."""
+        return self.delivery in ("urn", "urn2")
+
+    @property
     def lying_adversary(self) -> bool:
         """Selects Ben-Or Protocol B thresholds (spec §5.1)."""
         return self.adversary in ("byzantine", "adaptive", "adaptive_min")
 
     def validate(self) -> "SimConfig":
-        if self.delivery not in ("keys", "urn"):
-            raise ValueError(f"unknown delivery {self.delivery!r}; use 'keys' or 'urn'")
+        if self.delivery not in ("keys", "urn", "urn2"):
+            raise ValueError(
+                f"unknown delivery {self.delivery!r}; use 'keys', 'urn' or 'urn2'")
         if not (0 < self.n <= prf.MAX_N):
             raise ValueError(f"n={self.n} out of range (1..{prf.MAX_N})")
         if not (0 <= self.f < self.n):
@@ -97,17 +107,23 @@ def _f_opt(n: int) -> int:
     return (n - 1) // 3
 
 
+# The product scheduling model: what every preset, sweep_point, bench.py and
+# ad-hoc CLI run defaults to. Decided by the measured device-busy A/B between
+# the two count-level samplers (docs/PERF.md round 5); flipping it re-goldens
+# every preset-level artifact, so it changes only with an A/B writeup.
+PRODUCT_DELIVERY = "urn"
+
 # Benchmark presets (BASELINE.json:6-12; pinned in spec/PROTOCOL.md §7).
-# All presets pin delivery="urn" — the product scheduling model; pass
-# delivery="keys" explicitly to run the spec-§4 validation model instead.
+# All presets pin the product scheduling model; pass delivery="keys"
+# explicitly to run the spec-§4 validation model instead.
 PRESETS: dict[str, SimConfig] = {
-    "config1": SimConfig(protocol="benor", n=4, f=1, instances=1, adversary="none", coin="local", delivery="urn"),
-    "config2": SimConfig(protocol="benor", n=64, f=21, instances=10_000, adversary="crash", coin="local", delivery="urn"),
+    "config1": SimConfig(protocol="benor", n=4, f=1, instances=1, adversary="none", coin="local", delivery=PRODUCT_DELIVERY),
+    "config2": SimConfig(protocol="benor", n=64, f=21, instances=10_000, adversary="crash", coin="local", delivery=PRODUCT_DELIVERY),
     # config3's instance count is the one preset field BASELINE.json leaves
     # unspecified ("—"); 1000 is our choice (big enough for stable histograms,
     # small enough for the oracle-anchored checks), not a [B] requirement.
-    "config3": SimConfig(protocol="bracha", n=256, f=85, instances=1_000, adversary="byzantine", coin="shared", delivery="urn"),
-    "config4": SimConfig(protocol="bracha", n=512, f=170, instances=100_000, adversary="none", coin="shared", delivery="urn"),
+    "config3": SimConfig(protocol="bracha", n=256, f=85, instances=1_000, adversary="byzantine", coin="shared", delivery=PRODUCT_DELIVERY),
+    "config4": SimConfig(protocol="bracha", n=512, f=170, instances=100_000, adversary="none", coin="shared", delivery=PRODUCT_DELIVERY),
 }
 
 # Config 5 is a sweep (spec §7): bracha, adaptive adversary, shared coin.
@@ -122,7 +138,8 @@ SWEEP_POINT_N = 512
 def sweep_point(n: int, seed: int = 0, instances: int = SWEEP_INSTANCES) -> SimConfig:
     return SimConfig(
         protocol="bracha", n=n, f=_f_opt(n), instances=instances,
-        adversary="adaptive", coin="shared", seed=seed, delivery="urn",
+        adversary="adaptive", coin="shared", seed=seed,
+        delivery=PRODUCT_DELIVERY,
     ).validate()
 
 

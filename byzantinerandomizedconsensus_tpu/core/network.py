@@ -98,3 +98,75 @@ class Network:
             c0[v] = rem[0] + (1 if own == 0 else 0)
             c1[v] = rem[1] + (1 if own == 1 else 0)
         return c0, c1
+
+    def urn2_counts(self, rnd: int, t: int, vals_by_class, silent: np.ndarray,
+                    strata: str = "none", minority: int = 0):
+        """Per-receiver delivered counts (c0, c1) via the §4b-v2 inversion.
+
+        Same class/stratum semantics as :meth:`urn_counts`; the dropped-count
+        vector is sampled directly as nested hypergeometrics via the
+        corner-minimal conditional-Bernoulli chains of spec §4b-v2. Scalar
+        python-int implementation, independent of ops/urn2.py.
+        """
+        n, f = self.cfg.n, self.cfg.f
+        half = (n + 1) // 2
+        k = n - f - 1
+        c0 = np.empty(n, dtype=np.int32)
+        c1 = np.empty(n, dtype=np.int32)
+        for v in range(n):
+            h = 0 if v < half else 1
+            vals = vals_by_class[h]
+            m = [0, 0, 0]
+            for u in range(n):
+                if u != v and not silent[u]:
+                    m[int(vals[u])] += 1
+            L = sum(m)
+            D = max(0, L - k)
+            if strata == "class":
+                st = [h != 0, h != 1, True]
+            elif strata == "minority":
+                st = [minority != 0, minority != 1, True]
+            else:
+                st = [False, False, False]
+
+            def chain(seg: int, mm: int, Lr: int, Dr: int) -> int:
+                """d ~ HG(Lr, mm, Dr), corner-minimal chain (spec §4b-v2)."""
+                comp = Lr - mm
+                if mm <= comp and mm <= Dr:
+                    is_comp, K, P = False, mm, Dr      # ITEM
+                elif Dr <= comp:
+                    is_comp, K, P = False, Dr, mm      # DRAW
+                else:
+                    is_comp, K, P = True, comp, Dr     # COMP
+                s = int(prf.prf_u32(self.seed, self.instance, rnd, t,
+                                    np.uint32(v), seg, prf.URN2, xp=np))
+                a = 0
+                for j in range(K):
+                    s = (s * prf.URN_LCG_A + prf.URN_LCG_C) & 0xFFFFFFFF
+                    u32 = s ^ (s >> 16)
+                    q = ((u32 >> 10) * (Lr - j)) >> 22
+                    if q < P - a:
+                        a += 1
+                return (Dr - a) if is_comp else a
+
+            d = [0, 0]
+            mb = [m[w] if st[w] else 0 for w in range(3)]
+            Lb = sum(mb)
+            Db = min(D, Lb)
+            Lr, Dr = Lb, Db
+            for w in (0, 1):                 # segments 0-1: biased stratum
+                dw = chain(w, mb[w], Lr, Dr)
+                d[w] += dw
+                Lr -= mb[w]
+                Dr -= dw
+            Lr, Dr = L - Lb, D - Db
+            for w in (0, 1):                 # segments 2-3: unbiased stratum
+                mu = m[w] - mb[w]
+                dw = chain(2 + w, mu, Lr, Dr)
+                d[w] += dw
+                Lr -= mu
+                Dr -= dw
+            own = int(vals[v])
+            c0[v] = m[0] - d[0] + (1 if own == 0 else 0)
+            c1[v] = m[1] - d[1] + (1 if own == 1 else 0)
+        return c0, c1

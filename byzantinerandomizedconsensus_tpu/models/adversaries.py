@@ -109,7 +109,7 @@ class AdversaryModel:
                              xp.where(b == 2, xp.uint8(1), honest_values.astype(xp.uint8)))
                 values = xp.where(faulty, v, honest_values).astype(xp.uint8)
                 return values, silent, no_bias
-            if cfg.delivery == "urn":
+            if cfg.count_level:
                 # §4b: urn counts recompute the two-faced class values from
                 # (honest, faulty) themselves — never build the O(B,n,n) matrix.
                 return honest_values, zero_silent, no_bias
@@ -129,7 +129,7 @@ class AdversaryModel:
             # bias delivery (by receiver class, or globally minority-first).
             minority = observed_minority(honest_values, faulty, xp=xp)
             values = xp.where(faulty, minority[:, None], honest_values).astype(xp.uint8)
-            if cfg.delivery == "urn":
+            if cfg.count_level:
                 # §4b: scheduling strata are derived inside the urn from the
                 # wire values — the (B, R, n) bias matrix is never needed.
                 return values, zero_silent, no_bias

@@ -42,19 +42,18 @@ def _take_lane(arr, recv, xp):
     return arr[:, recv.astype(xp.int32)]
 
 
-def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
-              recv_ids=None, xp=np):
-    """(c0, c1) delivered-value counts per receiver lane — spec §4b.
+def lane_setup(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
+               recv_ids=None, xp=np):
+    """Shared §4b/§4b-v2 per-lane class state.
 
-    Signature matches the round-body ``counts_fn`` hook. ``values`` is the
-    injected (B, n) common wire value (the (B, R, n) equivocation matrix of the
-    keys model is ignored here — §4b replaces it with two-faced class values
-    recomputed from ``honest``/``faulty``). ``silent`` (B, n) includes
-    validation silences. Returns two (B, R) int32.
+    Returns ``(recv, own_val, m, st, L, D)``: the (R,) receiver lane ids, the
+    (B, R) own wire value, the per-lane live class counts ``m[w]`` (B, R) i32
+    over senders ``u != v``, the stratum flags ``st[w]`` (bool, broadcastable
+    to (B, R)), and the urn totals ``L``/``D``. Both urn samplers consume
+    exactly this state; only the drop-sampling algorithm differs.
     """
     n, f = cfg.n, cfg.f
     u32, i32 = xp.uint32, xp.int32
-    B = silent.shape[0]
     if recv_ids is None:
         recv = xp.arange(n, dtype=xp.uint32)
     else:
@@ -91,7 +90,6 @@ def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
     # scheduling. "adaptive": biased(w, h) = (w == 2) | (w != h), per lane
     # class. "adaptive_min" (§6.4b): biased(w) = (w == 2) | (w != minority),
     # receiver-independent — (B, 1) planes broadcast over lanes.
-    adaptive = cfg.adversary in ("adaptive", "adaptive_min")
     if cfg.adversary == "adaptive":
         st = [h_lane != (w == 1) if w < 2 else xp.broadcast_to(True, h_lane.shape)
               for w in (0, 1, 2)]
@@ -108,6 +106,26 @@ def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
 
     L = m[0] + m[1] + m[2]
     D = xp.maximum(L - i32(n - f - 1), i32(0))            # (B, R) drops
+    return recv, own_val, m, st, L, D
+
+
+def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
+              recv_ids=None, xp=np):
+    """(c0, c1) delivered-value counts per receiver lane — spec §4b.
+
+    Signature matches the round-body ``counts_fn`` hook. ``values`` is the
+    injected (B, n) common wire value (the (B, R, n) equivocation matrix of the
+    keys model is ignored here — §4b replaces it with two-faced class values
+    recomputed from ``honest``/``faulty``). ``silent`` (B, n) includes
+    validation silences. Returns two (B, R) int32.
+    """
+    f = cfg.f
+    u32, i32 = xp.uint32, xp.int32
+    B = silent.shape[0]
+    recv, own_val, m, st, L, D = lane_setup(
+        cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
+        recv_ids=recv_ids, xp=xp)
+    adaptive = cfg.adversary in ("adaptive", "adaptive_min")
 
     inst = xp.asarray(inst_ids, dtype=xp.uint32)[:, None]
     s0 = prf.prf_u32(seed, inst, rnd, t, recv[None, :], 0, prf.URN, xp=xp)

@@ -1,0 +1,130 @@
+"""Urn delivery v2 (spec/PROTOCOL.md §4b-v2) — direct dropped-count inversion.
+
+Samples the per-receiver per-class dropped-count vector directly as nested
+hypergeometrics (stratum split deterministic, within-stratum class split via
+corner-minimal conditional-Bernoulli chains) instead of §4b's D sequential
+draws. Per-lane work is bounded by the smallest hypergeometric corner: zero on
+unanimous steps, O(m0+m1) on ⊥-dominated steps, ≤ ~1.5·D on balanced steps —
+the regime mix the round-4 roofline measured as 91% of device time for §4b.
+
+Generic over the array namespace (numpy host loop / ``lax.while_loop`` with an
+inner unrolled block); the CPU oracle implements the same spec independently in
+core/network.py::Network.urn2_counts. All arithmetic is uint32/int32 with
+wraparound, so numpy, XLA, and C++ agree bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu.ops import prf, urn
+
+# Inner unrolled block of the chain loop: keeps the (s, a) carry in registers
+# across iterations instead of round-tripping through HBM each draw (the same
+# lever as §4b's fori_loop unroll=10, measured ~3x there).
+_UNROLL = 8
+
+
+def _chain(seed, inst_ids, rnd, t, recv, seg, m, Lr, Dr, xp):
+    """One §4b-v2 segment: d ~ HG(Lr, m, Dr) via the corner-minimal chain.
+
+    ``m``/``Lr``/``Dr`` are (B, R) int32 (non-negative). Returns (B, R) int32
+    ``d``. Masked lanes (j >= K) advance only this segment's LCG state, which
+    is dead after the segment (per-segment reseeding, spec §4b-v2), so the
+    vectorized batch-max loop equals the oracle's per-lane K-iteration loop.
+    """
+    u32, i32 = xp.uint32, xp.int32
+    B = Lr.shape[0]
+    comp = (Lr - m).astype(i32)
+    is_item = (m <= comp) & (m <= Dr)
+    is_draw = ~is_item & (Dr <= comp)
+    is_comp = ~is_item & ~is_draw
+    K = xp.minimum(xp.minimum(m, comp), Dr).astype(i32)
+    P = xp.where(is_draw, m, Dr).astype(u32)
+
+    inst = xp.asarray(inst_ids, dtype=xp.uint32)[:, None]
+    s = prf.prf_u32(seed, inst, rnd, t, recv[None, :], seg, prf.URN2, xp=xp)
+    s = xp.broadcast_to(s, (B, recv.shape[0])).astype(u32)
+    # zeros_like (not zeros): under shard_map the while_loop carry must enter
+    # with the same device-variance as it leaves with, and ``a`` becomes
+    # recv-varying after one draw.
+    a = xp.zeros_like(s)
+
+    def draw(j, s, a):
+        s = (s * u32(prf.URN_LCG_A) + u32(prf.URN_LCG_C)).astype(u32)
+        u = s ^ (s >> u32(16))
+        den = (Lr - j).astype(u32)            # >= 1 while j < K; garbage masked
+        q = ((u >> u32(10)) * den) >> u32(22)
+        acc = (q < (P - a)) & (j < K)
+        return s, (a + acc.astype(u32)).astype(u32)
+
+    if xp is np:
+        for j in range(int(K.max()) if K.size else 0):
+            s, a = draw(i32(j), s, a)
+    else:
+        import jax
+
+        kmax = xp.max(K) if K.size else i32(0)
+
+        def cond(carry):
+            return carry[0] < kmax
+
+        def body(carry):
+            j, s, a = carry
+            for uu in range(_UNROLL):
+                s, a = draw(j + i32(uu), s, a)
+            return j + i32(_UNROLL), s, a
+
+        _, s, a = jax.lax.while_loop(cond, body, (i32(0), s, a))
+
+    a = a.astype(i32)
+    return xp.where(is_comp, Dr - a, a).astype(i32)
+
+
+def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
+              recv_ids=None, xp=np):
+    """(c0, c1) delivered-value counts per receiver lane — spec §4b-v2.
+
+    Same hook signature and same class/stratum state (ops/urn.py::lane_setup)
+    as the §4b sampler; only the drop sampling differs.
+    """
+    i32 = xp.int32
+    recv, own_val, m, st, L, D = urn.lane_setup(
+        cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
+        recv_ids=recv_ids, xp=xp)
+    adaptive = cfg.adversary in ("adaptive", "adaptive_min")
+
+    d = [None, None]  # total drops attributed to tracked values 0, 1
+    if adaptive:
+        # Stratum split (spec §4b-v2): biased absorbs min(D, L_b) drops.
+        z = xp.zeros((1, 1), dtype=i32)
+        mb = [xp.where(st[w], m[w], z).astype(i32) for w in (0, 1, 2)]
+        Lb = (mb[0] + mb[1] + mb[2]).astype(i32)
+        Db = xp.minimum(D, Lb).astype(i32)
+        # Segments 0-1: biased stratum, values 0 then 1.
+        Lr, Dr = Lb, Db
+        for w in (0, 1):
+            d[w] = _chain(seed, inst_ids, rnd, t, recv, w, mb[w], Lr, Dr, xp)
+            Lr = (Lr - mb[w]).astype(i32)
+            Dr = (Dr - d[w]).astype(i32)
+        # Segments 2-3: unbiased stratum, values 0 then 1.
+        mu = [(m[w] - mb[w]).astype(i32) for w in (0, 1)]
+        Lr = (L - Lb).astype(i32)
+        Dr = (D - Db).astype(i32)
+        for w in (0, 1):
+            du = _chain(seed, inst_ids, rnd, t, recv, 2 + w, mu[w], Lr, Dr, xp)
+            d[w] = (d[w] + du).astype(i32)
+            Lr = (Lr - mu[w]).astype(i32)
+            Dr = (Dr - du).astype(i32)
+    else:
+        # Biased stratum statically empty: segments 0-1 are no-ops and are
+        # skipped; segment indices 2-3 are used for seeding per the spec.
+        Lr, Dr = L, D
+        for w in (0, 1):
+            d[w] = _chain(seed, inst_ids, rnd, t, recv, 2 + w, m[w], Lr, Dr, xp)
+            Lr = (Lr - m[w]).astype(i32)
+            Dr = (Dr - d[w]).astype(i32)
+
+    c0 = (m[0] - d[0] + (own_val == 0).astype(i32)).astype(i32)
+    c1 = (m[1] - d[1] + (own_val == 1).astype(i32)).astype(i32)
+    return c0, c1

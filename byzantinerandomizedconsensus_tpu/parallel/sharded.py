@@ -149,8 +149,8 @@ class JaxShardedBackend(JitChunkedBackend):
     def _chunk_size(self, cfg: SimConfig) -> int:
         """Total chunk B across the mesh; per-device transients are (B/|data|, n/|model|, n)."""
         mesh = self.mesh
-        if cfg.delivery == "urn":
-            # No O(B·n²) transient (spec §4b) — per-device chunk mirrors
+        if cfg.count_level:
+            # No O(B·n²) transient (spec §4b/§4b-v2) — per-device chunk mirrors
             # JaxBackend._chunk_size's dispatch-amortisation optimum.
             per_dev = max(1, (1 << 21) // max(1, cfg.n))
         elif self.kernel == "pallas":
@@ -177,8 +177,11 @@ class JaxShardedBackend(JitChunkedBackend):
     def _make_fn(self, cfg: SimConfig):
         counts_fn = None
         if self.kernel == "pallas":
+            from byzantinerandomizedconsensus_tpu.backends.base import (
+                check_pallas_delivery)
             from byzantinerandomizedconsensus_tpu.ops import pallas_tally, pallas_urn
 
+            check_pallas_delivery(cfg)
             interpret = jax.default_backend() != "tpu"
             mod = pallas_urn if cfg.delivery == "urn" else pallas_tally
             counts_fn = partial(mod.counts_fn, interpret=interpret)

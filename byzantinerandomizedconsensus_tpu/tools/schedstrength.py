@@ -45,7 +45,7 @@ import pathlib
 import numpy as np
 
 from byzantinerandomizedconsensus_tpu.backends.numpy_backend import NumpyBackend
-from byzantinerandomizedconsensus_tpu.config import SimConfig
+from byzantinerandomizedconsensus_tpu.config import PRODUCT_DELIVERY, SimConfig
 from byzantinerandomizedconsensus_tpu.models.adversaries import AdversaryModel
 
 BIAS_MODES = ("none", "class", "echo", "anti", "minority")
@@ -125,7 +125,7 @@ def run_strength(ns, instances: int = 400, round_cap: int = 128,
 
 def run_shipped(ns, instances: int = 2000, round_cap: int = 128,
                 coin: str = "local", backend: str = "jax",
-                delivery: str = "urn", seed: int = 0, progress=print) -> dict:
+                delivery: str = PRODUCT_DELIVERY, seed: int = 0, progress=print) -> dict:
     """The *shipped* adversaries (spec §6.4 class / §6.4b minority-first)
     through an ordinary product backend — validates the experiment-harness
     findings on the product path (urn delivery, accelerated backend) instead

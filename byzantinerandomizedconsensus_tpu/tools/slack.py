@@ -34,6 +34,7 @@ import argparse
 import json
 import pathlib
 
+from byzantinerandomizedconsensus_tpu.config import PRODUCT_DELIVERY
 from byzantinerandomizedconsensus_tpu.utils import sweep
 
 # Two full slack cycles around n ≈ 100: s = 2,3,1,2,3,1.
@@ -42,7 +43,7 @@ DEFAULT_NS = (95, 96, 97, 98, 99, 100)
 
 def run_slack(out_dir: pathlib.Path, ns=DEFAULT_NS, instances: int = 2000,
               backend: str = "jax", round_cap: int = 128, seed: int = 0,
-              delivery: str = "urn", progress=print) -> dict:
+              delivery: str = PRODUCT_DELIVERY, progress=print) -> dict:
     """{coin: {n: summary+slack}} over the slack cycle; resumable."""
     out = {}
     for coin in ("local", "shared"):
@@ -79,7 +80,8 @@ def main(argv=None) -> int:
     ap.add_argument("--instances", type=int, default=2000)
     ap.add_argument("--round-cap", type=int, default=128)
     ap.add_argument("--backend", default="jax")
-    ap.add_argument("--delivery", choices=["keys", "urn"], default="urn")
+    ap.add_argument("--delivery", choices=["keys", "urn", "urn2"],
+                    default=PRODUCT_DELIVERY)
     args = ap.parse_args(argv)
 
     from byzantinerandomizedconsensus_tpu.utils.devices import ensure_live_backend

@@ -13,7 +13,7 @@ import numpy as np
 
 from byzantinerandomizedconsensus_tpu.backends.base import get_backend
 from byzantinerandomizedconsensus_tpu.config import (
-    DEFAULT_ROUND_CAP, SWEEP_INSTANCES, SWEEP_NS, sweep_point)
+    DEFAULT_ROUND_CAP, PRODUCT_DELIVERY, SWEEP_INSTANCES, SWEEP_NS, sweep_point)
 from byzantinerandomizedconsensus_tpu.utils import checkpoint, metrics
 
 
@@ -25,7 +25,7 @@ def run_sweep(
     seed: int = 0,
     shard_instances: int = 500,
     coin: str = "shared",
-    delivery: str = "urn",
+    delivery: str = PRODUCT_DELIVERY,
     round_cap: int | None = None,
     progress=print,
 ) -> dict:
@@ -74,10 +74,15 @@ def _warn_stale_shards(out_dir: pathlib.Path, delivery: str, round_cap: int,
         return
     stale = []
     for p in out_dir.glob("*.npz"):
-        named_urn = "_urn_" in p.name
+        if "_urn2_" in p.name:
+            named_delivery = "urn2"
+        elif "_urn_" in p.name:
+            named_delivery = "urn"
+        else:
+            named_delivery = "keys"  # legacy names carry no delivery token
         m = re.search(r"_c(\d+)_s", p.name)
         named_cap = int(m.group(1)) if m else DEFAULT_ROUND_CAP  # legacy names
-        if (delivery == "urn") != named_urn or named_cap != round_cap:
+        if delivery != named_delivery or named_cap != round_cap:
             stale.append(p.name)
     if stale:
         progress(

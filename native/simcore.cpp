@@ -60,6 +60,7 @@ enum Purpose : uint32_t {
   kByzValue = 5,
   kSched = 6,
   kUrn = 7,
+  kUrn2 = 8,
 };
 
 constexpr uint32_t kCoinStep = 3;
@@ -93,7 +94,7 @@ enum AdversaryKind { kNone = 0, kCrash = 1, kByzantine = 2, kAdaptive = 3,
                      kAdaptiveMin = 4 };
 enum CoinKind { kLocal = 0, kShared = 1 };
 enum InitKind { kRandom = 0, kAll0 = 1, kAll1 = 2, kSplit = 3 };
-enum DeliveryKind { kKeys = 0, kUrnDelivery = 1 };
+enum DeliveryKind { kKeys = 0, kUrnDelivery = 1, kUrn2Delivery = 2 };
 
 struct Cfg {
   int protocol;
@@ -111,6 +112,12 @@ struct Cfg {
 inline bool lying_adversary(const Cfg& c) {
   return c.adversary == kByzantine || c.adversary == kAdaptive ||
          c.adversary == kAdaptiveMin;
+}
+
+// Count-level delivery models (spec §4b / §4b-v2): class-granular adversary
+// structure, no per-receiver matrices.
+inline bool count_level(const Cfg& c) {
+  return c.delivery == kUrnDelivery || c.delivery == kUrn2Delivery;
 }
 
 // ------------------------------------------------------------ per-thread state
@@ -226,7 +233,7 @@ void inject(const Cfg& cfg, Key k, uint32_t inst, uint32_t rnd, uint32_t t,
           else if (b == 2) s.values[j] = 1;
           // b == 0 or 3: honest value retained.
         }
-      } else if (cfg.delivery == kUrnDelivery) {
+      } else if (count_level(cfg)) {
         // §4b two-faced equivocation: one value per receiver class.
         s.two_faced = true;
         for (int h = 0; h < 2; ++h) {
@@ -263,7 +270,7 @@ void inject(const Cfg& cfg, Key k, uint32_t inst, uint32_t rnd, uint32_t t,
       const uint8_t minority = observed_minority(s, n);
       for (int j = 0; j < n; ++j)
         if (s.faulty[j]) s.values[j] = minority;
-      if (cfg.delivery == kUrnDelivery) return;  // strata derived in-urn (§4b)
+      if (count_level(cfg)) return;  // strata derived in-urn (§4b/§4b-v2)
       s.bias_per_recv = true;
       for (int v = 0; v < n; ++v) {
         const uint8_t pref = (v >= (n + 1) / 2) ? 1 : 0;
@@ -280,7 +287,7 @@ void inject(const Cfg& cfg, Key k, uint32_t inst, uint32_t rnd, uint32_t t,
       const uint8_t minority = observed_minority(s, n);
       for (int j = 0; j < n; ++j)
         if (s.faulty[j]) s.values[j] = minority;
-      if (cfg.delivery == kUrnDelivery) return;  // strata derived in-urn (§4b)
+      if (count_level(cfg)) return;  // strata derived in-urn (§4b/§4b-v2)
       // Receiver-independent bias: compute one row, replicate it.
       s.bias_per_recv = true;
       uint8_t* row0 = s.bias.data();
@@ -400,6 +407,91 @@ void urn_deliver_and_tally(const Cfg& cfg, Key k, uint32_t inst, uint32_t rnd,
   }
 }
 
+// ------------------------------- urn-v2 delivery + tallies (spec §4b-v2)
+
+// d ~ HG(Lr, m, Dr) via the corner-minimal conditional-Bernoulli chain
+// (spec §4b-v2): walk the smallest of {class items, drops, complement items},
+// each step an exact exchangeability Bernoulli realized by the §4b
+// range-reduction primitive. Seeded per (receiver, step, segment).
+inline int hg_chain(Key k, uint32_t inst, uint32_t rnd, uint32_t t, uint32_t v,
+                    uint32_t seg, int m, int Lr, int Dr) {
+  const int comp = Lr - m;
+  bool is_comp = false;
+  int K, P;
+  if (m <= comp && m <= Dr) {
+    K = m;
+    P = Dr;  // ITEM
+  } else if (Dr <= comp) {
+    K = Dr;
+    P = m;  // DRAW
+  } else {
+    is_comp = true;
+    K = comp;
+    P = Dr;  // COMP
+  }
+  uint32_t s = prf_u32(k, inst, rnd, t, v, seg, kUrn2);
+  int a = 0;
+  for (int j = 0; j < K; ++j) {
+    s = s * kUrnLcgA + kUrnLcgC;
+    const uint32_t u = s ^ (s >> 16);
+    const uint32_t q = ((u >> 10) * uint32_t(Lr - j)) >> 22;
+    if (q < uint32_t(P - a)) ++a;
+  }
+  return is_comp ? (Dr - a) : a;
+}
+
+// Direct dropped-count inversion: stratum split deterministic (biased first),
+// within-stratum class split via nested hypergeometric chains. Mirrors
+// ops/urn2.py segment-for-segment; same class/stratum state as
+// urn_deliver_and_tally.
+void urn2_deliver_and_tally(const Cfg& cfg, Key k, uint32_t inst, uint32_t rnd,
+                            uint32_t t, Scratch& s) {
+  const int n = cfg.n, f = cfg.f;
+  const int half = (n + 1) / 2;
+  const int quota = n - f - 1;
+  const bool adaptive = cfg.adversary == kAdaptive;
+  const bool adaptive_min = cfg.adversary == kAdaptiveMin;
+  const uint8_t minority = adaptive_min ? observed_minority(s, n) : 0;
+  for (int v = 0; v < n; ++v) {
+    const int h = (v >= half) ? 1 : 0;
+    const uint8_t* vals =
+        s.two_faced ? (h ? s.vclass1.data() : s.vclass0.data()) : s.values.data();
+    int m[3] = {0, 0, 0};
+    for (int j = 0; j < n; ++j)
+      if (j != v && !s.silent[j]) ++m[vals[j]];
+    const int L = m[0] + m[1] + m[2];
+    const int D = std::max(0, L - quota);
+    const bool st[3] = {(adaptive && h != 0) || (adaptive_min && minority != 0),
+                        (adaptive && h != 1) || (adaptive_min && minority != 1),
+                        adaptive || adaptive_min};
+    const int mb[3] = {st[0] ? m[0] : 0, st[1] ? m[1] : 0, st[2] ? m[2] : 0};
+    const int Lb = mb[0] + mb[1] + mb[2];
+    const int Db = std::min(D, Lb);
+    int d[2] = {0, 0};
+    int Lr = Lb, Dr = Db;
+    for (int w = 0; w < 2; ++w) {  // segments 0-1: biased stratum
+      const int dw = hg_chain(k, inst, rnd, t, uint32_t(v), uint32_t(w),
+                              mb[w], Lr, Dr);
+      d[w] += dw;
+      Lr -= mb[w];
+      Dr -= dw;
+    }
+    Lr = L - Lb;
+    Dr = D - Db;
+    for (int w = 0; w < 2; ++w) {  // segments 2-3: unbiased stratum
+      const int mu = m[w] - mb[w];
+      const int dw = hg_chain(k, inst, rnd, t, uint32_t(v), uint32_t(2 + w),
+                              mu, Lr, Dr);
+      d[w] += dw;
+      Lr -= mu;
+      Dr -= dw;
+    }
+    const uint8_t own = vals[v];
+    s.c0[v] = m[0] - d[0] + (own == 0 ? 1 : 0);
+    s.c1[v] = m[1] - d[1] + (own == 1 ? 1 : 0);
+  }
+}
+
 // ----------------------------------------------- protocol round (spec §5)
 
 // One full round for one instance; updates Scratch state in place.
@@ -428,6 +520,8 @@ void run_round(const Cfg& cfg, Key k, uint32_t inst, uint32_t rnd, Scratch& s) {
     }
     if (cfg.delivery == kUrnDelivery)
       urn_deliver_and_tally(cfg, k, inst, rnd, uint32_t(t), s);
+    else if (cfg.delivery == kUrn2Delivery)
+      urn2_deliver_and_tally(cfg, k, inst, rnd, uint32_t(t), s);
     else
       deliver_and_tally(cfg, k, inst, rnd, uint32_t(t), s);
 
@@ -556,6 +650,6 @@ void sim_run(int protocol, int n, int f, int adversary, int coin, int init,
 }
 
 // ABI version stamp so the Python loader can detect stale cached builds.
-int sim_abi_version() { return 2; }
+int sim_abi_version() { return 3; }
 
 }  // extern "C"

@@ -40,6 +40,21 @@ GOLDEN_CONFIGS = {
                                          instances=100, adversary="adaptive_min",
                                          coin="shared", round_cap=64, seed=8,
                                          delivery="urn"),
+    # Urn inversion (spec §4b-v2, added round 5) — one per adversary family,
+    # incl. the two-faced Ben-Or Byzantine pairing and both adaptive strata.
+    "urn2_benor_byz": SimConfig(protocol="benor", n=16, f=3, instances=100,
+                                adversary="byzantine", coin="local", round_cap=64,
+                                seed=9, delivery="urn2"),
+    "urn2_bracha_crash": SimConfig(protocol="bracha", n=10, f=3, instances=100,
+                                   adversary="crash", coin="shared", round_cap=64,
+                                   seed=10, delivery="urn2"),
+    "urn2_bracha_adaptive": SimConfig(protocol="bracha", n=13, f=4, instances=100,
+                                      adversary="adaptive", coin="shared",
+                                      round_cap=64, seed=11, delivery="urn2"),
+    "urn2_bracha_adaptive_min": SimConfig(protocol="bracha", n=13, f=4,
+                                          instances=100, adversary="adaptive_min",
+                                          coin="shared", round_cap=64, seed=12,
+                                          delivery="urn2"),
 }
 
 PATH = pathlib.Path(__file__).parent / "golden.npz"

@@ -1,0 +1,200 @@
+"""Urn inversion delivery (spec §4b-v2): chain-level exactness against the
+closed-form hypergeometric pmf, bit-match across all four implementation
+stacks, protocol properties, and statistical agreement with both the keys
+model and the §4b urn sampler.
+
+Like §4b, urn2 is a *different exact sampler of the same delivery distribution
+family*: bit-matching is within delivery="urn2"; cross-model checks are
+statistical.
+"""
+
+import dataclasses
+import math
+
+import numpy as np
+import pytest
+
+from byzantinerandomizedconsensus_tpu import SimConfig, Simulator, preset
+
+URN2_SMALL = [
+    SimConfig(protocol="benor", n=4, f=1, instances=60, adversary="none", coin="local",
+              round_cap=64, seed=0, delivery="urn2"),
+    SimConfig(protocol="benor", n=9, f=4, instances=40, adversary="crash", coin="local",
+              round_cap=96, seed=1, delivery="urn2"),
+    SimConfig(protocol="benor", n=16, f=3, instances=40, adversary="byzantine",
+              coin="local", round_cap=64, seed=2, delivery="urn2"),
+    SimConfig(protocol="benor", n=11, f=2, instances=40, adversary="adaptive",
+              coin="shared", round_cap=64, seed=3, delivery="urn2"),
+    SimConfig(protocol="bracha", n=10, f=3, instances=40, adversary="byzantine",
+              coin="shared", round_cap=64, seed=4, delivery="urn2"),
+    SimConfig(protocol="bracha", n=16, f=5, instances=40, adversary="adaptive",
+              coin="shared", round_cap=64, seed=5, delivery="urn2"),
+    SimConfig(protocol="bracha", n=13, f=4, instances=40, adversary="crash",
+              coin="local", round_cap=64, seed=6, delivery="urn2"),
+    SimConfig(protocol="bracha", n=7, f=2, instances=40, adversary="none",
+              coin="shared", round_cap=64, seed=7, delivery="urn2"),
+    SimConfig(protocol="bracha", n=13, f=4, instances=40, adversary="adaptive_min",
+              coin="shared", round_cap=64, seed=8, delivery="urn2"),
+]
+
+
+def _hg_pmf(N: int, m: int, D: int, k: int) -> float:
+    """Exact HG(N, m, D) pmf at k."""
+    if k < max(0, D - (N - m)) or k > min(m, D):
+        return 0.0
+    return (math.comb(m, k) * math.comb(N - m, D - k)) / math.comb(N, D)
+
+
+@pytest.mark.parametrize("N,m,D", [
+    (20, 3, 9),    # ITEM mode  (m smallest)
+    (20, 12, 5),   # DRAW mode  (D smallest)
+    (20, 16, 10),  # COMP mode  (N-m smallest)
+    (11, 5, 6),    # near-balanced
+    (7, 7, 3),     # degenerate: all items marked -> d = D exactly
+    (9, 0, 4),     # degenerate: no marked items -> d = 0 exactly
+    (13, 6, 0),    # degenerate: no drops -> d = 0 exactly
+])
+def test_chain_exact_hypergeometric(N, m, D):
+    """The §4b-v2 corner-minimal chain samples the exact HG(N, m, D) law (up
+    to the spec's O(2^-22) range-reduction bias): empirical frequencies over
+    many PRF streams match the closed-form pmf. This pins the sampler itself,
+    independent of any protocol round."""
+    from byzantinerandomizedconsensus_tpu.ops.urn2 import _chain
+
+    B = 20_000
+    inst = np.arange(B, dtype=np.uint32)
+    recv = np.zeros(1, dtype=np.uint32)
+    arr = lambda v: np.full((B, 1), v, dtype=np.int32)  # noqa: E731
+    d = _chain(123, inst, 0, 0, recv, 2, arr(m), arr(N), arr(D), np)[:, 0]
+    assert d.min() >= max(0, D - (N - m)) and d.max() <= min(m, D)
+    for k in range(min(m, D) + 1):
+        p = _hg_pmf(N, m, D, k)
+        emp = float((d == k).mean())
+        # 5-sigma binomial band around the exact pmf (plus 1e-4 slack for the
+        # deterministic range-reduction bias).
+        tol = 5 * math.sqrt(max(p * (1 - p), 1e-9) / B) + 1e-4
+        assert abs(emp - p) < tol, f"k={k}: emp={emp:.5f} pmf={p:.5f}"
+
+
+@pytest.mark.parametrize(
+    "cfg", URN2_SMALL,
+    ids=lambda c: f"{c.protocol}-n{c.n}f{c.f}-{c.adversary}-{c.coin}")
+def test_urn2_bitmatch_small(cfg):
+    ref = Simulator(cfg, "cpu").run()
+    for backend in ("numpy", "jax", "native"):
+        got = Simulator(cfg, backend).run()
+        np.testing.assert_array_equal(ref.rounds, got.rounds, err_msg=f"rounds {backend}")
+        np.testing.assert_array_equal(ref.decision, got.decision,
+                                      err_msg=f"decision {backend}")
+
+
+@pytest.mark.parametrize("name,n_sample", [("config2", 4), ("config3", 3), ("config4", 2)])
+def test_urn2_bitmatch_benchmark_sampled(name, n_sample):
+    import zlib
+
+    cfg = preset(name, round_cap=64, delivery="urn2")
+    rng = np.random.default_rng(zlib.crc32(name.encode()))
+    ids = np.unique(rng.integers(0, cfg.instances, size=n_sample))
+    ref = Simulator(cfg, "cpu").run(ids)
+    for backend in ("numpy", "jax"):
+        got = Simulator(cfg, backend).run(ids)
+        np.testing.assert_array_equal(ref.rounds, got.rounds, err_msg=f"rounds {backend}")
+        np.testing.assert_array_equal(ref.decision, got.decision,
+                                      err_msg=f"decision {backend}")
+
+
+@pytest.mark.parametrize("cfg", URN2_SMALL[:6],
+                         ids=lambda c: f"{c.protocol}-{c.adversary}")
+def test_urn2_agreement_and_validity(cfg):
+    res = Simulator(cfg, "numpy").run()
+    assert set(np.unique(res.decision)) <= {0, 1, 2}
+    for init, expect in (("all0", 0), ("all1", 1)):
+        c = dataclasses.replace(cfg, init=init, instances=30)
+        r = Simulator(c, "numpy").run()
+        decided = r.decision != 2
+        assert np.all(r.decision[decided] == expect), f"validity broken for {init}"
+
+
+@pytest.mark.parametrize("other", ["keys", "urn"])
+def test_urn2_matches_other_models_statistically(other):
+    """Same delivery distribution family ⇒ close round/decision statistics,
+    against both the §4 keys model and the §4b sequential sampler."""
+    base = SimConfig(protocol="bracha", n=16, f=5, instances=4000,
+                     adversary="none", coin="shared", round_cap=64, seed=11)
+    ref = Simulator(dataclasses.replace(base, delivery=other), "numpy").run()
+    got = Simulator(dataclasses.replace(base, delivery="urn2"), "numpy").run()
+    assert abs(float(ref.rounds.mean()) - float(got.rounds.mean())) < 0.1
+    assert abs(float((ref.decision == 1).mean())
+               - float((got.decision == 1).mean())) < 0.08
+
+
+def test_urn2_adaptive_matches_urn_statistically():
+    """The two-stratum (4-segment) path against §4b's draw loop — the
+    stratum-priority decomposition must preserve the biased-first law."""
+    base = SimConfig(protocol="bracha", n=16, f=5, instances=400,
+                     adversary="adaptive", coin="local", round_cap=64, seed=11)
+    ref = Simulator(dataclasses.replace(base, delivery="urn"), "native").run()
+    got = Simulator(dataclasses.replace(base, delivery="urn2"), "native").run()
+    assert abs(float(ref.rounds.mean()) - float(got.rounds.mean())) < 1.5
+    assert abs(float((ref.decision == 1).mean())
+               - float((got.decision == 1).mean())) < 0.08
+
+
+@pytest.mark.parametrize("n_data,n_model", [(8, 1), (4, 2), (2, 4)])
+def test_urn2_sharded_bitmatch(n_data, n_model):
+    """Urn2 under shard_map (instance + replica sharding) bit-matches the
+    single-device jax backend on every mesh shape."""
+    from byzantinerandomizedconsensus_tpu.parallel.mesh import make_mesh
+    from byzantinerandomizedconsensus_tpu.parallel.sharded import JaxShardedBackend
+
+    cfg = SimConfig(protocol="bracha", n=16, f=5, instances=48,
+                    adversary="adaptive", coin="shared", round_cap=64, seed=21,
+                    delivery="urn2")
+    ref = Simulator(cfg, "jax").run()
+    got = JaxShardedBackend(mesh=make_mesh(n_data=n_data, n_model=n_model)).run(cfg)
+    np.testing.assert_array_equal(ref.rounds, got.rounds)
+    np.testing.assert_array_equal(ref.decision, got.decision)
+
+
+def test_urn2_sharded_two_faced_byzantine():
+    """Two-faced equivocation (spec §4b) under replica sharding with the
+    §4b-v2 sampler: per-class value recomputation must line up with global
+    receiver indices."""
+    from byzantinerandomizedconsensus_tpu.parallel.mesh import make_mesh
+    from byzantinerandomizedconsensus_tpu.parallel.sharded import JaxShardedBackend
+
+    cfg = SimConfig(protocol="benor", n=16, f=3, instances=40,
+                    adversary="byzantine", coin="local", round_cap=64, seed=31,
+                    delivery="urn2")
+    ref = Simulator(cfg, "cpu").run()
+    got = JaxShardedBackend(mesh=make_mesh(n_data=2, n_model=4)).run(cfg)
+    np.testing.assert_array_equal(ref.rounds, got.rounds)
+    np.testing.assert_array_equal(ref.decision, got.decision)
+
+
+def test_urn2_counts_conservation():
+    """Spec §4b-v2: c0+c1+c2 = min(L, n-f-1)+1; with no faults and no bot
+    values the delivered total is exactly n-f for every receiver."""
+    from byzantinerandomizedconsensus_tpu.ops import urn2
+
+    cfg = SimConfig(protocol="bracha", n=32, f=10, instances=8, adversary="none",
+                    coin="shared", delivery="urn2")
+    B, n = 5, cfg.n
+    inst = np.arange(B, dtype=np.uint32)
+    values = (np.arange(n, dtype=np.uint8) % 2)[None, :].repeat(B, 0)
+    silent = np.zeros((B, n), dtype=bool)
+    faulty = np.zeros((B, n), dtype=bool)
+    c0, c1 = urn2.counts_fn(cfg, cfg.seed, inst, 0, 0, values, silent, faulty,
+                            values, xp=np)
+    np.testing.assert_array_equal(c0 + c1, np.full((B, n), n - cfg.f))
+    assert (c0 <= (values == 0).sum(-1)[:, None] + 1).all()
+    assert (c1 <= (values == 1).sum(-1)[:, None] + 1).all()
+    assert (c0 >= 0).all() and (c1 >= 0).all()
+
+
+def test_urn2_rejects_pallas_kernel():
+    """The Pallas kernels implement §4b only; urn2 must fail loudly, not fall
+    back silently (ADVICE r1 pattern)."""
+    cfg = dataclasses.replace(URN2_SMALL[0], delivery="urn2")
+    with pytest.raises(ValueError, match="urn2"):
+        Simulator(cfg, "jax_pallas").run()
