@@ -20,19 +20,26 @@ TARGET_INST_PER_SEC = 100_000 / 60.0  # north-star: 100k instances < 60 s
 
 
 def _prev_round_headline():
-    """(artifact_name, inst/s) from the previous round's BENCH_r*.json.
+    """(artifact_name, inst/s, device_busy_s|None) from the previous round's
+    BENCH_r*.json.
 
     The driver records bench output per round; comparing against the previous
     round's artifact is the perf-regression guard (VERDICT r2 #4): tunnel
     variance is ±10-15% (docs/PERF.md), so |vs_prev_round - 1| > 0.15 means a
-    real change, not noise, and must be explained in PERF.md. Round anchoring
-    and the unparseable-VERDICT warning live in utils/rounds.py.
+    real change, not noise, and must be explained in PERF.md — and when the
+    capture window is noisier than that, the device-busy comparison is the
+    authoritative signal (VERDICT r4 #2; utils/timing.regression_verdict).
+    Round anchoring and the unparseable-VERDICT warning live in
+    utils/rounds.py.
     """
     from byzantinerandomizedconsensus_tpu.utils.rounds import prev_round_artifact
 
+    def _doc(doc):
+        return doc.get("parsed", doc) if isinstance(doc, dict) else {}
+
     def _value(doc):
         try:
-            return float(doc.get("parsed", doc).get("value"))
+            return float(_doc(doc).get("value"))
         except (AttributeError, TypeError, ValueError):
             return None
 
@@ -41,7 +48,9 @@ def _prev_round_headline():
     if not found:
         return None
     name, _rnd, doc = found
-    return (name, _value(doc))
+    detail = _doc(doc).get("detail", {})
+    dev = detail.get("device_busy_s") if isinstance(detail, dict) else None
+    return (name, _value(doc), dev)
 
 
 def main() -> int:
@@ -58,11 +67,13 @@ def main() -> int:
     ensure_live_backend()
 
     instances = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
-    # The headline is the preset as shipped: config4 pins delivery="urn"
-    # (spec §4b — count-level scheduling, O(n·f) per instance-step) on the
-    # plain jax backend. BENCH_BACKEND (jax | jax_pallas | jax_sharded[:p])
-    # and BENCH_DELIVERY=keys (spec §4 O(n²)-mask validation model, where
-    # the fused Pallas kernel is the TPU fast path) remain for A/B runs.
+    # The headline is the preset as shipped: config4 pins the product
+    # scheduling model (config.PRODUCT_DELIVERY — spec §4b-v2 "urn2" since
+    # round 5) on the plain jax backend. BENCH_BACKEND
+    # (jax | jax_pallas | jax_sharded[:p]) and BENCH_DELIVERY
+    # (urn = the §4b cross-check sampler; keys = the spec-§4 O(n²)-mask
+    # validation model, where the fused Pallas kernel is the TPU fast path)
+    # remain for A/B runs.
     backend = sys.argv[2] if len(sys.argv) > 2 else os.environ.get("BENCH_BACKEND", "")
     delivery = os.environ.get("BENCH_DELIVERY", None)
     if not backend:
@@ -75,31 +86,52 @@ def main() -> int:
     overrides = {"instances": instances}
     if delivery is not None:
         overrides["delivery"] = delivery
+    elif backend.partition(":")[0] == "jax_pallas":
+        # The Pallas kernels implement keys + §4b urn only; the urn2 product
+        # default would make the warm-up raise (check_pallas_delivery). A bare
+        # BENCH_BACKEND=jax_pallas A/B therefore measures the §4b cross-check
+        # kernel; set BENCH_DELIVERY=keys for the keys-model Pallas path.
+        overrides["delivery"] = "urn"
     cfg = preset("config4", **overrides)
 
     # Warm-up compile at the exact run shape + best-of-five timed runs — the
-    # shared measurement discipline (utils/timing.py; docs/PERF.md).
-    from byzantinerandomizedconsensus_tpu.utils.timing import spread, timed_best_of
+    # shared measurement discipline (utils/timing.py; docs/PERF.md) — plus the
+    # noise-immune device-busy leg and the machine-readable regression verdict
+    # (VERDICT r4 #2).
+    from byzantinerandomizedconsensus_tpu.utils.timing import (
+        device_busy, regression_verdict, timed_best_of)
 
-    res, walls = timed_best_of(get_backend(backend), cfg)
+    be = get_backend(backend)
+    res, walls = timed_best_of(be, cfg)
     wall = min(walls)
+    dev = device_busy(be, cfg)
 
     inst_per_sec = instances / wall
     undecided = int((res.decision == 2).sum())
     prev = _prev_round_headline()
+    verdict = regression_verdict(
+        walls, rate=inst_per_sec,
+        prev_wall_rate=prev[1] if prev else None,
+        device_busy_s=dev.get("device_busy_s"),
+        prev_device_busy_s=prev[2] if prev else None)
     print(json.dumps({
         "metric": "consensus_instances_per_sec@n512_f170_shared_coin",
         "value": round(inst_per_sec, 1),
         "unit": "instances/s",
         "vs_baseline": round(inst_per_sec / TARGET_INST_PER_SEC, 3),
-        **({"vs_prev_round": round(inst_per_sec / prev[1], 3),
-            "prev_round_artifact": prev[0]} if prev else {}),
+        **({"prev_round_artifact": prev[0]} if prev else {}),
+        **{k: v for k, v in verdict.items() if k != "walls_spread"},
         "detail": {
             "platform": __import__("jax").default_backend(),
+            "backend": backend,
+            "delivery": cfg.delivery,
             "instances": instances,
             "wall_s": round(wall, 2),
             "walls_s": [round(w, 3) for w in walls],
-            "walls_spread": round(spread(walls), 3),
+            "walls_spread": verdict["walls_spread"],
+            **({"device_busy_s": dev["device_busy_s"]}
+               if "device_busy_s" in dev else
+               {"device_busy_error": dev.get("error", "?")}),
             "mean_rounds_to_decision": round(float(res.rounds.mean()), 4),
             "undecided": undecided,
         },

@@ -109,9 +109,11 @@ def _f_opt(n: int) -> int:
 
 # The product scheduling model: what every preset, sweep_point, bench.py and
 # ad-hoc CLI run defaults to. Decided by the measured device-busy A/B between
-# the two count-level samplers (docs/PERF.md round 5); flipping it re-goldens
-# every preset-level artifact, so it changes only with an A/B writeup.
-PRODUCT_DELIVERY = "urn"
+# the two count-level samplers (docs/PERF.md round 5: urn2 0.160 s device /
+# urn 0.276 s at config 4, 1.72x, walls 430.2k vs 283.5k inst/s —
+# artifacts/ab_delivery_r5.json); flipping it re-goldens every preset-level
+# artifact, so it changes only with an A/B writeup.
+PRODUCT_DELIVERY = "urn2"
 
 # Benchmark presets (BASELINE.json:6-12; pinned in spec/PROTOCOL.md §7).
 # All presets pin the product scheduling model; pass delivery="keys"

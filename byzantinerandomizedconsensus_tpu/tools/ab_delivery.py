@@ -1,0 +1,89 @@
+"""Delivery-sampler A/B on the device of record (VERDICT r4 #1).
+
+Measures config 4 end-to-end under each count-level delivery sampler — §4b
+``urn`` (sequential draws) vs §4b-v2 ``urn2`` (direct count inversion) — with
+the shared best-of-N wall methodology AND the profiler device-busy leg, which
+is the authoritative comparison signal through the noisy tunnel (docs/PERF.md
+round 4; utils/timing.py). The samplers draw different exact schedules, so
+``mean_rounds`` is recorded to show the distribution-level agreement next to
+the perf split.
+
+CLI: ``python -m byzantinerandomizedconsensus_tpu.tools.ab_delivery``
+writes ``artifacts/ab_delivery_r{N}.json``; docs/PERF.md round 5 quotes it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+
+from byzantinerandomizedconsensus_tpu.config import preset
+from byzantinerandomizedconsensus_tpu.tools.product import run_config
+from byzantinerandomizedconsensus_tpu.utils.rounds import default_artifact
+
+
+def measure(delivery: str, backend: str, instances: int) -> dict:
+    """One A/B leg — the shared product measurement record (tools/product.py
+    run_config: warmed best-of-N walls + device-busy), trimmed of the bulky
+    histogram and keyed by delivery."""
+    cfg = preset("config4", delivery=delivery, instances=instances)
+    entry, _raw_walls = run_config(cfg, backend)
+    keep = ("wall_s", "walls_s", "walls_spread", "instances_per_sec",
+            "mean_rounds_decided", "undecided_at_cap", "device_busy_s",
+            "device_busy_error")
+    return {"delivery": delivery,
+            **{k: entry[k] for k in keep if k in entry}}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default=default_artifact("ab_delivery"))
+    ap.add_argument("--instances", type=int, default=100_000)
+    ap.add_argument("--backend", default="jax")
+    ap.add_argument("--deliveries", nargs="*", default=["urn", "urn2"],
+                    choices=["keys", "urn", "urn2"])
+    args = ap.parse_args(argv)
+
+    from byzantinerandomizedconsensus_tpu.utils.devices import ensure_live_backend
+
+    ensure_live_backend()
+    import jax
+
+    legs = {}
+    for d in args.deliveries:
+        legs[d] = measure(d, args.backend, args.instances)
+        print(json.dumps(legs[d]), flush=True)
+
+    doc = {
+        "description": "Config-4 delivery-sampler A/B: walls (best-of-N) + "
+                       "profiler device-busy per sampler (tools/ab_delivery.py;"
+                       " VERDICT r4 #1/#2)",
+        "platform": jax.default_backend(),
+        "backend": args.backend,
+        "instances": args.instances,
+        "legs": legs,
+    }
+    if "urn" in legs and "urn2" in legs:
+        u, v = legs["urn"], legs["urn2"]
+        doc["urn2_vs_urn"] = {
+            "wall_speedup": round(u["wall_s"] / v["wall_s"], 3),
+            # >0 (not truthiness): a sub-50µs leg rounds to a valid 0.0 from
+            # which no ratio can be formed.
+            **({"device_busy_speedup":
+                round(u["device_busy_s"] / v["device_busy_s"], 3)}
+               if u.get("device_busy_s", 0) > 0
+               and v.get("device_busy_s", 0) > 0 else {}),
+            "mean_rounds_delta": round(
+                v["mean_rounds_decided"] - u["mean_rounds_decided"], 4),
+        }
+        print(json.dumps({"urn2_vs_urn": doc["urn2_vs_urn"]}), flush=True)
+    out = pathlib.Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(doc, indent=1) + "\n")
+    print(json.dumps({"out": str(out)}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

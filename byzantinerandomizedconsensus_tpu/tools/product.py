@@ -35,16 +35,21 @@ from byzantinerandomizedconsensus_tpu.utils import metrics
 from byzantinerandomizedconsensus_tpu.utils.rounds import (
     default_artifact, prev_round_artifact)
 from byzantinerandomizedconsensus_tpu.utils.timing import (
-    DEFAULT_REPEATS, spread, timed_best_of)
+    DEFAULT_REPEATS, device_busy, regression_verdict, spread, timed_best_of)
 
 
-def run_config(cfg, backend: str, timed_repeats: int = DEFAULT_REPEATS) -> dict:
+def run_config(cfg, backend: str, timed_repeats: int = DEFAULT_REPEATS):
     """One shipped config end-to-end: warm-up compile, then best-of-N
-    (utils/timing.py — the same methodology as bench.py)."""
-    res, walls = timed_best_of(get_backend(backend), cfg, timed_repeats)
+    (utils/timing.py — the same methodology as bench.py), plus the
+    noise-immune device-busy leg (VERDICT r4 #2). Returns
+    ``(entry, raw_walls)`` — the unrounded walls feed regression_verdict
+    (rounding first distorts the spread for sub-ms configs)."""
+    be = get_backend(backend)
+    res, walls = timed_best_of(be, cfg, timed_repeats)
     s = metrics.summary(res)
     s["round_histogram"] = metrics.round_histogram(res).tolist()
     best = min(walls)
+    dev = device_busy(be, cfg)
     s.update(
         backend=backend,
         wall_s=round(best, 3),
@@ -52,7 +57,13 @@ def run_config(cfg, backend: str, timed_repeats: int = DEFAULT_REPEATS) -> dict:
         walls_spread=round(spread(walls), 3),
         instances_per_sec=round(cfg.instances / best, 1),
     )
-    return s
+    if "device_busy_s" in dev:
+        s["device_busy_s"] = dev["device_busy_s"]
+    else:
+        # A failed capture must surface in the artifact (it explains a later
+        # "no device-busy comparison available" verdict), never vanish.
+        s["device_busy_error"] = dev.get("error", "?")
+    return s, walls
 
 
 def main(argv=None) -> int:
@@ -100,16 +111,21 @@ def main(argv=None) -> int:
             label = name
         print(f"{label}: n={cfg.n} f={cfg.f} x{cfg.instances} "
               f"{cfg.adversary}/{cfg.coin} cap={cfg.round_cap}", flush=True)
-        entry = run_config(cfg, args.backend)
+        entry, raw_walls = run_config(cfg, args.backend)
         entry["platform"] = platform
         # Per-preset regression guard (VERDICT r3 #5): like-for-like only —
-        # skip the comparison when the previous entry ran elsewhere.
+        # skip the comparison when the previous entry ran elsewhere. The
+        # machine-readable noise verdict (VERDICT r4 #2) keys the regression
+        # claim on device-busy when the walls are too noisy to carry it.
         prev_entry = prev[2].get(name, {}) if prev else {}
         if (prev_entry.get("instances_per_sec")
                 and prev_entry.get("platform") == platform
                 and prev_entry.get("backend") == args.backend):
-            entry["vs_prev_round"] = round(
-                entry["instances_per_sec"] / prev_entry["instances_per_sec"], 3)
+            entry.update(regression_verdict(
+                raw_walls, rate=entry["instances_per_sec"],
+                prev_wall_rate=prev_entry["instances_per_sec"],
+                device_busy_s=entry.get("device_busy_s"),
+                prev_device_busy_s=prev_entry.get("device_busy_s")))
             entry["prev_round_artifact"] = prev[0]
         art[name] = entry
         print(json.dumps({k: entry[k] for k in

@@ -15,16 +15,28 @@ the tunnelled TPU (bench.py and tools/product.py must not diverge):
 - artifacts record the full ``walls_s`` list so best AND dispersion are on
   the record;
 - rates computed from the unrounded minimum (rounding first can zero a
-  sub-millisecond leg).
+  sub-millisecond leg);
+- a **device-busy** leg next to the walls (VERDICT r4 #2): the profiler's
+  summed device program time is bit-stable across captures while tunnel
+  walls swing 40-80% in bad windows (docs/PERF.md round 4), so artifacts
+  carry both signals and :func:`regression_verdict` encodes which one a
+  regression claim may key on.
 """
 
 from __future__ import annotations
 
+import pathlib
 import time
 
 import numpy as np
 
 DEFAULT_REPEATS = 5
+
+# Above this (max-min)/min wall dispersion, wall-based vs_prev_round is
+# uninformative for sub-second runs (docs/PERF.md round 4: spreads of 41-76%
+# observed while the profiler device time was bit-identical) — regression
+# verdicts must key on device-busy time instead.
+NOISY_WALLS_SPREAD = 0.3
 
 
 def timed_best_of(be, cfg, repeats: int = DEFAULT_REPEATS):
@@ -49,3 +61,129 @@ def spread(walls) -> float:
     best-of figure so 'within tunnel noise' claims are checkable."""
     w = sorted(walls)
     return (w[-1] - w[0]) / w[0] if w and w[0] > 0 else 0.0
+
+
+def trace_snapshot(trace_dir) -> dict:
+    """{path: (mtime_ns, size)} of every trace file currently under
+    ``trace_dir`` — taken *before* a capture so parse_trace can tell this
+    run's output apart from leftovers in a reused dir. Keyed on
+    (st_mtime_ns, st_size), not bare mtime: an overwrite landing in the same
+    coarse-mtime quantum must still count as fresh (ADVICE r4)."""
+    d = pathlib.Path(trace_dir)
+    if not d.exists():
+        return {}
+    return {p: (p.stat().st_mtime_ns, p.stat().st_size)
+            for p in d.rglob("*.trace.json.gz")}
+
+
+def parse_trace(trace_dir, before: dict | None = None) -> dict:
+    """Device busy time + top device ops from the newest trace.json.gz under
+    ``trace_dir`` that this run produced: a file counts iff it is a new path
+    or its (mtime_ns, size) changed vs the ``before`` snapshot
+    (trace_snapshot). A failed capture must surface as an error, never
+    silently reparse a stale trace — and an overwrite of a previous run's
+    path still counts as fresh. Durations are summed per op name over
+    device-pid complete events; ``device_busy_s`` sums the top-level jit
+    program executions (child events nest inside them, so summing everything
+    would double-count)."""
+    import collections
+    import gzip
+    import json
+
+    before = before or {}
+    paths = sorted(
+        (p for p in pathlib.Path(trace_dir).rglob("*.trace.json.gz")
+         if p not in before
+         or (p.stat().st_mtime_ns, p.stat().st_size) != before[p]),
+        key=lambda p: p.stat().st_mtime_ns)
+    if not paths:
+        return {"error": "no new trace.json.gz produced by this run"}
+    with gzip.open(paths[-1]) as fh:
+        doc = json.load(fh)
+    ev = doc.get("traceEvents", [])
+    dev_pids = {e["pid"] for e in ev
+                if e.get("ph") == "M" and e.get("name") == "process_name"
+                and "TPU" in str(e.get("args", {}).get("name", ""))}
+    per_op = collections.Counter()
+    busy = 0.0
+    for e in ev:
+        if e.get("ph") == "X" and e.get("pid") in dev_pids:
+            name = e.get("name", "?")
+            per_op[name] += e.get("dur", 0)
+            if name.startswith("jit_"):
+                busy += e.get("dur", 0)
+    return {
+        "source": str(paths[-1]),
+        "device_busy_s": round(busy / 1e6, 4),
+        "top_device_ops_s": {k: round(v / 1e6, 4)
+                             for k, v in per_op.most_common(8)},
+    }
+
+
+def device_busy(be, cfg, trace_dir=None) -> dict:
+    """Profiler-measured device-busy time of one warmed full run of ``cfg``.
+
+    The noise-immune half of the perf record (VERDICT r4 #2): dispatches the
+    backend's own chunked program under ``jax.profiler`` and parses the trace.
+    Assumes the program is already compiled (call after timed_best_of).
+    Returns ``{"device_busy_s": ...}`` or ``{"error": ...}`` — host-only
+    backends and failed captures degrade to an error entry, never raise.
+    """
+    if not getattr(be, "needs_warmup", False):
+        return {"error": f"backend {be.name!r} runs on host; no device trace"}
+    import contextlib
+    import tempfile
+
+    import jax
+
+    from byzantinerandomizedconsensus_tpu.utils import profiling
+
+    cleanup = contextlib.nullcontext(trace_dir) if trace_dir \
+        else tempfile.TemporaryDirectory(prefix="device_busy_")
+    try:
+        with cleanup as tdir:
+            ids = np.arange(cfg.instances, dtype=np.int64)
+            chunk = be._clamp_chunk(cfg,
+                                    min(be._chunk_size(cfg), max(1, len(ids))))
+            fn = be._fn(cfg)
+            extra = be._extra_args(cfg)
+            before = trace_snapshot(tdir)
+            # _device_ctx: device-pinned backends (jax_cpu) must be profiled
+            # on THEIR device, not the JAX default the bare dispatch would use.
+            with be._device_ctx(), profiling.trace(tdir):
+                jax.block_until_ready(be._dispatch_chunks(fn, ids, chunk, extra))
+            out = parse_trace(tdir, before=before)
+        out.pop("top_device_ops_s", None)  # bench/product records stay small
+        return out
+    except Exception as e:  # tunnel profilers can be unsupported
+        return {"error": repr(e)}
+
+
+def regression_verdict(walls, prev_wall_rate=None, rate=None,
+                       device_busy_s=None, prev_device_busy_s=None) -> dict:
+    """Machine-readable explain-or-noise record (VERDICT r4 #2).
+
+    Encodes the PERF.md rule: when the wall spread exceeds
+    ``NOISY_WALLS_SPREAD``, wall-based ``vs_prev_round`` is uninformative and
+    the regression signal is the device-busy ratio (when both rounds have
+    one); otherwise the wall ratio stands. Returns a dict to merge into the
+    artifact: ``regression_signal`` names the authoritative field.
+    """
+    sp = spread(walls)
+    out = {"walls_spread": round(sp, 3)}
+    if rate is not None and prev_wall_rate:
+        out["vs_prev_round"] = round(rate / prev_wall_rate, 3)
+    # Strictly-positive check, not truthiness: a sub-50µs device leg rounds to
+    # 0.0 (a valid measurement, but no ratio can be formed from it).
+    if (device_busy_s or 0) > 0 and (prev_device_busy_s or 0) > 0:
+        # device ratio oriented like the wall ratio: >1 = faster than prev.
+        out["vs_prev_round_device"] = round(prev_device_busy_s / device_busy_s, 3)
+    if sp > NOISY_WALLS_SPREAD:
+        out["regression_signal"] = (
+            "vs_prev_round_device" if "vs_prev_round_device" in out
+            else "none: walls too noisy "
+                 f"(spread {sp:.2f} > {NOISY_WALLS_SPREAD}) and no device-busy "
+                 "comparison available")
+    elif "vs_prev_round" in out:
+        out["regression_signal"] = "vs_prev_round"
+    return out
