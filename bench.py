@@ -86,11 +86,12 @@ def main() -> int:
     overrides = {"instances": instances}
     if delivery is not None:
         overrides["delivery"] = delivery
-    elif backend.partition(":")[0] == "jax_pallas":
-        # The Pallas kernels implement keys + §4b urn only; the urn2 product
+    elif "pallas" in backend:
+        # The Pallas kernels implement keys + §4b urn only (any spelling:
+        # jax_pallas, jax:pallas, jax_sharded:2,pallas); the urn2 product
         # default would make the warm-up raise (check_pallas_delivery). A bare
-        # BENCH_BACKEND=jax_pallas A/B therefore measures the §4b cross-check
-        # kernel; set BENCH_DELIVERY=keys for the keys-model Pallas path.
+        # pallas A/B therefore measures the §4b cross-check kernel; set
+        # BENCH_DELIVERY=keys for the keys-model Pallas path.
         overrides["delivery"] = "urn"
     cfg = preset("config4", **overrides)
 

@@ -268,7 +268,12 @@ def step_counts(cfg, inst_ids, rnd, step, v0c, v1c, silent, faulty=None,
     plane = pl.BlockSpec((block_b, n_pad), lambda b, r: (b, 0))
     if strata == "minority":
         if faulty is None:
-            faulty = jnp.zeros((B, n), dtype=jnp.int32)
+            # An all-non-faulty default would silently include the faulty
+            # senders' injected minority votes in the §6.4b observation,
+            # diverging from the oracle instead of failing loudly (ADVICE r4).
+            raise ValueError(
+                "minority strata (adversary='adaptive_min') requires the "
+                "faulty mask; got faulty=None")
         faulty_in = [_pad(faulty.astype(jnp.int32), 0)]
         faulty_spec = [plane]
     else:

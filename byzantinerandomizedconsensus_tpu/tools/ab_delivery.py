@@ -26,13 +26,14 @@ from byzantinerandomizedconsensus_tpu.utils.rounds import default_artifact
 def measure(delivery: str, backend: str, instances: int) -> dict:
     """One A/B leg — the shared product measurement record (tools/product.py
     run_config: warmed best-of-N walls + device-busy), trimmed of the bulky
-    histogram and keyed by delivery."""
+    histogram and keyed by delivery. ``_wall_raw`` carries the unrounded best
+    for ratio-forming (rounded wall_s can be a valid 0.0)."""
     cfg = preset("config4", delivery=delivery, instances=instances)
-    entry, _raw_walls = run_config(cfg, backend)
+    entry, raw_walls = run_config(cfg, backend)
     keep = ("wall_s", "walls_s", "walls_spread", "instances_per_sec",
             "mean_rounds_decided", "undecided_at_cap", "device_busy_s",
             "device_busy_error")
-    return {"delivery": delivery,
+    return {"delivery": delivery, "_wall_raw": min(raw_walls),
             **{k: entry[k] for k in keep if k in entry}}
 
 
@@ -67,9 +68,10 @@ def main(argv=None) -> int:
     if "urn" in legs and "urn2" in legs:
         u, v = legs["urn"], legs["urn2"]
         doc["urn2_vs_urn"] = {
-            "wall_speedup": round(u["wall_s"] / v["wall_s"], 3),
-            # >0 (not truthiness): a sub-50µs leg rounds to a valid 0.0 from
-            # which no ratio can be formed.
+            # Ratios from unrounded values, formed only when positive (the
+            # recorded device leg can be a valid 0.0 for sub-50µs runs).
+            **({"wall_speedup": round(u["_wall_raw"] / v["_wall_raw"], 3)}
+               if v["_wall_raw"] > 0 else {}),
             **({"device_busy_speedup":
                 round(u["device_busy_s"] / v["device_busy_s"], 3)}
                if u.get("device_busy_s", 0) > 0
@@ -78,6 +80,8 @@ def main(argv=None) -> int:
                 v["mean_rounds_decided"] - u["mean_rounds_decided"], 4),
         }
         print(json.dumps({"urn2_vs_urn": doc["urn2_vs_urn"]}), flush=True)
+    for leg in legs.values():
+        leg.pop("_wall_raw", None)
     out = pathlib.Path(args.out)
     out.parent.mkdir(parents=True, exist_ok=True)
     out.write_text(json.dumps(doc, indent=1) + "\n")

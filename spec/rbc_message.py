@@ -21,15 +21,18 @@ Bracha's echo/ready/accept protocol [Bracha, Information & Computation 75, 1987]
 - :func:`run_message_instance` re-runs the full §5.2 consensus round body on top
   of message-level RBC — message-level §5.1b validation included — and must
   reproduce the count-level oracle (backends/cpu.py) exactly: per-step RBC
-  outcomes equal the count-level wire, the per-receiver delivered sets equal the
-  §4 mask under the mask-realizing schedule, and the final (rounds, decision)
-  equals ``CpuBackend.run``.
+  outcomes equal the count-level wire, the per-receiver deliveries equal the
+  count-level model under the delivery-realizing schedule (the §4 mask rows via
+  :func:`_make_mask_hold`, or the §4b/§4b-v2 per-class delivered-count vectors
+  via :func:`_make_counts_hold` — VERDICT r4 #3), and the final
+  (rounds, decision) equals ``CpuBackend.run``.
 
 Driven by tests/test_rbc_message.py: achievability (every count-level knob has a
 message-level strategy realizing it, and only those outcomes ever occur), attack
 strategies (split-brain init/echo/ready equivocation under adversarial schedules,
 reactive rushing), the threshold boundary, and the instance-level oracle match at
-n ∈ {4, 7, 10, 13}.
+n ∈ {4, 7, 10, 13, 16} across all three delivery models and every non-crash
+adversary (crash included on the urn leg).
 
 Pure scalar Python: this is an oracle-layer instrument (like spec/analytic_bracha),
 never a performance path.
@@ -355,6 +358,71 @@ def _make_mask_hold(mask) -> Callable[[Engine, Msg], bool]:
     return hold
 
 
+def _make_counts_hold(values, silent_all, targets) -> Callable[[Engine, Msg], bool]:
+    """Scheduler realizing a §4b/§4b-v2 delivered-count vector at message level
+    — the count-domain analog of :func:`_make_mask_hold` (VERDICT r4 #3): the
+    accept-causing READY of a live non-own sender whose wire-value class is
+    already full at the receiver is withheld until the receiver's whole quota
+    has accepted, so the first min(L, n−f−1) valid non-own accepts carry
+    exactly the urn's per-class counts. ``targets[v]`` is the per-receiver
+    non-own delivered count per wire value class (0, 1, ⊥) — feasible by
+    construction (``r_w ≤ m_w``), so no deadlock: an under-target class always
+    has a live sender left to admit. Withholding only ever defers —
+    :meth:`Engine.run` flushes all holds, preserving eventual delivery."""
+
+    def hold(eng: Engine, msg: Msg) -> bool:
+        if msg.kind != READY:
+            return False
+        v, u = msg.dst, msg.inst
+        if u == v or silent_all[u]:
+            return False
+        view = eng.views[v][u]
+        if view.accepted is not None:
+            return False
+        s = view.ready_from.get(msg.value, set())
+        if msg.src in s or len(s) + 1 < 2 * eng.f + 1:
+            return False  # not the accept-causing delivery
+        admitted = [0, 0, 0]
+        for w in range(eng.n):
+            if w != v and not silent_all[w] \
+                    and eng.views[v][w].accepted is not None:
+                admitted[int(values[w])] += 1
+        if sum(admitted) >= sum(targets[v]):
+            return False  # quota realized; later accepts sit beyond it
+        return admitted[int(values[u])] >= targets[v][int(values[u])]
+
+    return hold
+
+
+def _urn_counts_and_targets(cfg, net, adv, r: int, t: int, honest, values,
+                            silent_all):
+    """Count-level §4b/§4b-v2 delivery for one step: the (c0, c1) arrays from
+    the oracle's urn sampler (strata per adversary, mirroring backends/cpu.py)
+    plus the per-receiver non-own per-class targets they induce."""
+    n, f = cfg.n, cfg.f
+    if cfg.adversary == "adaptive":
+        strata, minority = "class", 0
+    elif cfg.adversary == "adaptive_min":
+        strata, minority = "minority", adv.observed_minority(honest)
+    else:
+        strata, minority = "none", 0
+    counts = net.urn_counts if cfg.delivery == "urn" else net.urn2_counts
+    c0, c1 = counts(r, t, [values, values], silent_all,
+                    strata=strata, minority=minority)
+    targets = []
+    for v in range(n):
+        own = int(values[v])
+        live_no = sum(1 for u in range(n)
+                      if u != v and not silent_all[u])
+        quota = min(live_no, n - f - 1)
+        # own message is always delivered, silence-exempt (spec §4/§4b) — the
+        # urn counts include it unconditionally, the non-own targets never do.
+        t0 = int(c0[v]) - (1 if own == 0 else 0)
+        t1 = int(c1[v]) - (1 if own == 1 else 0)
+        targets.append([t0, t1, quota - t0 - t1])
+    return c0, c1, targets
+
+
 def _realize_faulty_sender(eng: Engine, rng: random.Random, u: int,
                            wire_silent: bool, wire_value: int, honest_value: int) -> None:
     """Realize one count-level knob (silent, or common value ``wire_value``) for
@@ -392,11 +460,14 @@ def run_message_instance(cfg, instance: int, rng: random.Random,
     engine invariants prove the quotient; the common outcomes are asserted equal
     to the count-level wire ``(values, silent)`` from ``Adversary.inject``;
     receiver-local §5.1b validation over the accepted outcomes is asserted equal
-    to the global count-level predicate; and under the mask-realizing schedule
-    each receiver's wait-quota (first n−f valid accepts, own message in-head)
-    is asserted equal to the §4 delivery mask row. State then evolves through the
-    same ``Replica`` machine as backends/cpu.py; the caller compares the returned
-    ``(rounds, decision)`` with ``CpuBackend.run``.
+    to the global count-level predicate; and under the delivery-realizing
+    schedule each receiver's wait-quota (first n−f valid accepts, own message
+    in-head) is asserted equal to the count-level delivery — the §4 mask row
+    under ``delivery="keys"`` (:func:`_make_mask_hold`), or the §4b/§4b-v2
+    per-class delivered-count vector under ``delivery="urn"``/``"urn2"``
+    (:func:`_make_counts_hold`, VERDICT r4 #3). State then evolves through the
+    same ``Replica`` machine as backends/cpu.py; the caller compares the
+    returned ``(rounds, decision)`` with ``CpuBackend.run``.
     """
     from byzantinerandomizedconsensus_tpu.backends.cpu import CpuBackend
     from byzantinerandomizedconsensus_tpu.core.adversary import make_adversary
@@ -405,8 +476,9 @@ def run_message_instance(cfg, instance: int, rng: random.Random,
     from byzantinerandomizedconsensus_tpu.ops import prf
 
     cfg = cfg.validate()
-    assert cfg.protocol == "bracha" and cfg.delivery == "keys", \
-        "message-level validation targets the bracha §4-mask model"
+    assert cfg.protocol == "bracha", \
+        "message-level validation targets the bracha protocol"
+    count_level = cfg.delivery in ("urn", "urn2")
     if realize_rng is None:
         realize_rng = random.Random(rng.randrange(1 << 30))
     n, f = cfg.n, cfg.f
@@ -430,9 +502,16 @@ def run_message_instance(cfg, instance: int, rng: random.Random,
             g_prev = (int(np.count_nonzero(~silent_all & (values == 0))),
                       int(np.count_nonzero(~silent_all & (values == 1))))
 
-            # ---- message level: n concurrent RBCs under the mask schedule ----
-            mask = net.delivery_mask(r, t, silent_all, bias)
-            eng = Engine(n, f, faulty, rng=rng, hold=_make_mask_hold(mask))
+            # ---- message level: n concurrent RBCs under the delivery-
+            # realizing schedule (mask row / per-class count targets) ----
+            if count_level:
+                c0, c1, targets = _urn_counts_and_targets(
+                    cfg, net, adv, r, t, honest, values, silent_all)
+                eng = Engine(n, f, faulty, rng=rng,
+                             hold=_make_counts_hold(values, silent_all, targets))
+            else:
+                mask = net.delivery_mask(r, t, silent_all, bias)
+                eng = Engine(n, f, faulty, rng=rng, hold=_make_mask_hold(mask))
             for u in range(n):
                 if not faulty[u]:
                     eng.start_broadcast(u, int(honest[u]))
@@ -465,18 +544,32 @@ def run_message_instance(cfg, instance: int, rng: random.Random,
                     if out[u] == 1 and not silent_all[u]))
             assert g_prev_msg == g_prev
 
-            # wait-quota == the §4 mask row (leg 3): first n−f−1 valid non-own
-            # accepts in message-arrival order, plus the own message in-head
-            for v in range(n):
-                seq = [u for (u, _w) in eng.accept_order[v]
-                       if u != v and not silent_all[u]]
-                quota = {v} | set(seq[: n - f - 1])
-                assert quota == set(int(u) for u in np.flatnonzero(mask[v])), (
-                    f"delivered set diverged at receiver {v} (r={r} t={t})")
-
-            vmat = np.broadcast_to(values, (n, n))
-            for rep in reps:
-                rep.on_deliver(t, vmat[rep.index], mask[rep.index])
+            # wait-quota == the count-level delivery (leg 3): the first
+            # n−f−1 valid non-own accepts in message-arrival order, plus the
+            # own message in-head — set-equal to the §4 mask row (keys), or
+            # class-count-equal to the §4b/§4b-v2 delivered-count vector (urn).
+            if count_level:
+                for v in range(n):
+                    seq = [u for (u, _w) in eng.accept_order[v]
+                           if u != v and not silent_all[u]]
+                    got = [0, 0, 0]
+                    for u in seq[: n - f - 1]:
+                        got[int(values[u])] += 1
+                    assert got == targets[v], (
+                        f"delivered class counts diverged at receiver {v} "
+                        f"(r={r} t={t}): {got} != {targets[v]}")
+                for rep in reps:
+                    rep.on_counts(t, int(c0[rep.index]), int(c1[rep.index]))
+            else:
+                for v in range(n):
+                    seq = [u for (u, _w) in eng.accept_order[v]
+                           if u != v and not silent_all[u]]
+                    quota = {v} | set(seq[: n - f - 1])
+                    assert quota == set(int(u) for u in np.flatnonzero(mask[v])), (
+                        f"delivered set diverged at receiver {v} (r={r} t={t})")
+                vmat = np.broadcast_to(values, (n, n))
+                for rep in reps:
+                    rep.on_deliver(t, vmat[rep.index], mask[rep.index])
 
         if cfg.coin == "shared":
             shared = int(prf.prf_bit(cfg.seed, instance, r, prf.COIN_STEP, 0, 0,

@@ -1,0 +1,56 @@
+"""Driver entry-point module: the forced-CPU helper must announce every
+degradation on stderr (VERDICT r4 weak #4) — the dry-run's output is the
+driver's multi-chip artifact of record and must never silently change meaning
+when a private JAX API drifts."""
+
+import pathlib
+import sys
+
+import pytest
+
+_REPO_ROOT = str(pathlib.Path(__file__).resolve().parents[1])
+
+
+@pytest.fixture()
+def graft_entry(monkeypatch):
+    if _REPO_ROOT not in sys.path:
+        sys.path.insert(0, _REPO_ROOT)
+    import __graft_entry__ as ge
+
+    # conftest already forces JAX_PLATFORMS=cpu, so the helper's gate is open.
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    return ge
+
+
+def test_force_cpu_warns_on_import_failure(graft_entry, monkeypatch, capsys):
+    def boom():
+        raise ImportError("private API moved")
+
+    monkeypatch.setattr(graft_entry, "_import_xla_bridge", boom)
+    graft_entry._force_cpu_if_requested()  # must not raise
+    err = capsys.readouterr().err
+    assert "WARNING forced-CPU setup degraded" in err
+    assert "private API moved" in err
+
+
+def test_force_cpu_warns_on_missing_factories(graft_entry, monkeypatch, capsys):
+    class FakeBridge:
+        # no _backend_factories dict, no backends_are_initialized
+        pass
+
+    monkeypatch.setattr(graft_entry, "_import_xla_bridge", lambda: FakeBridge())
+    graft_entry._force_cpu_if_requested()  # must not raise
+    err = capsys.readouterr().err
+    assert "_backend_factories missing or not a dict" in err
+
+
+def test_force_cpu_noop_without_cpu_request(graft_entry, monkeypatch, capsys):
+    monkeypatch.setenv("JAX_PLATFORMS", "")
+    monkeypatch.setenv("XLA_FLAGS", "")
+
+    def boom():  # must never be reached when the env doesn't ask for CPU
+        raise AssertionError("helper ran without a CPU request")
+
+    monkeypatch.setattr(graft_entry, "_import_xla_bridge", boom)
+    graft_entry._force_cpu_if_requested()
+    assert capsys.readouterr().err == ""

@@ -68,3 +68,31 @@ def test_registry_exposes_sharded():
     from byzantinerandomizedconsensus_tpu.backends import available_backends
 
     assert "jax_sharded" in available_backends()
+
+
+@pytest.mark.parametrize("n_data,n_model", [(4, 2), (2, 4)])
+def test_compiled_collective_inventory(n_data, n_model):
+    """The ARCHITECTURE.md multi-chip cost model's measured half: the compiled
+    benchmark-shape program contains exactly 3 all-gathers (one u8 wire-value
+    gather per Bracha step) and 2 all-reduces (per-round termination psum +
+    once-per-chunk decision psum) — nothing else crosses chips, on any mesh
+    layout. A new collective appearing here invalidates the predicted scaling
+    curve and must update that section."""
+    import re
+
+    import jax.numpy as jnp
+
+    from byzantinerandomizedconsensus_tpu.config import preset
+    from byzantinerandomizedconsensus_tpu.parallel import sharded
+
+    mesh = make_mesh(n_data=n_data, n_model=n_model,
+                     devices=_cpu_devices(n_data * n_model))
+    cfg = preset("config4", instances=8, round_cap=64)
+    fn = jax.jit(lambda ids, key: sharded._run_chunk_sharded(cfg, mesh, ids, key))
+    hlo = fn.lower(jnp.arange(8, dtype=jnp.uint32),
+                   jnp.zeros(2, dtype=jnp.uint32)).compile().as_text()
+    counts = {op: len(re.findall(rf"\b{op}\b", hlo))
+              for op in ("all-gather", "all-reduce", "collective-permute",
+                         "all-to-all")}
+    assert counts == {"all-gather": 3, "all-reduce": 2,
+                      "collective-permute": 0, "all-to-all": 0}, counts
