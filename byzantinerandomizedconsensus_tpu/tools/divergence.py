@@ -1,15 +1,17 @@
-"""Keys-vs-urn per-instance divergence map (spec §4b "cross-model divergence").
+"""Cross-model per-instance divergence map (spec §4b/§4b-v2).
 
-The two delivery models (spec §4 keys, §4b urn) are different exact samplers of
-the same delivery-distribution family, so per-instance outcomes *should* diverge
-wherever scheduling freedom can cross a quorum margin — and round 3 found they
-never did at any committed comparison point (all of which were config-5-family
-points: bracha + adaptive). This tool maps where the divergence actually lives,
-so the cross-model statistical tests (tests/test_urn.py) are demonstrably run
-on samples with discriminating power (VERDICT r3 missing #3 / next #3).
+The three delivery models (spec §4 keys, §4b urn, §4b-v2 urn2) are different
+exact samplers of the same delivery-distribution family, so per-instance
+outcomes *should* diverge pairwise wherever scheduling freedom can cross a
+quorum margin — and round 3 found the keys↔urn pair never did at any committed
+comparison point (all of which were config-5-family points: bracha + adaptive).
+This tool maps where the divergence actually lives, so the cross-model
+statistical tests (tests/test_urn.py, tests/test_urn2.py) are demonstrably run
+on samples with discriminating power (VERDICT r3 missing #3 / next #3; urn2
+pairs added in round 5).
 
-Measured structure (artifacts/divergence_r4.json; pinned as regression tests in
-tests/test_divergence.py):
+Measured structure (artifacts/divergence_r5.json — all three pairwise
+divergences; pinned as regression tests in tests/test_divergence.py):
 
 - **Divergent regime** — every non-adaptive adversary at small/medium n, plus
   benor+adaptive (whose class/value misalignment restores sampler freedom):
@@ -92,10 +94,14 @@ FULL_GRID: tuple[tuple[SimConfig, str], ...] = (
 
 
 def compare_row(cfg: SimConfig, instances: int, backend: str) -> dict:
-    """Run ``cfg`` at both deliveries; return the per-instance comparison."""
+    """Run ``cfg`` at all three deliveries; return the pairwise per-instance
+    comparison. ``frac_rounds_differ``/``frac_decision_differ`` stay the
+    keys↔urn pair (the original map's fields); the §4b-v2 sampler adds the
+    keys↔urn2 and urn↔urn2 pairs (round 5 — the "divergence regimes apply
+    verbatim" claim of spec §4b-v2, measured)."""
     cfg = dataclasses.replace(cfg, instances=instances).validate()
     res = {}
-    for delivery in ("keys", "urn"):
+    for delivery in ("keys", "urn", "urn2"):
         c = dataclasses.replace(cfg, delivery=delivery)
         res[delivery] = Simulator(c, backend).run()
 
@@ -107,7 +113,13 @@ def compare_row(cfg: SimConfig, instances: int, backend: str) -> dict:
         "frac_rounds_differ": float((k.rounds != u.rounds).mean()),
         "frac_decision_differ": float((k.decision != u.decision).mean()),
     }
-    for name, r in (("keys", k), ("urn", u)):
+    for a, b in (("keys", "urn2"), ("urn", "urn2")):
+        ra, rb = res[a], res[b]
+        row[f"frac_rounds_differ_{a}_{b}"] = float(
+            (ra.rounds != rb.rounds).mean())
+        row[f"frac_decision_differ_{a}_{b}"] = float(
+            (ra.decision != rb.decision).mean())
+    for name, r in res.items():
         row[f"mean_rounds_{name}"] = float(r.rounds.mean())
         row[f"p1_{name}"] = float((r.decision == 1).mean())
         row[f"capped_{name}"] = float((r.decision == 2).mean())
@@ -131,26 +143,27 @@ def run_divergence(instances: int = 400, backend: str = "numpy",
             rows.append(row)
     div = [r for r in rows if r["regime"] == "divergent"]
     rob = [r for r in rows if r["regime"] == "robust"]
-    return {
-        "rows": rows,
-        "summary": {
-            "divergent_rows": len(div),
-            "robust_rows": len(rob),
-            "min_frac_rounds_differ_divergent":
-                min(r["frac_rounds_differ"] for r in div),
-            "max_frac_rounds_differ_robust":
-                max(r["frac_rounds_differ"] for r in rob),
-            "max_abs_mean_rounds_gap":
-                max(abs(r["mean_rounds_keys"] - r["mean_rounds_urn"])
-                    for r in rows),
-        },
-    }
+    summary = {"divergent_rows": len(div), "robust_rows": len(rob)}
+    # Bare-suffixed fields keep their r4 keys↔urn meaning; each new pair gets
+    # its own suffix (no silent meaning changes across artifact rounds).
+    for suffix in ("", "_keys_urn2", "_urn_urn2"):
+        summary[f"min_frac_rounds_differ_divergent{suffix}"] = \
+            min(r[f"frac_rounds_differ{suffix}"] for r in div)
+        summary[f"max_frac_rounds_differ_robust{suffix}"] = \
+            max(r[f"frac_rounds_differ{suffix}"] for r in rob)
+    for a, b in (("keys", "urn"), ("keys", "urn2"), ("urn", "urn2")):
+        summary[f"max_abs_mean_rounds_gap_{a}_{b}"] = max(
+            abs(r[f"mean_rounds_{a}"] - r[f"mean_rounds_{b}"]) for r in rows)
+    summary["max_abs_mean_rounds_gap"] = \
+        summary["max_abs_mean_rounds_gap_keys_urn"]
+    return {"rows": rows, "summary": summary}
 
 
 def main(argv=None) -> int:
     from byzantinerandomizedconsensus_tpu.utils.rounds import default_artifact
 
-    ap = argparse.ArgumentParser(description="keys-vs-urn divergence map")
+    ap = argparse.ArgumentParser(
+        description="cross-model (keys/urn/urn2) divergence map")
     ap.add_argument("--out", default=default_artifact("divergence"))
     ap.add_argument("--instances", type=int, default=400)
     ap.add_argument("--backend", default="numpy")
