@@ -93,6 +93,12 @@ FULL_GRID: tuple[tuple[SimConfig, str], ...] = (
 )
 
 
+# Pairwise sampler comparisons. The bare suffix is the original keys↔urn map
+# (field names unchanged since r4); each later pair gets an explicit suffix.
+PAIRS = (("keys", "urn", ""), ("keys", "urn2", "_keys_urn2"),
+         ("urn", "urn2", "_urn_urn2"))
+
+
 def compare_row(cfg: SimConfig, instances: int, backend: str) -> dict:
     """Run ``cfg`` at all three deliveries; return the pairwise per-instance
     comparison. ``frac_rounds_differ``/``frac_decision_differ`` stay the
@@ -105,19 +111,16 @@ def compare_row(cfg: SimConfig, instances: int, backend: str) -> dict:
         c = dataclasses.replace(cfg, delivery=delivery)
         res[delivery] = Simulator(c, backend).run()
 
-    k, u = res["keys"], res["urn"]
     row = {
         "protocol": cfg.protocol, "n": cfg.n, "f": cfg.f,
         "adversary": cfg.adversary, "coin": cfg.coin, "seed": cfg.seed,
         "round_cap": cfg.round_cap, "instances": instances,
-        "frac_rounds_differ": float((k.rounds != u.rounds).mean()),
-        "frac_decision_differ": float((k.decision != u.decision).mean()),
     }
-    for a, b in (("keys", "urn2"), ("urn", "urn2")):
+    for a, b, suffix in PAIRS:
         ra, rb = res[a], res[b]
-        row[f"frac_rounds_differ_{a}_{b}"] = float(
+        row[f"frac_rounds_differ{suffix}"] = float(
             (ra.rounds != rb.rounds).mean())
-        row[f"frac_decision_differ_{a}_{b}"] = float(
+        row[f"frac_decision_differ{suffix}"] = float(
             (ra.decision != rb.decision).mean())
     for name, r in res.items():
         row[f"mean_rounds_{name}"] = float(r.rounds.mean())
@@ -144,14 +147,13 @@ def run_divergence(instances: int = 400, backend: str = "numpy",
     div = [r for r in rows if r["regime"] == "divergent"]
     rob = [r for r in rows if r["regime"] == "robust"]
     summary = {"divergent_rows": len(div), "robust_rows": len(rob)}
-    # Bare-suffixed fields keep their r4 keys↔urn meaning; each new pair gets
-    # its own suffix (no silent meaning changes across artifact rounds).
-    for suffix in ("", "_keys_urn2", "_urn_urn2"):
+    # Bare-suffixed fields keep their r4 keys↔urn meaning (PAIRS); each new
+    # pair gets its own suffix (no silent meaning changes across rounds).
+    for a, b, suffix in PAIRS:
         summary[f"min_frac_rounds_differ_divergent{suffix}"] = \
             min(r[f"frac_rounds_differ{suffix}"] for r in div)
         summary[f"max_frac_rounds_differ_robust{suffix}"] = \
             max(r[f"frac_rounds_differ{suffix}"] for r in rob)
-    for a, b in (("keys", "urn"), ("keys", "urn2"), ("urn", "urn2")):
         summary[f"max_abs_mean_rounds_gap_{a}_{b}"] = max(
             abs(r[f"mean_rounds_{a}"] - r[f"mean_rounds_{b}"]) for r in rows)
     summary["max_abs_mean_rounds_gap"] = \

@@ -45,15 +45,17 @@ def test_divergence_exists_and_statistics_accept(cfg, min_frac):
     assert row["frac_rounds_differ_keys_urn2"] > min_frac, row
     assert row["frac_rounds_differ_urn_urn2"] > min_frac, row
     # ... and the statistical acceptance the family-equality claim needs. The
-    # mean-rounds bound is *relative* (15% + a small absolute floor): these
-    # configs' rounds are geometric-tailed (local coin, mean up to ~15,
-    # σ ≈ mean), so an absolute bound has no headroom at a few hundred
-    # samples — the committed divergence_r5.json measures a 1.06 absolute /
-    # 7.6% relative urn↔urn2 gap at n=16 f=7 with 400 instances.
+    # mean-rounds bound is *relative* (5% + a small absolute floor): these
+    # configs' rounds are geometric-tailed (local coin; the n=16 f=7 row's
+    # mean is ~36 with σ ≈ mean), so an absolute bound has no headroom at a
+    # few hundred samples — the committed divergence_r5.json measures a 1.06
+    # absolute (2.9% relative) urn↔urn2 gap at n=16 f=7 with 400 instances,
+    # and 5% + 0.3 keeps ~2× headroom over that while still rejecting a gap
+    # twice the largest ever measured.
     for a, b in (("keys", "urn"), ("keys", "urn2"), ("urn", "urn2")):
         scale = max(row[f"mean_rounds_{a}"], row[f"mean_rounds_{b}"])
         assert abs(row[f"mean_rounds_{a}"] - row[f"mean_rounds_{b}"]) \
-            < 0.15 * scale + 0.3, (a, b, row)
+            < 0.05 * scale + 0.3, (a, b, row)
         assert abs(row[f"p1_{a}"] - row[f"p1_{b}"]) < 0.08, (a, b, row)
 
 

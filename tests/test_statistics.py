@@ -56,14 +56,14 @@ def test_shared_coin_fairness_and_commonality():
 def test_mean_rounds_matches_exact_markov_constant():
     """Mean rounds-to-decision for Ben-Or n=4 f=1 against the *exact* value from
     the spec/analytic.py Markov-chain enumeration (SURVEY.md §4.4; spec §8a):
-    E[rounds] = 3.221122… for uniform initial estimates, identically for both
-    delivery models. A consistently-wrong protocol cannot pass this; cross-seed
+    E[rounds] = 3.221122… for uniform initial estimates, identically for every
+    delivery model. A consistently-wrong protocol cannot pass this; cross-seed
     stability alone could."""
     from spec.analytic import expected_rounds_benor_n4
 
     exact = expected_rounds_benor_n4()
     assert abs(exact - 3.221122) < 1e-5, "enumeration drifted from the pinned spec value"
-    for delivery in ("urn", "keys"):
+    for delivery in ("urn", "urn2", "keys"):
         rs = []
         for seed in (1, 2, 3):
             cfg = SimConfig(protocol="benor", n=4, f=1, instances=2500,
@@ -82,7 +82,7 @@ def test_mean_rounds_matches_exact_bracha_chain():
     adversary against the exact spec/analytic_bracha.py enumeration (VERDICT
     r2 #8; spec §8b). This is the analytic pin for the §5.1b validation logic
     and the three-step round body: E[rounds] = 1.244628 (shared coin) /
-    1.313035 (local coin), identically for both delivery models. The chain is
+    1.313035 (local coin), identically for every delivery model. The chain is
     re-derived here (≈6 s, cached) so a drift in either the enumeration or
     the pinned constants fails loudly."""
     from spec.analytic_bracha import expected_rounds_bracha_n4
@@ -93,7 +93,7 @@ def test_mean_rounds_matches_exact_bracha_chain():
         assert abs(exact - want) < 1e-5, \
             f"enumeration drifted from the pinned spec §8b value ({coin})"
     for coin in ("shared", "local"):
-        for delivery in ("urn", "keys"):
+        for delivery in ("urn", "urn2", "keys"):
             cfg = SimConfig(protocol="bracha", n=4, f=1, instances=8000,
                             adversary="byzantine", coin=coin, round_cap=64,
                             seed=47, delivery=delivery)
@@ -131,7 +131,7 @@ def test_mean_rounds_matches_exact_adaptive_min_chain():
     """Third closed-form anchor (spec §8c, round 4): Bracha n=4 f=1 under
     adaptive_min. Deterministic minority injection + minority-first biased
     delivery collapse the chain to 8 undecided states with exact rational
-    constants — E[rounds] = 1.75 (shared) / 4.0 (local), both delivery models
+    constants — E[rounds] = 1.75 (shared) / 4.0 (local), every delivery model
     (the local value, 3.05× the Byzantine anchor's 1.313, is the closed-form
     statement of §6.4's measured small-n dominance). P[decide 1] = 1/2 exactly
     (the §8b symmetry argument carries over)."""
@@ -144,7 +144,7 @@ def test_mean_rounds_matches_exact_adaptive_min_chain():
             f"enumeration drifted from the pinned spec §8c value ({coin})"
         assert abs(p_decide_one_bracha_n4(coin, "adaptive_min") - 0.5) < 1e-9
     for coin, want in pinned.items():
-        for delivery in ("urn", "keys"):
+        for delivery in ("urn", "urn2", "keys"):
             cfg = SimConfig(protocol="bracha", n=4, f=1, instances=8000,
                             adversary="adaptive_min", coin=coin, round_cap=64,
                             seed=47, delivery=delivery)
