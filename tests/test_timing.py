@@ -1,0 +1,103 @@
+"""utils/timing.py: the shared measurement discipline (VERDICT r4 #2).
+
+The wall-based legs are exercised end-to-end by bench/product runs on the live
+device; these tests pin the host-testable halves — the regression-verdict
+rule, trace parsing/freshness, and the host-backend degradation path — so the
+driver-facing artifact semantics can't drift silently.
+"""
+
+import gzip
+import json
+import pathlib
+
+from byzantinerandomizedconsensus_tpu.utils import timing
+
+
+# -- regression_verdict: the machine-readable explain-or-noise rule -----------
+
+def test_verdict_quiet_walls_keys_on_wall_ratio():
+    out = timing.regression_verdict([1.0, 1.05, 1.1], prev_wall_rate=100.0,
+                                    rate=110.0, device_busy_s=0.5,
+                                    prev_device_busy_s=0.6)
+    assert out["regression_signal"] == "vs_prev_round"
+    assert out["vs_prev_round"] == 1.1
+    assert out["vs_prev_round_device"] == 1.2  # still recorded alongside
+
+
+def test_verdict_noisy_walls_keys_on_device():
+    out = timing.regression_verdict([1.0, 1.5], prev_wall_rate=100.0,
+                                    rate=70.0, device_busy_s=0.5,
+                                    prev_device_busy_s=0.5)
+    assert out["walls_spread"] == 0.5
+    assert out["regression_signal"] == "vs_prev_round_device"
+    assert out["vs_prev_round_device"] == 1.0  # the wall "regression" is noise
+
+
+def test_verdict_noisy_walls_without_device_says_so():
+    out = timing.regression_verdict([1.0, 1.5], prev_wall_rate=100.0, rate=70.0)
+    assert out["regression_signal"].startswith("none: walls too noisy")
+
+
+def test_verdict_zero_device_forms_no_ratio():
+    """A sub-50µs device leg legitimately rounds to 0.0 — recorded upstream,
+    but no ratio can be formed from it."""
+    out = timing.regression_verdict([1.0, 1.01], prev_wall_rate=100.0,
+                                    rate=100.0, device_busy_s=0.0,
+                                    prev_device_busy_s=0.5)
+    assert "vs_prev_round_device" not in out
+    assert out["regression_signal"] == "vs_prev_round"
+
+
+def test_verdict_without_prev_round():
+    out = timing.regression_verdict([1.0, 1.02])
+    assert "vs_prev_round" not in out and "regression_signal" not in out
+
+
+# -- trace parsing + freshness ------------------------------------------------
+
+def _write_trace(path: pathlib.Path, busy_us: int) -> None:
+    doc = {"traceEvents": [
+        {"ph": "M", "name": "process_name", "pid": 7,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "X", "pid": 7, "name": "jit_step", "dur": busy_us},
+        {"ph": "X", "pid": 7, "name": "fusion.1", "dur": busy_us // 2},
+        # host-pid events must not count toward device busy
+        {"ph": "X", "pid": 1, "name": "jit_step", "dur": 10 ** 9},
+    ]}
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with gzip.open(path, "wt") as fh:
+        json.dump(doc, fh)
+
+
+def test_parse_trace_sums_top_level_jit_device_time(tmp_path):
+    _write_trace(tmp_path / "a" / "x.trace.json.gz", busy_us=250_000)
+    out = timing.parse_trace(tmp_path, before={})
+    assert out["device_busy_s"] == 0.25  # jit_step only; host pid excluded
+    assert "jit_step" in out["top_device_ops_s"]
+
+
+def test_parse_trace_rejects_stale_and_accepts_same_mtime_overwrite(tmp_path):
+    """Freshness is (mtime_ns, size), not bare mtime (ADVICE r4): an overwrite
+    landing in the same mtime quantum still counts as fresh when its size
+    changes; an untouched dir is an error, never a silent reparse."""
+    import os
+
+    p = tmp_path / "t" / "x.trace.json.gz"
+    _write_trace(p, busy_us=100_000)
+    before = timing.trace_snapshot(tmp_path)
+    assert timing.parse_trace(tmp_path, before=before) == {
+        "error": "no new trace.json.gz produced by this run"}
+    # overwrite with different content but force the snapshot's mtime back
+    mtime = before[p][0]
+    _write_trace(p, busy_us=900_000)
+    os.utime(p, ns=(mtime, mtime))
+    out = timing.parse_trace(tmp_path, before=before)
+    assert out.get("device_busy_s") == 0.9, out
+
+
+def test_device_busy_host_backend_degrades_to_error():
+    from byzantinerandomizedconsensus_tpu.backends import get_backend
+    from byzantinerandomizedconsensus_tpu.config import preset
+
+    out = timing.device_busy(get_backend("numpy"), preset("config1"))
+    assert "error" in out and "host" in out["error"]
