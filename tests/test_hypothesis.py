@@ -23,7 +23,7 @@ def sim_configs(draw):
         fmax = (n - 1) // 2
     f = draw(st.integers(min_value=0, max_value=max(0, fmax)))
     seed = draw(st.integers(min_value=0, max_value=2**40))
-    delivery = draw(st.sampled_from(["keys", "urn"]))
+    delivery = draw(st.sampled_from(["keys", "urn", "urn2"]))
     return SimConfig(protocol=protocol, n=n, f=f, instances=12, adversary=adversary,
                      coin=coin, seed=seed, round_cap=48,
                      delivery=delivery).validate()
