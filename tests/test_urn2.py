@@ -198,3 +198,77 @@ def test_urn2_rejects_pallas_kernel():
     cfg = dataclasses.replace(URN2_SMALL[0], delivery="urn2")
     with pytest.raises(ValueError, match="urn2"):
         Simulator(cfg, "jax_pallas").run()
+
+
+@pytest.mark.parametrize("adversary", ["none", "adaptive_min"])
+def test_joint_counts_match_exact_stratified_law(adversary):
+    """The FULL §4b-v2 decomposition against closed form: counts_fn's joint
+    (c0, c1) distribution at a fixed wire must equal the deterministic stratum
+    split composed with nested hypergeometrics — P(d0, d1) = HG(Lb, mb0, Db)
+    · HG(Lb−mb0, mb1, Db−d0b) ⊗ (unbiased likewise) — not merely have correct
+    single-segment marginals (test_chain_exact_hypergeometric). Sampled over
+    many PRF instances at one receiver lane, 5σ bands per support point."""
+    from byzantinerandomizedconsensus_tpu.ops import urn2
+
+    cfg = SimConfig(protocol="bracha", n=16, f=5, instances=1,
+                    adversary=adversary, coin="shared", delivery="urn2",
+                    ).validate()
+    n, f = cfg.n, cfg.f
+    B = 40_000
+    inst = np.arange(B, dtype=np.uint32)
+    # Fixed wire: 5×0, 6×1, 5×⊥; faulty = last f senders (they "sent" what
+    # values says — counts_fn only reads values/silent/faulty/honest).
+    base = np.array([0] * 5 + [1] * 6 + [2] * 5, dtype=np.uint8)
+    values = np.broadcast_to(base, (B, n)).copy()
+    honest = values
+    silent = np.zeros((B, n), dtype=bool)
+    faulty = np.zeros((B, n), dtype=bool)
+    faulty[:, n - f:] = True
+    c0, c1 = urn2.counts_fn(cfg, cfg.seed, inst, 0, 0, values, silent, faulty,
+                            honest, xp=np)
+    v = 0  # receiver lane under test (own value 0, always delivered)
+    m = [int(((np.arange(n) != v) & (base == w)).sum()) for w in (0, 1, 2)]
+    L = sum(m)
+    D = max(0, L - (n - f - 1))
+    if adversary == "adaptive_min":
+        # minority among live honest non-⊥ votes: 5×0 vs 6×1 among the 11
+        # correct senders → minority = 0; biased(w) = (w == 2) | (w != 0),
+        # i.e. the *majority* value and ⊥ are dropped first (spec §6.4b).
+        st = [False, True, True]
+    else:
+        st = [False, False, False]
+    mb = [m[w] if st[w] else 0 for w in range(3)]
+    Lb, Db = sum(mb), min(D, sum(mb))
+
+    def nested(mm0, mm1, LL, DD):
+        """P(d0, d1) over one stratum: d0 ~ HG(LL, mm0, DD), d1 | d0."""
+        out = {}
+        for d0 in range(min(mm0, DD) + 1):
+            p0 = _hg_pmf(LL, mm0, DD, d0)
+            if p0 == 0.0:
+                continue
+            for d1 in range(min(mm1, DD - d0) + 1):
+                p1 = _hg_pmf(LL - mm0, mm1, DD - d0, d1)
+                if p1 > 0.0:
+                    out[(d0, d1)] = out.get((d0, d1), 0.0) + p0 * p1
+        return out
+
+    pb = nested(mb[0], mb[1], Lb, Db)
+    pu = nested(m[0] - mb[0], m[1] - mb[1], L - Lb, D - Db)
+    joint = {}
+    for (a0, a1), p in pb.items():
+        for (b0, b1), q in pu.items():
+            k = (a0 + b0, a1 + b1)
+            joint[k] = joint.get(k, 0.0) + p * q
+
+    own0 = 1  # receiver 0's own value is 0
+    emp = {}
+    for x, y in zip(c0[:, v], c1[:, v]):
+        d0 = m[0] - (int(x) - own0)
+        d1 = m[1] - int(y)
+        emp[(d0, d1)] = emp.get((d0, d1), 0) + 1
+    assert set(emp) <= set(joint), (sorted(emp), sorted(joint))
+    for k, p in joint.items():
+        e = emp.get(k, 0) / B
+        tol = 5 * math.sqrt(max(p * (1 - p), 1e-9) / B) + 1e-4
+        assert abs(e - p) < tol, f"{adversary} {k}: emp={e:.5f} pmf={p:.5f}"
