@@ -23,10 +23,10 @@ def check_pallas_delivery(cfg: SimConfig) -> None:
     """Reject kernel='pallas' for deliveries the Pallas kernels don't
     implement — fail loudly rather than fall back silently (ADVICE r1).
     Shared by JaxBackend and JaxShardedBackend so the guard can't drift."""
-    if cfg.delivery == "urn2":
+    if cfg.delivery in ("urn2", "urn3"):
         raise ValueError(
             "kernel='pallas' implements the §4b sampler only; "
-            "delivery='urn2' supports kernel='xla'")
+            f"delivery={cfg.delivery!r} supports kernel='xla'")
 
 
 @dataclasses.dataclass

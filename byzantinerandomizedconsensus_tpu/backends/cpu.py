@@ -99,8 +99,8 @@ class CpuBackend(SimulatorBackend):
                         minority = adv.observed_minority(honest)
                     else:
                         strata, minority = "none", 0
-                    counts = net.urn_counts if cfg.delivery == "urn" \
-                        else net.urn2_counts
+                    counts = {"urn": net.urn_counts, "urn2": net.urn2_counts,
+                              "urn3": net.urn3_counts}[cfg.delivery]
                     c0, c1 = counts(r, t, vbc, silent,
                                     strata=strata, minority=minority)
                     for rep in replicas:

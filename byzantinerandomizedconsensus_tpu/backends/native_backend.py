@@ -25,9 +25,9 @@ _PROTO = {"benor": 0, "bracha": 1}
 _ADV = {"none": 0, "crash": 1, "byzantine": 2, "adaptive": 3, "adaptive_min": 4}
 _COIN = {"local": 0, "shared": 1}
 _INIT = {"random": 0, "all0": 1, "all1": 2, "split": 3}
-_DELIVERY = {"keys": 0, "urn": 1, "urn2": 2}
+_DELIVERY = {"keys": 0, "urn": 1, "urn2": 2, "urn3": 3}
 
-_ABI_VERSION = 3
+_ABI_VERSION = 4
 
 _lib = None
 

@@ -17,6 +17,7 @@ import pathlib
 import sys
 
 from byzantinerandomizedconsensus_tpu import PRESETS, SimConfig, Simulator, preset
+from byzantinerandomizedconsensus_tpu.config import DELIVERY_KINDS
 from byzantinerandomizedconsensus_tpu.utils import metrics, sweep
 
 
@@ -33,10 +34,12 @@ def _add_config_args(p: argparse.ArgumentParser, default_backend: str = "cpu") -
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--round-cap", type=int, default=None)
     p.add_argument("--init", choices=["random", "all0", "all1", "split"], default=None)
-    p.add_argument("--delivery", choices=["keys", "urn", "urn2"], default=None,
+    p.add_argument("--delivery", choices=list(DELIVERY_KINDS), default=None,
                    help="scheduling model: urn (spec §4b, sequential count-level "
-                        "draws) | urn2 (spec §4b-v2, direct count inversion) — "
-                        "the count-level pair; presets pin the A/B-measured "
+                        "draws) | urn2 (spec §4b-v2, direct count inversion) | "
+                        "urn3 (spec §4c, mode-anchored cheap law — a different "
+                        "distribution, not a §4b-family sampler) — the "
+                        "count-level trio; presets pin the A/B-measured "
                         "product one | keys (spec §4, O(n²) mask — the "
                         "validation model)")
     p.add_argument("--backend", default=default_backend,
@@ -58,8 +61,8 @@ def _announce_default_delivery() -> str:
     from byzantinerandomizedconsensus_tpu.config import PRODUCT_DELIVERY
 
     print(f"[cli] --delivery not given: using the product scheduling model "
-          f"'{PRODUCT_DELIVERY}' (pass --delivery keys|urn|urn2 to pin)",
-          file=sys.stderr)
+          f"'{PRODUCT_DELIVERY}' (pass --delivery {'|'.join(DELIVERY_KINDS)} "
+          "to pin)", file=sys.stderr)
     return PRODUCT_DELIVERY
 
 
@@ -222,7 +225,7 @@ def main(argv=None) -> int:
     p_sw.add_argument("--seed", type=int, default=0)
     p_sw.add_argument("--round-cap", type=int, default=None)
     p_sw.add_argument("--coin", choices=["local", "shared"], default="shared")
-    p_sw.add_argument("--delivery", choices=["keys", "urn", "urn2"], default=None)
+    p_sw.add_argument("--delivery", choices=list(DELIVERY_KINDS), default=None)
     p_sw.add_argument("--plot", default=None, metavar="FILE",
                       help="render the round-distribution figure (png/svg)")
     p_sw.set_defaults(fn=cmd_sweep)

@@ -11,7 +11,17 @@ Protocol = Literal["benor", "bracha"]
 AdversaryKind = Literal["none", "crash", "byzantine", "adaptive", "adaptive_min"]
 CoinKind = Literal["local", "shared"]
 InitKind = Literal["random", "all0", "all1", "split"]
-DeliveryKind = Literal["keys", "urn", "urn2"]
+DeliveryKind = Literal["keys", "urn", "urn2", "urn3"]
+
+# The delivery registry: every scheduling model a SimConfig may name, in spec
+# order. COUNT_LEVEL_DELIVERIES are the §4b-family samplers (no O(n²) mask
+# object; class-granular adversary structure); "keys" is the spec-§4 mask
+# model. validate(), the CLI choices, the native-backend enum and the round
+# bodies' counts dispatch all derive from these two tuples, so adding a
+# delivery model is a one-line registration here plus its sampler
+# implementations (ops/, core/network.py, native/simcore.cpp).
+COUNT_LEVEL_DELIVERIES = ("urn", "urn2", "urn3")
+DELIVERY_KINDS = ("keys",) + COUNT_LEVEL_DELIVERIES
 
 # Single source for the default round cap. checkpoint.shard_name encodes only
 # NON-default caps (legacy shard names imply this value), so every site that
@@ -50,12 +60,14 @@ class SimConfig:
     crash_window: int = 4
     init: InitKind = "random"
     # Scheduling model. The count-level samplers "urn" (spec §4b, sequential
-    # draws) and "urn2" (spec §4b-v2, direct count inversion) are the
-    # TPU-native models; the benchmark presets pin whichever the measured A/B
-    # made the product path (docs/PERF.md round 5). "keys" (spec §4, the
-    # O(n²) permutation-key mask) is the validation model: an independent
-    # exact sampler of the same delivery-distribution family, kept as the
-    # SimConfig default for ad-hoc spec-§4 work and cross-model checks.
+    # draws), "urn2" (spec §4b-v2, direct count inversion) and "urn3"
+    # (spec §4c, mode-anchored cheap law — a *different distribution*, not a
+    # third exact sampler of the §4b family) are the TPU-native models; the
+    # benchmark presets pin whichever the measured A/B made the product path
+    # (docs/PERF.md rounds 5-6). "keys" (spec §4, the O(n²) permutation-key
+    # mask) is the validation model: an independent exact sampler of the same
+    # delivery-distribution family as §4b/§4b-v2, kept as the SimConfig
+    # default for ad-hoc spec-§4 work and cross-model checks.
     delivery: DeliveryKind = "keys"
 
     @property
@@ -65,9 +77,9 @@ class SimConfig:
     @property
     def count_level(self) -> bool:
         """True for the count-domain delivery models (§4b "urn", §4b-v2
-        "urn2"): no O(n²) mask object exists, adversary structure is class-
-        granular, and memory is O(B·n)."""
-        return self.delivery in ("urn", "urn2")
+        "urn2", §4c "urn3"): no O(n²) mask object exists, adversary structure
+        is class-granular, and memory is O(B·n)."""
+        return self.delivery in COUNT_LEVEL_DELIVERIES
 
     @property
     def lying_adversary(self) -> bool:
@@ -75,9 +87,10 @@ class SimConfig:
         return self.adversary in ("byzantine", "adaptive", "adaptive_min")
 
     def validate(self) -> "SimConfig":
-        if self.delivery not in ("keys", "urn", "urn2"):
+        if self.delivery not in DELIVERY_KINDS:
             raise ValueError(
-                f"unknown delivery {self.delivery!r}; use 'keys', 'urn' or 'urn2'")
+                f"unknown delivery {self.delivery!r}; "
+                f"use one of {'|'.join(DELIVERY_KINDS)}")
         if not (0 < self.n <= prf.MAX_N):
             raise ValueError(f"n={self.n} out of range (1..{prf.MAX_N})")
         if not (0 <= self.f < self.n):
@@ -109,10 +122,13 @@ def _f_opt(n: int) -> int:
 
 # The product scheduling model: what every preset, sweep_point, bench.py and
 # ad-hoc CLI run defaults to. Decided by the measured device-busy A/B between
-# the two count-level samplers (docs/PERF.md round 5: urn2 0.160 s device /
-# urn 0.276 s at config 4, 1.72x, walls 430.2k vs 283.5k inst/s —
-# artifacts/ab_delivery_r5.json); flipping it re-goldens every preset-level
-# artifact, so it changes only with an A/B writeup.
+# the count-level samplers (docs/PERF.md round 5: urn2 0.1602 s device /
+# urn 0.2759 s at config 4, 1.72x; the committed artifacts/ab_delivery_r5.json
+# records walls of 387.0k vs 259.4k inst/s in its — noisier — capture window,
+# the 430k wall headline is PERF.md's best session); flipping it re-goldens
+# every preset-level artifact, so it changes only with an A/B writeup.
+# Round 6 A/B'd §4c "urn3" against it (artifacts/ab_delivery_r6.json;
+# docs/PERF.md round 6) — see the ship-or-bury verdict there.
 PRODUCT_DELIVERY = "urn2"
 
 # Benchmark presets (BASELINE.json:6-12; pinned in spec/PROTOCOL.md §7).

@@ -170,3 +170,69 @@ class Network:
             c0[v] = m[0] - d[0] + (1 if own == 0 else 0)
             c1[v] = m[1] - d[1] + (1 if own == 1 else 0)
         return c0, c1
+
+    def urn3_counts(self, rnd: int, t: int, vals_by_class, silent: np.ndarray,
+                    strata: str = "none", minority: int = 0):
+        """Per-receiver delivered counts (c0, c1) via the §4c cheap law.
+
+        Same class/stratum semantics as :meth:`urn_counts`, same deterministic
+        stratum split as :meth:`urn2_counts` — but the within-stratum class
+        split is the spec §4c mode-anchored bounded-correction law, not a
+        hypergeometric: d = clamp(round(Dr·m/Lr) + (popcount(nibble) − 2),
+        HG support), one PRF word per receiver-step, segment ``g`` owning
+        nibble bits [8g, 8g+4). Scalar python-int implementation, independent
+        of ops/urn3.py.
+        """
+        n, f = self.cfg.n, self.cfg.f
+        half = (n + 1) // 2
+        k = n - f - 1
+        c0 = np.empty(n, dtype=np.int32)
+        c1 = np.empty(n, dtype=np.int32)
+        for v in range(n):
+            h = 0 if v < half else 1
+            vals = vals_by_class[h]
+            m = [0, 0, 0]
+            for u in range(n):
+                if u != v and not silent[u]:
+                    m[int(vals[u])] += 1
+            L = sum(m)
+            D = max(0, L - k)
+            if strata == "class":
+                st = [h != 0, h != 1, True]
+            elif strata == "minority":
+                st = [minority != 0, minority != 1, True]
+            else:
+                st = [False, False, False]
+            word = int(prf.prf_u32(self.seed, self.instance, rnd, t,
+                                   np.uint32(v), 0, prf.URN3, xp=np))
+
+            def cheap(seg: int, mm: int, Lr: int, Dr: int) -> int:
+                nib = (word >> (8 * seg)) & 0xF
+                corr = bin(nib).count("1") - 2
+                den = max(Lr, 1)
+                base = (2 * Dr * mm + den) // (2 * den)
+                lo = max(0, Dr - (Lr - mm))
+                hi = min(mm, Dr)
+                return min(max(base + corr, lo), hi)
+
+            d = [0, 0]
+            mb = [m[w] if st[w] else 0 for w in range(3)]
+            Lb = sum(mb)
+            Db = min(D, Lb)
+            Lr, Dr = Lb, Db
+            for w in (0, 1):                 # segments 0-1: biased stratum
+                dw = cheap(w, mb[w], Lr, Dr)
+                d[w] += dw
+                Lr -= mb[w]
+                Dr -= dw
+            Lr, Dr = L - Lb, D - Db
+            for w in (0, 1):                 # segments 2-3: unbiased stratum
+                mu = m[w] - mb[w]
+                dw = cheap(2 + w, mu, Lr, Dr)
+                d[w] += dw
+                Lr -= mu
+                Dr -= dw
+            own = int(vals[v])
+            c0[v] = m[0] - d[0] + (1 if own == 0 else 0)
+            c1[v] = m[1] - d[1] + (1 if own == 1 else 0)
+        return c0, c1

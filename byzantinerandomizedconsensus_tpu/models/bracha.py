@@ -15,7 +15,7 @@ from __future__ import annotations
 import numpy as np
 
 from byzantinerandomizedconsensus_tpu.models import coins, validation
-from byzantinerandomizedconsensus_tpu.ops import masks, tally, urn, urn2
+from byzantinerandomizedconsensus_tpu.ops import delivery_counts_fn, masks, tally
 
 
 def _step_counts(cfg, seed, inst_ids, rnd, t, values, silent, bias, xp, recv_ids=None):
@@ -46,9 +46,9 @@ def round_body(cfg, seed, inst_ids, rnd, state, adv, setup, xp=np,
             return counts_fn(cfg, seed, inst_ids, rnd, t, v, s,
                              setup["faulty"], honest, recv_ids=recv_ids)
         if cfg.count_level:
-            mod = urn if cfg.delivery == "urn" else urn2
-            return mod.counts_fn(cfg, seed, inst_ids, rnd, t, v, s,
-                                 setup["faulty"], honest, recv_ids=recv_ids, xp=xp)
+            return delivery_counts_fn(cfg.delivery)(
+                cfg, seed, inst_ids, rnd, t, v, s,
+                setup["faulty"], honest, recv_ids=recv_ids, xp=xp)
         return _step_counts(cfg, seed, inst_ids, rnd, t, v, s, b, xp, recv_ids)
 
     # Step 0 — broadcast est; majority of delivered (ties -> 1).

@@ -1,1 +1,22 @@
 """Kernels shared by all backends: PRF, scheduling masks, quorum tallies."""
+
+from __future__ import annotations
+
+
+def delivery_counts_fn(delivery: str):
+    """The vectorized count-level sampler for a delivery kind (the round
+    bodies' dispatch point — config.COUNT_LEVEL_DELIVERIES names the keys).
+    Lazy imports keep `ops` import-light for the PRF-only consumers."""
+    if delivery == "urn":
+        from byzantinerandomizedconsensus_tpu.ops import urn
+
+        return urn.counts_fn
+    if delivery == "urn2":
+        from byzantinerandomizedconsensus_tpu.ops import urn2
+
+        return urn2.counts_fn
+    if delivery == "urn3":
+        from byzantinerandomizedconsensus_tpu.ops import urn3
+
+        return urn3.counts_fn
+    raise KeyError(f"no count-level sampler for delivery {delivery!r}")

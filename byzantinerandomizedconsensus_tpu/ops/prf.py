@@ -31,6 +31,7 @@ BYZ_VALUE = 5
 SCHED = 6
 URN = 7
 URN2 = 8
+URN3 = 9
 
 # Urn-delivery LCG (spec §4b): full period mod 2^32 (A ≡ 1 mod 4, C odd).
 URN_LCG_A = 0x915F77F5

@@ -1,0 +1,104 @@
+"""Urn delivery v3 (spec/PROTOCOL.md §4c) — mode-anchored cheap delivery law.
+
+NOT a third exact sampler of the §4b hypergeometric family: §4c is a
+*distribution-level* replacement (VERDICT r5 next #1). The per-receiver
+per-class dropped count is sampled as the rounded hypergeometric mean plus a
+bounded integer correction — ``Binomial(4, 1/2) − 2``, one PRF nibble —
+clamped to the exact hypergeometric support. Cost is O(1) integer work per
+receiver-step (one Threefry word, ~20 elementwise ops, **no loop at all**),
+versus §4b-v2's ``K = min(m, L−m, D)`` conditional-Bernoulli chain, which
+round-1 near-balanced steps pay at the full ``K = D`` (docs/NEXT.md item -1:
+~74% of config-4 device time).
+
+The support clamp preserves every §5 count guarantee (``c_w ≥ m_w − f``,
+``c_w ≤ m_w + [own]``, ``Σ c_w = min(L, k) + 1``) and makes the law collapse
+to the *exact* law wherever the exact law is deterministic — homogeneous
+strata (binary-alphabet adaptive steps, unanimous wires) have ``lo = hi``, so
+the §4b delivery-robust regime carries over bit-for-bit. Where the exact law
+is genuinely random (balanced wires), §4c concentrates: correction std ≈ 1 vs
+the hypergeometric's up-to-√D/2. tools/divergence.py quantifies the outcome
+deviation; the ship-or-bury A/B is tools/ab_delivery.py (docs/PERF.md r6).
+
+Generic over the array namespace (numpy / jax.numpy — identical branchless
+code path, nothing to unroll); the CPU oracle implements the same spec
+independently in core/network.py::Network.urn3_counts. All arithmetic is
+int32/uint32 with wraparound, so numpy, XLA and C++ agree bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu.ops import prf, urn
+
+
+def _cheap(u, seg, m, Lr, Dr, xp):
+    """One §4c segment: d = clamp(round(Dr·m/Lr) + (popcount(nibble) − 2),
+    HG support). ``u`` is the (B, R) uint32 per-receiver-step PRF word;
+    segment ``seg`` owns bits [8·seg, 8·seg+4). ``m``/``Lr``/``Dr`` are
+    (B, R) int32 (non-negative, Dr ≤ Lr). Returns (B, R) int32."""
+    u32, i32 = xp.uint32, xp.int32
+    nib = (u >> u32(8 * seg)) & u32(0xF)
+    pop = ((nib & u32(1)) + ((nib >> u32(1)) & u32(1))
+           + ((nib >> u32(2)) & u32(1)) + ((nib >> u32(3)) & u32(1)))
+    corr = pop.astype(i32) - i32(2)                      # Binomial(4,1/2) − 2
+    den = xp.maximum(Lr, i32(1))                         # Lr = 0 ⇒ m = Dr = 0
+    base = (i32(2) * Dr * m + den) // (i32(2) * den)     # round-half-up mean
+    lo = xp.maximum(Dr - (Lr - m), i32(0))               # HG support bounds
+    hi = xp.minimum(m, Dr)
+    return xp.clip(base + corr, lo, hi).astype(i32)
+
+
+def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
+              recv_ids=None, xp=np):
+    """(c0, c1) delivered-value counts per receiver lane — spec §4c.
+
+    Same hook signature and same class/stratum state (ops/urn.py::lane_setup)
+    as the §4b/§4b-v2 samplers; only the drop law differs (and is cheaper by
+    construction, not by inversion).
+    """
+    i32 = xp.int32
+    recv, own_val, m, st, L, D = urn.lane_setup(
+        cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
+        recv_ids=recv_ids, xp=xp)
+    adaptive = cfg.adversary in ("adaptive", "adaptive_min")
+
+    inst = xp.asarray(inst_ids, dtype=xp.uint32)[:, None]
+    # One PRF word per (instance, round, step, receiver); (B, 1) x (1, R)
+    # broadcast yields the (B, R) lane plane directly.
+    u = prf.prf_u32(seed, inst, rnd, t, recv[None, :], 0, prf.URN3, xp=xp)
+
+    d = [None, None]  # total drops attributed to tracked values 0, 1
+    if adaptive:
+        # Stratum split (deterministic, exactly §4b-v2): biased absorbs
+        # min(D, L_b) drops. Segments 0-1 = biased, 2-3 = unbiased.
+        z = xp.zeros((1, 1), dtype=i32)
+        mb = [xp.where(st[w], m[w], z).astype(i32) for w in (0, 1, 2)]
+        Lb = (mb[0] + mb[1] + mb[2]).astype(i32)
+        Db = xp.minimum(D, Lb).astype(i32)
+        Lr, Dr = Lb, Db
+        for w in (0, 1):
+            d[w] = _cheap(u, w, mb[w], Lr, Dr, xp)
+            Lr = (Lr - mb[w]).astype(i32)
+            Dr = (Dr - d[w]).astype(i32)
+        mu = [(m[w] - mb[w]).astype(i32) for w in (0, 1)]
+        Lr = (L - Lb).astype(i32)
+        Dr = (D - Db).astype(i32)
+        for w in (0, 1):
+            du = _cheap(u, 2 + w, mu[w], Lr, Dr, xp)
+            d[w] = (d[w] + du).astype(i32)
+            Lr = (Lr - mu[w]).astype(i32)
+            Dr = (Dr - du).astype(i32)
+    else:
+        # Biased stratum statically empty: segments 0-1 are skipped; segment
+        # indices (hence nibbles) 2-3 are used, matching the §4b-v2 seeding
+        # convention so the two strata families stay aligned.
+        Lr, Dr = L, D
+        for w in (0, 1):
+            d[w] = _cheap(u, 2 + w, m[w], Lr, Dr, xp)
+            Lr = (Lr - m[w]).astype(i32)
+            Dr = (Dr - d[w]).astype(i32)
+
+    c0 = (m[0] - d[0] + (own_val == 0).astype(i32)).astype(i32)
+    c1 = (m[1] - d[1] + (own_val == 1).astype(i32)).astype(i32)
+    return c0, c1

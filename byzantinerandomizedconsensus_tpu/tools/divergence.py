@@ -29,8 +29,18 @@ divergences; pinned as regression tests in tests/test_divergence.py):
   ⊥-bearing step's jitter is confined to the biased stratum's drop split,
   which the adversary's own dynamics keep clear of the adopt/decide margins.
 
+Round 6 adds the spec §4c pairs (keys↔urn3, urn2↔urn3) and a
+``rounds_hist_tv`` total-variation distance per pair: §4c is a *different
+delivery distribution* (mode-anchored cheap law), so unlike the three
+§4b-family samplers its distribution-level gaps are real and bounded rather
+than zero-in-the-limit — the robust-regime rows must still be per-instance
+identical (homogeneous strata are law-independent), and the ``--presets``
+rows quantify the §4c-vs-§4b-v2 deviation at the five benchmark shapes for
+the ship-or-bury decision (docs/PERF.md round 6).
+
 CLI: ``python -m byzantinerandomizedconsensus_tpu.tools.divergence``
-(``--full`` adds the large-n config-5-family rows on an accelerated backend).
+(``--full`` adds the large-n config-5-family rows on an accelerated backend;
+``--presets`` adds the five-preset §4c deviation rows).
 """
 
 from __future__ import annotations
@@ -95,8 +105,28 @@ FULL_GRID: tuple[tuple[SimConfig, str], ...] = (
 
 # Pairwise sampler comparisons. The bare suffix is the original keys↔urn map
 # (field names unchanged since r4); each later pair gets an explicit suffix.
+# The urn3 pairs (round 6) compare ACROSS distribution families — spec §4c is
+# a different law, so their distribution-level gaps are expected to be real
+# (bounded, measured), not sampler noise; the robust-regime rows must still
+# be identical (the homogeneous-strata mechanism is law-independent).
 PAIRS = (("keys", "urn", ""), ("keys", "urn2", "_keys_urn2"),
-         ("urn", "urn2", "_urn_urn2"))
+         ("urn", "urn2", "_urn_urn2"), ("keys", "urn3", "_keys_urn3"),
+         ("urn2", "urn3", "_urn2_urn3"))
+
+DELIVERIES = ("keys", "urn", "urn2", "urn3")
+
+
+def rounds_hist_tv(ra, rb) -> float:
+    """Total-variation distance between two rounds-to-decision histograms
+    (0 = identical distribution, 1 = disjoint). The distribution-level
+    deviation measure the §4c ship-or-bury decision keys on, next to the
+    per-instance disagreement fraction."""
+    import numpy as np
+
+    hi = int(max(ra.max(initial=0), rb.max(initial=0))) + 1
+    pa = np.bincount(ra, minlength=hi) / max(1, len(ra))
+    pb = np.bincount(rb, minlength=hi) / max(1, len(rb))
+    return float(0.5 * np.abs(pa - pb).sum())
 
 
 def compare_row(cfg: SimConfig, instances: int, backend: str) -> dict:
@@ -107,7 +137,7 @@ def compare_row(cfg: SimConfig, instances: int, backend: str) -> dict:
     verbatim" claim of spec §4b-v2, measured)."""
     cfg = dataclasses.replace(cfg, instances=instances).validate()
     res = {}
-    for delivery in ("keys", "urn", "urn2"):
+    for delivery in DELIVERIES:
         c = dataclasses.replace(cfg, delivery=delivery)
         res[delivery] = Simulator(c, backend).run()
 
@@ -122,6 +152,7 @@ def compare_row(cfg: SimConfig, instances: int, backend: str) -> dict:
             (ra.rounds != rb.rounds).mean())
         row[f"frac_decision_differ{suffix}"] = float(
             (ra.decision != rb.decision).mean())
+        row[f"rounds_hist_tv{suffix}"] = rounds_hist_tv(ra.rounds, rb.rounds)
     for name, r in res.items():
         row[f"mean_rounds_{name}"] = float(r.rounds.mean())
         row[f"p1_{name}"] = float((r.decision == 1).mean())
@@ -129,9 +160,53 @@ def compare_row(cfg: SimConfig, instances: int, backend: str) -> dict:
     return row
 
 
+def preset_row(name: str, cfg: SimConfig, instances: int, backend: str) -> dict:
+    """§4c-vs-§4b-v2 deviation at one benchmark preset shape (the ship-or-bury
+    evidence row): per-instance disagreement + rounds-histogram TV distance,
+    urn2 vs urn3 only (keys at benchmark n is the O(n²) path and the §4b pair
+    is already mapped by the grid rows)."""
+    cfg = dataclasses.replace(cfg, instances=instances).validate()
+    res = {d: Simulator(dataclasses.replace(cfg, delivery=d), backend).run()
+           for d in ("urn2", "urn3")}
+    ra, rb = res["urn2"], res["urn3"]
+    return {
+        "preset": name, "protocol": cfg.protocol, "n": cfg.n, "f": cfg.f,
+        "adversary": cfg.adversary, "coin": cfg.coin, "seed": cfg.seed,
+        "round_cap": cfg.round_cap, "instances": instances, "backend": backend,
+        "frac_rounds_differ_urn2_urn3": float((ra.rounds != rb.rounds).mean()),
+        "frac_decision_differ_urn2_urn3": float(
+            (ra.decision != rb.decision).mean()),
+        "rounds_hist_tv_urn2_urn3": rounds_hist_tv(ra.rounds, rb.rounds),
+        "mean_rounds_urn2": float(ra.rounds.mean()),
+        "mean_rounds_urn3": float(rb.rounds.mean()),
+        "p1_urn2": float((ra.decision == 1).mean()),
+        "p1_urn3": float((rb.decision == 1).mean()),
+        "capped_urn2": float((ra.decision == 2).mean()),
+        "capped_urn3": float((rb.decision == 2).mean()),
+    }
+
+
+def run_preset_rows(instances: int = 2000, backend: str = "native",
+                    progress=print) -> list:
+    """The five benchmark presets (config5 = its SWEEP_POINT_N stand-in),
+    §4c vs §4b-v2. Config 1 ships instances=1; all rows use the same sampled
+    ``instances`` id range (instance i depends only on (cfg, seed, i))."""
+    from byzantinerandomizedconsensus_tpu.config import (
+        PRESETS, SWEEP_POINT_N, sweep_point)
+
+    rows = []
+    shapes = {**PRESETS, "config5": sweep_point(SWEEP_POINT_N)}
+    for name, cfg in shapes.items():
+        rows.append(preset_row(name, cfg, instances, backend))
+        progress(json.dumps(rows[-1]))
+    return rows
+
+
 def run_divergence(instances: int = 400, backend: str = "numpy",
                    full: bool = False, full_backend: str = "jax",
-                   full_instances: int = 2000, progress=print) -> dict:
+                   full_instances: int = 2000, presets: bool = False,
+                   preset_instances: int = 2000, preset_backend: str = "native",
+                   progress=print) -> dict:
     rows = []
     for cfg, regime in GRID:
         row = compare_row(cfg, instances, backend)
@@ -156,16 +231,27 @@ def run_divergence(instances: int = 400, backend: str = "numpy",
             max(r[f"frac_rounds_differ{suffix}"] for r in rob)
         summary[f"max_abs_mean_rounds_gap_{a}_{b}"] = max(
             abs(r[f"mean_rounds_{a}"] - r[f"mean_rounds_{b}"]) for r in rows)
+        summary[f"max_rounds_hist_tv_{a}_{b}"] = max(
+            r[f"rounds_hist_tv{suffix}"] for r in rows)
     summary["max_abs_mean_rounds_gap"] = \
         summary["max_abs_mean_rounds_gap_keys_urn"]
-    return {"rows": rows, "summary": summary}
+    out = {"rows": rows, "summary": summary}
+    if presets:
+        prows = run_preset_rows(instances=preset_instances,
+                                backend=preset_backend, progress=progress)
+        out["preset_rows"] = prows
+        summary["preset_max_rounds_hist_tv_urn2_urn3"] = max(
+            r["rounds_hist_tv_urn2_urn3"] for r in prows)
+        summary["preset_max_abs_mean_rounds_gap_urn2_urn3"] = max(
+            abs(r["mean_rounds_urn2"] - r["mean_rounds_urn3"]) for r in prows)
+    return out
 
 
 def main(argv=None) -> int:
     from byzantinerandomizedconsensus_tpu.utils.rounds import default_artifact
 
     ap = argparse.ArgumentParser(
-        description="cross-model (keys/urn/urn2) divergence map")
+        description="cross-model (keys/urn/urn2/urn3) divergence map")
     ap.add_argument("--out", default=default_artifact("divergence"))
     ap.add_argument("--instances", type=int, default=400)
     ap.add_argument("--backend", default="numpy")
@@ -173,6 +259,11 @@ def main(argv=None) -> int:
                     help="add large-n config-5-family rows (accelerated backend)")
     ap.add_argument("--full-backend", default="jax")
     ap.add_argument("--full-instances", type=int, default=2000)
+    ap.add_argument("--presets", action="store_true",
+                    help="add the five-preset §4c-vs-§4b-v2 deviation rows "
+                         "(per-instance disagreement + rounds-histogram TV)")
+    ap.add_argument("--preset-instances", type=int, default=2000)
+    ap.add_argument("--preset-backend", default="native")
     args = ap.parse_args(argv)
 
     if args.full:
@@ -181,7 +272,10 @@ def main(argv=None) -> int:
         ensure_live_backend()
     result = run_divergence(instances=args.instances, backend=args.backend,
                             full=args.full, full_backend=args.full_backend,
-                            full_instances=args.full_instances)
+                            full_instances=args.full_instances,
+                            presets=args.presets,
+                            preset_instances=args.preset_instances,
+                            preset_backend=args.preset_backend)
     out = pathlib.Path(args.out)
     out.parent.mkdir(parents=True, exist_ok=True)
     out.write_text(json.dumps(result, indent=1, sort_keys=True) + "\n")

@@ -57,7 +57,11 @@ def run_config(cfg, backend: str, timed_repeats: int = DEFAULT_REPEATS):
         walls_spread=round(spread(walls), 3),
         instances_per_sec=round(cfg.instances / best, 1),
     )
-    if "device_busy_s" in dev:
+    if "device_busy_suspect" in dev:
+        # A 0.0 that is absence-of-signal (no device pids / op-naming drift,
+        # utils/timing.parse_trace) is an error entry, not a measurement.
+        s["device_busy_error"] = dev["device_busy_suspect"]
+    elif "device_busy_s" in dev:
         s["device_busy_s"] = dev["device_busy_s"]
     else:
         # A failed capture must surface in the artifact (it explains a later

@@ -74,7 +74,9 @@ def _warn_stale_shards(out_dir: pathlib.Path, delivery: str, round_cap: int,
         return
     stale = []
     for p in out_dir.glob("*.npz"):
-        if "_urn2_" in p.name:
+        if "_urn3_" in p.name:
+            named_delivery = "urn3"
+        elif "_urn2_" in p.name:
             named_delivery = "urn2"
         elif "_urn_" in p.name:
             named_delivery = "urn"

@@ -106,18 +106,44 @@ def parse_trace(trace_dir, before: dict | None = None) -> dict:
                 and "TPU" in str(e.get("args", {}).get("name", ""))}
     per_op = collections.Counter()
     busy = 0.0
+    n_dev_events = 0
     for e in ev:
         if e.get("ph") == "X" and e.get("pid") in dev_pids:
+            n_dev_events += 1
             name = e.get("name", "?")
             per_op[name] += e.get("dur", 0)
             if name.startswith("jit_"):
                 busy += e.get("dur", 0)
-    return {
+    out = {
         "source": str(paths[-1]),
         "device_busy_s": round(busy / 1e6, 4),
         "top_device_ops_s": {k: round(v / 1e6, 4)
                              for k, v in per_op.most_common(8)},
     }
+    if busy == 0.0:
+        # A zero here is absence of signal unless proven otherwise — the
+        # silent-0.0 failure VERDICT r5 weak #1 targeted. Distinguish the
+        # three ways the signal can be absent so the artifact says why, and
+        # so regression_verdict's >0 guard refuses the ratio.
+        if not dev_pids:
+            # CPU-only session, or a capture that missed the device.
+            out["device_busy_suspect"] = (
+                "no TPU device pids in trace (CPU-only session?) — "
+                "device_busy_s is NOT a measurement")
+        elif n_dev_events:
+            # Device events exist but none match the jit_ program-name
+            # convention: PJRT/plugin op-naming drift.
+            out["device_busy_suspect"] = (
+                f"{n_dev_events} device X events but 0 'jit_'-prefixed "
+                "matches — PJRT op-naming drift? device_busy_s is NOT a "
+                "measurement")
+        else:
+            # TPU pids registered but zero complete events: the dispatch
+            # fell outside the captured window.
+            out["device_busy_suspect"] = (
+                "TPU device pids present but zero X events — empty capture "
+                "window? device_busy_s is NOT a measurement")
+    return out
 
 
 def device_busy(be, cfg, trace_dir=None) -> dict:
@@ -154,6 +180,11 @@ def device_busy(be, cfg, trace_dir=None) -> dict:
                 jax.block_until_ready(be._dispatch_chunks(fn, ids, chunk, extra))
             out = parse_trace(tdir, before=before)
         out.pop("top_device_ops_s", None)  # bench/product records stay small
+        if not trace_dir:
+            # The TemporaryDirectory is gone by now — a 'source' path into it
+            # would be a dangling reference in the artifact (ADVICE r5 #3).
+            # Kept only when the caller supplied a persistent trace_dir.
+            out.pop("source", None)
         return out
     except Exception as e:  # tunnel profilers can be unsupported
         return {"error": repr(e)}

@@ -61,6 +61,7 @@ enum Purpose : uint32_t {
   kSched = 6,
   kUrn = 7,
   kUrn2 = 8,
+  kUrn3 = 9,
 };
 
 constexpr uint32_t kCoinStep = 3;
@@ -94,7 +95,8 @@ enum AdversaryKind { kNone = 0, kCrash = 1, kByzantine = 2, kAdaptive = 3,
                      kAdaptiveMin = 4 };
 enum CoinKind { kLocal = 0, kShared = 1 };
 enum InitKind { kRandom = 0, kAll0 = 1, kAll1 = 2, kSplit = 3 };
-enum DeliveryKind { kKeys = 0, kUrnDelivery = 1, kUrn2Delivery = 2 };
+enum DeliveryKind { kKeys = 0, kUrnDelivery = 1, kUrn2Delivery = 2,
+                    kUrn3Delivery = 3 };
 
 struct Cfg {
   int protocol;
@@ -117,7 +119,8 @@ inline bool lying_adversary(const Cfg& c) {
 // Count-level delivery models (spec §4b / §4b-v2): class-granular adversary
 // structure, no per-receiver matrices.
 inline bool count_level(const Cfg& c) {
-  return c.delivery == kUrnDelivery || c.delivery == kUrn2Delivery;
+  return c.delivery == kUrnDelivery || c.delivery == kUrn2Delivery ||
+         c.delivery == kUrn3Delivery;
 }
 
 // ------------------------------------------------------------ per-thread state
@@ -492,6 +495,73 @@ void urn2_deliver_and_tally(const Cfg& cfg, Key k, uint32_t inst, uint32_t rnd,
   }
 }
 
+// -------------------------------- urn-v3 delivery + tallies (spec §4c)
+
+// Mode-anchored cheap drop law: d = clamp(round(Dr·m/Lr) + (popcount(nibble)
+// − 2), HG support). One PRF word per receiver-step; segment g owns nibble
+// bits [8g, 8g+4). O(1) integer work per receiver-step, no loop. NOT an
+// exact sampler of the §4b family — a deliberate distribution-level change
+// (spec §4c); the support clamp keeps every §5 count guarantee and collapses
+// to the exact law on homogeneous strata. Mirrors ops/urn3.py
+// segment-for-segment; same class/stratum state as the §4b/§4b-v2 legs.
+inline int cheap_drop(uint32_t word, uint32_t seg, int m, int Lr, int Dr) {
+  const uint32_t nib = (word >> (8 * seg)) & 0xFu;
+  const int corr = int((nib & 1u) + ((nib >> 1) & 1u) + ((nib >> 2) & 1u) +
+                       ((nib >> 3) & 1u)) - 2;
+  const int den = std::max(Lr, 1);
+  const int base = (2 * Dr * m + den) / (2 * den);
+  const int lo = std::max(0, Dr - (Lr - m));
+  const int hi = std::min(m, Dr);
+  return std::min(std::max(base + corr, lo), hi);
+}
+
+void urn3_deliver_and_tally(const Cfg& cfg, Key k, uint32_t inst, uint32_t rnd,
+                            uint32_t t, Scratch& s) {
+  const int n = cfg.n, f = cfg.f;
+  const int half = (n + 1) / 2;
+  const int quota = n - f - 1;
+  const bool adaptive = cfg.adversary == kAdaptive;
+  const bool adaptive_min = cfg.adversary == kAdaptiveMin;
+  const uint8_t minority = adaptive_min ? observed_minority(s, n) : 0;
+  for (int v = 0; v < n; ++v) {
+    const int h = (v >= half) ? 1 : 0;
+    const uint8_t* vals =
+        s.two_faced ? (h ? s.vclass1.data() : s.vclass0.data()) : s.values.data();
+    int m[3] = {0, 0, 0};
+    for (int j = 0; j < n; ++j)
+      if (j != v && !s.silent[j]) ++m[vals[j]];
+    const int L = m[0] + m[1] + m[2];
+    const int D = std::max(0, L - quota);
+    const bool st[3] = {(adaptive && h != 0) || (adaptive_min && minority != 0),
+                        (adaptive && h != 1) || (adaptive_min && minority != 1),
+                        adaptive || adaptive_min};
+    const int mb[3] = {st[0] ? m[0] : 0, st[1] ? m[1] : 0, st[2] ? m[2] : 0};
+    const int Lb = mb[0] + mb[1] + mb[2];
+    const int Db = std::min(D, Lb);
+    const uint32_t word = prf_u32(k, inst, rnd, t, uint32_t(v), 0, kUrn3);
+    int d[2] = {0, 0};
+    int Lr = Lb, Dr = Db;
+    for (int w = 0; w < 2; ++w) {  // segments 0-1: biased stratum
+      const int dw = cheap_drop(word, uint32_t(w), mb[w], Lr, Dr);
+      d[w] += dw;
+      Lr -= mb[w];
+      Dr -= dw;
+    }
+    Lr = L - Lb;
+    Dr = D - Db;
+    for (int w = 0; w < 2; ++w) {  // segments 2-3: unbiased stratum
+      const int mu = m[w] - mb[w];
+      const int dw = cheap_drop(word, uint32_t(2 + w), mu, Lr, Dr);
+      d[w] += dw;
+      Lr -= mu;
+      Dr -= dw;
+    }
+    const uint8_t own = vals[v];
+    s.c0[v] = m[0] - d[0] + (own == 0 ? 1 : 0);
+    s.c1[v] = m[1] - d[1] + (own == 1 ? 1 : 0);
+  }
+}
+
 // ----------------------------------------------- protocol round (spec §5)
 
 // One full round for one instance; updates Scratch state in place.
@@ -522,6 +592,8 @@ void run_round(const Cfg& cfg, Key k, uint32_t inst, uint32_t rnd, Scratch& s) {
       urn_deliver_and_tally(cfg, k, inst, rnd, uint32_t(t), s);
     else if (cfg.delivery == kUrn2Delivery)
       urn2_deliver_and_tally(cfg, k, inst, rnd, uint32_t(t), s);
+    else if (cfg.delivery == kUrn3Delivery)
+      urn3_deliver_and_tally(cfg, k, inst, rnd, uint32_t(t), s);
     else
       deliver_and_tally(cfg, k, inst, rnd, uint32_t(t), s);
 
@@ -650,6 +722,7 @@ void sim_run(int protocol, int n, int f, int adversary, int coin, int init,
 }
 
 // ABI version stamp so the Python loader can detect stale cached builds.
-int sim_abi_version() { return 3; }
+// v4: delivery enum grew kUrn3Delivery (spec §4c).
+int sim_abi_version() { return 4; }
 
 }  // extern "C"

@@ -98,10 +98,9 @@ def _round_transitions(state):
     return out
 
 
-@lru_cache(maxsize=1)
-def expected_rounds_by_state():
-    """Solve E[rounds | state] for every reachable state exactly."""
-    # Reachable exploration from all 16 initial estimate vectors.
+def _solve_chain(round_transitions):
+    """Solve E[rounds | state] for every state reachable from the 16 initial
+    estimate vectors, under the given one-round transition function."""
     initial = [tuple(sorted((e, False) for e in bits))
                for bits in itertools.product((0, 1), repeat=N)]
     todo = list(dict.fromkeys(initial))
@@ -110,7 +109,7 @@ def expected_rounds_by_state():
         s = todo.pop()
         if s in trans or all(d for _, d in s):
             continue
-        trans[s] = _round_transitions(s)
+        trans[s] = round_transitions(s)
         for ns in trans[s]:
             if ns not in trans and not all(d for _, d in ns):
                 todo.append(ns)
@@ -128,6 +127,13 @@ def expected_rounds_by_state():
 
 
 @lru_cache(maxsize=1)
+def expected_rounds_by_state():
+    """Solve E[rounds | state] exactly (uniform single-drop delivery — the
+    law §4, §4b and §4b-v2 all realize at n=4, f=1 with no silent senders)."""
+    return _solve_chain(_round_transitions)
+
+
+@lru_cache(maxsize=1)
 def expected_rounds_benor_n4() -> float:
     """E[rounds to all-decided], initial estimates uniform on {0,1}^4."""
     E = expected_rounds_by_state()
@@ -138,9 +144,164 @@ def expected_rounds_benor_n4() -> float:
     return total / 2 ** N
 
 
+# ---------------------------------------------------------------------------
+# Spec §4c ("urn3") anchor — same chain skeleton, different delivery law.
+#
+# §4c is NOT an exact sampler of the uniform-drop family above: its
+# per-receiver drop is the mode-anchored bounded-correction law. At n=4, f=1
+# with no silent senders the whole law reduces to ONE dropped value class per
+# receiver-step (L=3, D=1), whose pmf is exactly computable by enumerating
+# the two correction nibbles (segments 2 and 3; Binomial(4,1/2)−2 each, 16
+# equally likely nibble values ⇒ all probabilities are multiples of 1/256).
+# The §8d constant pins this law end-to-end through the Protocol-A round
+# body, the way §8a pins the exact-family models.
+
+# Binomial(4, 1/2) − 2 weights for the §4c correction, j = −2 … +2.
+_URN3_CORR = tuple(zip(range(-2, 3), (1, 4, 6, 4, 1)))
+
+
+def urn3_cheap_d(m: int, Lr: int, Dr: int, j: int) -> int:
+    """One §4c segment evaluated at correction j (spec §4c): clamp(base + j,
+    HG support). Mirrors ops/urn3.py::_cheap with the nibble popcount
+    replaced by its value — the enumeration form."""
+    den = max(Lr, 1)
+    base = (2 * Dr * m + den) // (2 * den)
+    lo = max(0, Dr - (Lr - m))
+    hi = min(m, Dr)
+    return min(max(base + j, lo), hi)
+
+
+def urn3_segment_pmf(m: int, Lr: int, Dr: int) -> dict:
+    """Exact pmf {d: probability} of one §4c segment (16 equally likely
+    nibbles grouped through the popcount weights). The chain-level law test
+    (tests/test_urn3.py) asserts the sampler against this closed form."""
+    out: dict = {}
+    for j, w in _URN3_CORR:
+        d = urn3_cheap_d(m, Lr, Dr, j)
+        out[d] = out.get(d, 0.0) + w / 16.0
+    return out
+
+
+@lru_cache(maxsize=None)
+def urn3_drop_pmf(m0: int, m1: int, m2: int):
+    """Exact dropped-class pmf {w: p} of the §4c law at L=3, D=1 (the n=4,
+    f=1, no-silent shape): segment 2 samples d0 from (m0, 3, 1); on d0=0
+    segment 3 samples d1 from (m1, 3−m0, 1); the remainder drops ⊥. The two
+    corrections come from disjoint nibbles of one PRF word ⇒ independent."""
+    assert m0 + m1 + m2 == 3
+    pmf = {0: 0.0, 1: 0.0, 2: 0.0}
+    for j2, w2 in _URN3_CORR:
+        d0 = urn3_cheap_d(m0, 3, 1, j2)
+        if d0 == 1:
+            pmf[0] += w2 / 16.0
+            continue
+        for j3, w3 in _URN3_CORR:
+            d1 = urn3_cheap_d(m1, 3 - m0, 1, j3)
+            pmf[1 if d1 == 1 else 2] += (w2 / 16.0) * (w3 / 16.0)
+    return pmf
+
+
+def _urn3_receiver_pmfs(vals):
+    """Per-receiver dropped-class pmf under §4c for one step's wire values."""
+    out = []
+    for i in range(N):
+        m = [0, 0, 0]
+        for k in range(N):
+            if k != i:
+                m[vals[k]] += 1
+        out.append(urn3_drop_pmf(*m))
+    return out
+
+
+def _support(pmf):
+    return [(w, p) for w, p in pmf.items() if p > 0.0]
+
+
+def _round_transitions_urn3(state):
+    """{next_state: probability} for one §4c round from ``state``. Unlike
+    the uniform-drop chain (which enumerates dropped *senders*), §4c drops
+    resolve only to value classes, so the enumeration is over per-receiver
+    dropped classes weighted by the exact §4c pmf."""
+    ests = [e for e, _ in state]
+    decided = [d for _, d in state]
+    t0_1 = sum(ests)        # step-0 wire totals, own message included
+    t0_0 = N - t0_1
+    out: dict = {}
+    pmfs0 = _urn3_receiver_pmfs(ests)
+    for drops0 in itertools.product(*[_support(p) for p in pmfs0]):
+        p0 = 1.0
+        props = []
+        for i in range(N):
+            w, pw = drops0[i]
+            p0 *= pw
+            c1 = t0_1 - (1 if w == 1 else 0)
+            c0 = t0_0 - (1 if w == 0 else 0)
+            props.append(1 if 2 * c1 > N else (0 if 2 * c0 > N else 2))
+        t1_1 = sum(1 for x in props if x == 1)
+        t1_0 = sum(1 for x in props if x == 0)
+        pmfs1 = _urn3_receiver_pmfs(props)
+        for drops1 in itertools.product(*[_support(p) for p in pmfs1]):
+            p1 = p0
+            acts = []
+            for i in range(N):
+                w, pw = drops1[i]
+                p1 *= pw
+                c1 = t1_1 - (1 if w == 1 else 0)
+                c0 = t1_0 - (1 if w == 0 else 0)
+                sel = 1 if c1 >= c0 else 0
+                c = c1 if sel else c0
+                acts.append((sel, c >= 2, c >= 1))
+            coin_users = [i for i in range(N)
+                          if not decided[i] and not acts[i][1] and not acts[i][2]]
+            for coins in itertools.product((0, 1), repeat=len(coin_users)):
+                p = p1 * 0.5 ** len(coin_users)
+                nest, ndec = list(ests), list(decided)
+                ci = iter(coins)
+                for i in range(N):
+                    if decided[i]:
+                        continue
+                    sel, dec, adopt = acts[i]
+                    if dec:
+                        ndec[i] = True
+                        nest[i] = sel
+                    elif adopt:
+                        nest[i] = sel
+                    else:
+                        nest[i] = next(ci)
+                ns = tuple(sorted(zip(nest, ndec)))
+                out[ns] = out.get(ns, 0.0) + p
+    return out
+
+
+@lru_cache(maxsize=1)
+def expected_rounds_by_state_urn3():
+    """Solve E[rounds | state] exactly under the §4c delivery law."""
+    return _solve_chain(_round_transitions_urn3)
+
+
+@lru_cache(maxsize=1)
+def expected_rounds_benor_n4_urn3() -> float:
+    """E[rounds to all-decided] under §4c, initial estimates uniform on
+    {0,1}^4 — the spec §8d constant."""
+    E = expected_rounds_by_state_urn3()
+    total = 0.0
+    for bits in itertools.product((0, 1), repeat=N):
+        s = tuple(sorted((e, False) for e in bits))
+        total += E.get(s, 0.0)
+    return total / 2 ** N
+
+
 if __name__ == "__main__":
     E = expected_rounds_by_state()
     print(f"reachable undecided states: {len(E)}")
     for s, v in sorted(E.items(), key=lambda kv: kv[1]):
         print(f"  {s}: {v:.6f}")
     print(f"E[rounds] (uniform init) = {expected_rounds_benor_n4():.6f}")
+    E3 = expected_rounds_by_state_urn3()
+    print(f"§4c reachable undecided states: {len(E3)}")
+    uni3 = tuple(sorted((e, False) for e in (0, 0, 0, 0)))
+    split31 = tuple(sorted((e, False) for e in (0, 0, 0, 1)))
+    split22 = tuple(sorted((e, False) for e in (0, 0, 1, 1)))
+    print(f"§4c unanimous: {E3.get(uni3, 0.0):.6f}  3-1: {E3[split31]:.6f}  "
+          f"2-2: {E3[split22]:.6f}")
+    print(f"§4c E[rounds] (uniform init) = {expected_rounds_benor_n4_urn3():.6f}")

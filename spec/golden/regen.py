@@ -55,6 +55,26 @@ GOLDEN_CONFIGS = {
                                           instances=100, adversary="adaptive_min",
                                           coin="shared", round_cap=64, seed=12,
                                           delivery="urn2"),
+    # Cheap delivery law (spec §4c, added round 6) — one per adversary family,
+    # incl. the two-faced Ben-Or Byzantine pairing and both adaptive strata.
+    # §4c is a different delivery *distribution*, so these vectors pin the law
+    # itself, not agreement with the §4b family.
+    "urn3_benor_byz": SimConfig(protocol="benor", n=16, f=3, instances=100,
+                                adversary="byzantine", coin="local", round_cap=64,
+                                seed=13, delivery="urn3"),
+    "urn3_bracha_crash": SimConfig(protocol="bracha", n=10, f=3, instances=100,
+                                   adversary="crash", coin="shared", round_cap=64,
+                                   seed=14, delivery="urn3"),
+    "urn3_bracha_adaptive": SimConfig(protocol="bracha", n=13, f=4, instances=100,
+                                      adversary="adaptive", coin="shared",
+                                      round_cap=64, seed=15, delivery="urn3"),
+    "urn3_bracha_adaptive_min": SimConfig(protocol="bracha", n=13, f=4,
+                                          instances=100, adversary="adaptive_min",
+                                          coin="shared", round_cap=64, seed=16,
+                                          delivery="urn3"),
+    "urn3_benor_none": SimConfig(protocol="benor", n=4, f=1, instances=100,
+                                 adversary="none", coin="local", round_cap=128,
+                                 seed=17, delivery="urn3"),
 }
 
 PATH = pathlib.Path(__file__).parent / "golden.npz"

@@ -406,6 +406,14 @@ def _urn_counts_and_targets(cfg, net, adv, r: int, t: int, honest, values,
         strata, minority = "minority", adv.observed_minority(honest)
     else:
         strata, minority = "none", 0
+    if cfg.delivery == "urn3":
+        # The count-realizing hold machinery realizes the §4b-family law; a
+        # §4c-aware hold (clamped-law counts are still within the delivered
+        # quota, so one should exist) is future work — fail loudly rather
+        # than silently realize the wrong model's counts (ROADMAP open item).
+        raise NotImplementedError(
+            "message-level realization of the §4c cheap law is not built; "
+            "use delivery='urn'/'urn2' for the count-realizing instrument")
     counts = net.urn_counts if cfg.delivery == "urn" else net.urn2_counts
     c0, c1 = counts(r, t, [values, values], silent_all,
                     strata=strata, minority=minority)

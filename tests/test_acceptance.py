@@ -24,7 +24,7 @@ pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
 # small enough to keep the suite quick; the >=10^3 runs live in the artifact.
 # keys at benchmark n costs ~0.5 s/instance on the 1-core box, so its CI
 # count is the suite-budget compromise (VERDICT r2 #5).
-CI_SAMPLES = {"urn": 192, "keys": 24}
+CI_SAMPLES = {"urn": 192, "urn3": 192, "keys": 24}
 
 
 @pytest.mark.parametrize("name,delivery", [
@@ -34,6 +34,11 @@ CI_SAMPLES = {"urn": 192, "keys": 24}
     # urn only in CI — the sweep pins urn, and the keys leg at n=512 costs
     # minutes on the numpy side (covered by the artifact run instead).
     ("config5", "urn"),
+    # §4c legs (round 6): the cheap law at the headline shape and at the
+    # adaptive benchmark point (where it must agree bit-for-bit with the
+    # §4b family anyway — robust regime).
+    ("config4", "urn3"),
+    ("config5", "urn3"),
 ])
 def test_at_scale_native_arbiter(name, delivery):
     entry = acceptance.check_at_scale(name, delivery,

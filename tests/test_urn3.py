@@ -1,0 +1,242 @@
+"""Cheap delivery law (spec §4c, delivery="urn3"): law-level exactness against
+the enumerated closed-form pmf, bit-match across all four implementation
+stacks, protocol properties, the §8d Markov anchor, and the divergence map.
+
+Unlike §4b/§4b-v2, urn3 is a *different delivery distribution* — cross-model
+checks assert bounded deviation (and exact identity in the delivery-robust
+regime), not family equality. Bit-matching is within delivery="urn3".
+"""
+
+import dataclasses
+import math
+
+import numpy as np
+import pytest
+
+from byzantinerandomizedconsensus_tpu import SimConfig, Simulator, preset
+
+URN3_SMALL = [
+    SimConfig(protocol="benor", n=4, f=1, instances=60, adversary="none", coin="local",
+              round_cap=64, seed=0, delivery="urn3"),
+    SimConfig(protocol="benor", n=9, f=4, instances=40, adversary="crash", coin="local",
+              round_cap=96, seed=1, delivery="urn3"),
+    SimConfig(protocol="benor", n=16, f=3, instances=40, adversary="byzantine",
+              coin="local", round_cap=64, seed=2, delivery="urn3"),
+    SimConfig(protocol="benor", n=11, f=2, instances=40, adversary="adaptive",
+              coin="shared", round_cap=64, seed=3, delivery="urn3"),
+    SimConfig(protocol="bracha", n=10, f=3, instances=40, adversary="byzantine",
+              coin="shared", round_cap=64, seed=4, delivery="urn3"),
+    SimConfig(protocol="bracha", n=16, f=5, instances=40, adversary="adaptive",
+              coin="shared", round_cap=64, seed=5, delivery="urn3"),
+    SimConfig(protocol="bracha", n=13, f=4, instances=40, adversary="crash",
+              coin="local", round_cap=64, seed=6, delivery="urn3"),
+    SimConfig(protocol="bracha", n=7, f=2, instances=40, adversary="none",
+              coin="shared", round_cap=64, seed=7, delivery="urn3"),
+    SimConfig(protocol="bracha", n=13, f=4, instances=40, adversary="adaptive_min",
+              coin="shared", round_cap=64, seed=8, delivery="urn3"),
+]
+
+
+@pytest.mark.parametrize("m,Lr,Dr", [
+    (5, 11, 6),      # mixed, interior support
+    (170, 341, 170), # the config-4 near-balanced shape
+    (3, 3, 1),       # homogeneous stratum -> deterministic d = Dr
+    (0, 9, 4),       # empty class -> d = 0
+    (7, 7, 3),       # all items in class -> d = Dr
+    (2, 9, 0),       # no drops -> d = 0
+    (4, 5, 4),       # tight support (lo = 3)
+])
+def test_cheap_exact_pmf(m, Lr, Dr):
+    """The §4c segment law against its closed form: the correction nibble has
+    16 equally likely values, so the pmf is exactly enumerable
+    (spec/analytic.py::urn3_segment_pmf) and the sampler's empirical
+    frequencies must match it (5σ) — the law-level anchor, independent of any
+    protocol round."""
+    from spec.analytic import urn3_segment_pmf
+
+    from byzantinerandomizedconsensus_tpu.ops import prf
+    from byzantinerandomizedconsensus_tpu.ops.urn3 import _cheap
+
+    B = 20_000
+    inst = np.arange(B, dtype=np.uint32)
+    recv = np.zeros(1, dtype=np.uint32)
+    u = prf.prf_u32(123, inst[:, None], 0, 0, recv[None, :], 0, prf.URN3, xp=np)
+    arr = lambda v: np.full((B, 1), v, dtype=np.int32)  # noqa: E731
+    d = _cheap(u, 2, arr(m), arr(Lr), arr(Dr), np)[:, 0]
+    pmf = urn3_segment_pmf(m, Lr, Dr)
+    assert d.min() >= max(0, Dr - (Lr - m)) and d.max() <= min(m, Dr)
+    assert set(np.unique(d)) <= set(pmf)
+    for k, p in pmf.items():
+        emp = float((d == k).mean())
+        tol = 5 * math.sqrt(max(p * (1 - p), 1e-9) / B) + 1e-4
+        assert abs(emp - p) < tol, f"d={k}: emp={emp:.5f} pmf={p:.5f}"
+
+
+@pytest.mark.parametrize(
+    "cfg", URN3_SMALL,
+    ids=lambda c: f"{c.protocol}-n{c.n}f{c.f}-{c.adversary}-{c.coin}")
+def test_urn3_bitmatch_small(cfg):
+    ref = Simulator(cfg, "cpu").run()
+    for backend in ("numpy", "jax", "native"):
+        got = Simulator(cfg, backend).run()
+        np.testing.assert_array_equal(ref.rounds, got.rounds, err_msg=f"rounds {backend}")
+        np.testing.assert_array_equal(ref.decision, got.decision,
+                                      err_msg=f"decision {backend}")
+
+
+@pytest.mark.parametrize("name,n_sample", [("config2", 4), ("config3", 3), ("config4", 2)])
+def test_urn3_bitmatch_benchmark_sampled(name, n_sample):
+    import zlib
+
+    cfg = preset(name, round_cap=64, delivery="urn3")
+    rng = np.random.default_rng(zlib.crc32(name.encode()))
+    ids = np.unique(rng.integers(0, cfg.instances, size=n_sample))
+    ref = Simulator(cfg, "cpu").run(ids)
+    for backend in ("numpy", "jax"):
+        got = Simulator(cfg, backend).run(ids)
+        np.testing.assert_array_equal(ref.rounds, got.rounds, err_msg=f"rounds {backend}")
+        np.testing.assert_array_equal(ref.decision, got.decision,
+                                      err_msg=f"decision {backend}")
+
+
+@pytest.mark.parametrize("cfg", URN3_SMALL[:6],
+                         ids=lambda c: f"{c.protocol}-{c.adversary}")
+def test_urn3_agreement_and_validity(cfg):
+    res = Simulator(cfg, "numpy").run()
+    assert set(np.unique(res.decision)) <= {0, 1, 2}
+    for init, expect in (("all0", 0), ("all1", 1)):
+        c = dataclasses.replace(cfg, init=init, instances=30)
+        r = Simulator(c, "numpy").run()
+        decided = r.decision != 2
+        assert np.all(r.decision[decided] == expect), f"validity broken for {init}"
+
+
+def test_urn3_counts_conservation():
+    """Spec §4c preserves the §4b count guarantees by support-clamping:
+    c0+c1+c2 = min(L, n-f-1)+1; with no faults and no bot values the
+    delivered total is exactly n-f for every receiver."""
+    from byzantinerandomizedconsensus_tpu.ops import urn3
+
+    cfg = SimConfig(protocol="bracha", n=32, f=10, instances=8, adversary="none",
+                    coin="shared", delivery="urn3")
+    B, n = 5, cfg.n
+    inst = np.arange(B, dtype=np.uint32)
+    values = (np.arange(n, dtype=np.uint8) % 2)[None, :].repeat(B, 0)
+    silent = np.zeros((B, n), dtype=bool)
+    faulty = np.zeros((B, n), dtype=bool)
+    c0, c1 = urn3.counts_fn(cfg, cfg.seed, inst, 0, 0, values, silent, faulty,
+                            values, xp=np)
+    np.testing.assert_array_equal(c0 + c1, np.full((B, n), n - cfg.f))
+    assert (c0 <= (values == 0).sum(-1)[:, None] + 1).all()
+    assert (c1 <= (values == 1).sum(-1)[:, None] + 1).all()
+    assert (c0 >= 0).all() and (c1 >= 0).all()
+
+
+@pytest.mark.parametrize("adversary", ["none", "adaptive", "adaptive_min"])
+def test_urn3_support_bounds_property(adversary):
+    """Property sweep over random wires (⊥ and silents included): every §4c
+    count obeys the exact-law support — c_w ≥ m_w − D, c_w ≤ m_w + [own],
+    and the delivered total is exactly min(L, n−f−1) + 1 (the n−f quorum
+    feasibility the §5 wait rule needs)."""
+    from byzantinerandomizedconsensus_tpu.ops import urn3
+
+    cfg = SimConfig(protocol="bracha", n=24, f=7, instances=1,
+                    adversary=adversary, coin="shared", delivery="urn3"
+                    ).validate()
+    n, f = cfg.n, cfg.f
+    rng = np.random.default_rng(42)
+    B = 40
+    inst = np.arange(B, dtype=np.uint32)
+    values = rng.integers(0, 3, size=(B, n)).astype(np.uint8)
+    silent = rng.random((B, n)) < 0.15
+    silent &= silent.cumsum(-1) <= f  # at most f silent senders (spec §4)
+    faulty = np.zeros((B, n), dtype=bool)
+    faulty[:, n - f:] = True
+    c0, c1 = urn3.counts_fn(cfg, cfg.seed, inst, 2, 1, values, silent, faulty,
+                            values, xp=np)
+    live = ~silent
+    own = values  # common wire (no two-faced pairing here)
+    # Per-lane class counts over senders u != v, and the urn totals.
+    L = live.sum(-1, keepdims=True) - live.astype(int)
+    D = np.maximum(L - (n - f - 1), 0)
+    for w, cw in ((0, c0), (1, c1)):
+        m_w = ((live & (values == w)).sum(-1, keepdims=True)
+               - (live & (own == w)).astype(int))
+        own_term = (own == w).astype(int)
+        # d_w ≤ min(m_w, D): c_w sits inside the exact-law support.
+        assert (cw <= m_w + own_term).all()
+        assert (cw >= m_w - np.minimum(m_w, D) + own_term).all()
+    # Quorum feasibility: delivered total (⊥ and own included) is exactly
+    # min(L, n−f−1) + 1; c2 = total − c0 − c1 must fit its class.
+    total = np.minimum(L, n - f - 1) + 1
+    c2_max = ((live & (values == 2)).sum(-1, keepdims=True)
+              - (live & (own == 2)).astype(int) + (own == 2).astype(int))
+    assert (c0 + c1 >= total - c2_max).all()
+    assert (c0 + c1 <= total).all()
+
+
+def test_urn3_mean_rounds_matches_exact_chain():
+    """The §8d closed-form anchor: E[rounds] for Ben-Or n=4, f=1 under the
+    §4c law, uniform init, exact Markov solve vs simulation at 4.5σ. Pins the
+    cheap law end-to-end through the Protocol-A round body (and distinguishes
+    it from the exact family: the §8a constant 3.221122 sits ~4σ away at this
+    sample size — the anchor has discriminating power)."""
+    from spec.analytic import expected_rounds_benor_n4_urn3
+
+    cfg = SimConfig(protocol="benor", n=4, f=1, instances=40_000,
+                    adversary="none", coin="local", round_cap=256, seed=123,
+                    delivery="urn3")
+    res = Simulator(cfg, "native").run()
+    mean = float(res.rounds.mean())
+    se = float(res.rounds.std()) / math.sqrt(cfg.instances)
+    exact = expected_rounds_benor_n4_urn3()
+    assert abs(mean - exact) < 4.5 * se, (mean, exact, se)
+    # Validity face of the anchor: unanimity decides in exactly one round.
+    for init in ("all0", "all1"):
+        r = Simulator(dataclasses.replace(cfg, init=init, instances=50),
+                      "native").run()
+        assert (r.rounds == 1).all()
+
+
+@pytest.mark.parametrize("adversary,protocol,n,f,coin,seed", [
+    ("adaptive", "bracha", 16, 5, "local", 5),
+    ("adaptive", "bracha", 16, 5, "shared", 11),
+    ("adaptive_min", "bracha", 16, 5, "local", 5),
+    ("adaptive_min", "benor", 11, 2, "local", 3),
+])
+def test_urn3_robust_regime_identical(adversary, protocol, n, f, coin, seed):
+    """The delivery-robust regime is law-independent: on binary-alphabet
+    steps the adaptive family's bias strata are value-homogeneous, so §4c's
+    support clamp gives lo = hi and the cheap law produces the *identical*
+    counts as the exact family — per-instance outcomes match keys and urn2
+    bit-for-bit (the §4b mechanism, carried over; measured in
+    artifacts/divergence_r6.json)."""
+    cfg = SimConfig(protocol=protocol, n=n, f=f, instances=200,
+                    adversary=adversary, coin=coin, seed=seed, round_cap=64)
+    ref = Simulator(dataclasses.replace(cfg, delivery="urn3"), "numpy").run()
+    for other in ("keys", "urn2"):
+        got = Simulator(dataclasses.replace(cfg, delivery=other), "numpy").run()
+        np.testing.assert_array_equal(ref.rounds, got.rounds, err_msg=other)
+        np.testing.assert_array_equal(ref.decision, got.decision, err_msg=other)
+
+
+def test_urn3_divergence_smoke():
+    """Divergent regime: §4c differs per-instance from §4b-v2 (it is a
+    different law) with a bounded distribution shift — nonzero disagreement,
+    rounds-histogram TV distance recorded and small, decision split intact."""
+    from byzantinerandomizedconsensus_tpu.tools.divergence import compare_row
+
+    cfg = SimConfig(protocol="bracha", n=16, f=5, adversary="none",
+                    coin="shared", seed=11, round_cap=64)
+    row = compare_row(cfg, instances=400, backend="numpy")
+    assert row["frac_rounds_differ_urn2_urn3"] > 0.02, row
+    assert 0.0 < row["rounds_hist_tv_urn2_urn3"] < 0.25, row
+    assert abs(row["p1_urn2"] - row["p1_urn3"]) < 0.1, row
+
+
+def test_urn3_rejects_pallas_kernel():
+    """The Pallas kernels implement §4b only; urn3 must fail loudly, not fall
+    back silently (ADVICE r1 pattern)."""
+    cfg = URN3_SMALL[0]
+    with pytest.raises(ValueError, match="urn3"):
+        Simulator(cfg, "jax_pallas").run()
