@@ -129,6 +129,32 @@ def main() -> int:
         prev_wall_rate=prev[1] if prev else None,
         device_busy_s=dev.get("device_busy_s"),
         prev_device_busy_s=prev[2] if prev else None)
+    # The device-of-record chain rule (VERDICT r5 next #8), stated in the
+    # record itself: a CPU-only session cannot extend vs_prev_round_device —
+    # the chain holds at the newest round that HAS a device leg (r5's
+    # 0.1602 s as of round 7), walls measured here are not comparable to it,
+    # and the next TPU session must compare against that artifact, not this
+    # one. Without this note a CPU round silently looks like a dropped chain.
+    # The anchor is looked up by its device leg, NOT by prev's wall-value
+    # filter: after one CPU-only round the immediately-previous artifact has
+    # no device_busy_s, and the note must still name the real anchor.
+    platform = __import__("jax").default_backend()
+    if platform != "tpu" and "device_busy_s" not in dev:
+        from byzantinerandomizedconsensus_tpu.utils.rounds import (
+            prev_round_artifact)
+
+        def _has_device_leg(doc):
+            detail = (doc.get("parsed", doc) if isinstance(doc, dict)
+                      else {}).get("detail", {})
+            return isinstance(detail, dict) and bool(
+                detail.get("device_busy_s"))
+
+        anchor = prev_round_artifact("BENCH", usable=_has_device_leg)
+        verdict["device_chain_note"] = (
+            "CPU-only session: vs_prev_round_device not extendable this "
+            "round; the device chain holds at "
+            f"{anchor[0] if anchor else 'the newest BENCH_r*.json with a device_busy_s leg (none found)'}"
+            " — re-run on the device of record before any perf verdict")
     print(json.dumps({
         "metric": "consensus_instances_per_sec@n512_f170_shared_coin",
         "value": round(inst_per_sec, 1),
@@ -137,7 +163,7 @@ def main() -> int:
         **({"prev_round_artifact": prev[0]} if prev else {}),
         **{k: v for k, v in verdict.items() if k != "walls_spread"},
         "detail": {
-            "platform": __import__("jax").default_backend(),
+            "platform": platform,
             "backend": backend,
             "delivery": cfg.delivery,
             "instances": instances,

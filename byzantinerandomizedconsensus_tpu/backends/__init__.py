@@ -55,6 +55,17 @@ def _native(n_threads: str = "0"):
     return NativeBackend(n_threads=int(n_threads))
 
 
+def _virtual(param: str = "2x2"):
+    """``virtual[:<data>x<model>]`` — host-side SPMD emulation of the sharded
+    layout (parallel/virtual.py): numpy round bodies on threads with a
+    barrier all-gather. A validation instrument (sharding-semantics bit-match
+    without an accelerator), not a performance path."""
+    from byzantinerandomizedconsensus_tpu.parallel.virtual import VirtualMeshBackend
+
+    d, _, m = param.partition("x")
+    return VirtualMeshBackend(n_data=int(d or "2"), n_model=int(m or "1"))
+
+
 def _jax_sharded(param: str = "1"):
     """``jax_sharded[:<n_model>[,pallas]]`` — replica-shard count over the mesh's
     model axis (must divide the device count and cfg.n), optionally with the
@@ -72,6 +83,7 @@ register_backend("jax_cpu", _jax_cpu)
 register_backend("jax_sharded", _jax_sharded)
 register_backend("jax_pallas", _jax_pallas)
 register_backend("native", _native)
+register_backend("virtual", _virtual)
 
 __all__ = [
     "SimResult",

@@ -56,7 +56,8 @@ class CpuBackend(SimulatorBackend):
             return np.ones(cfg.n, dtype=np.uint8)
         if cfg.init == "split":
             return (replica & 1).astype(np.uint8)
-        return prf.prf_bit(cfg.seed, instance, 0, 0, replica, 0, prf.INIT_EST, xp=np).astype(np.uint8)
+        return prf.prf_bit(cfg.seed, instance, 0, 0, replica, 0, prf.INIT_EST,
+                           xp=np, pack=cfg.pack_version).astype(np.uint8)
 
     def _run_instance(self, cfg: SimConfig, instance: int):
         est0 = self._initial_estimates(cfg, instance)
@@ -87,7 +88,8 @@ class CpuBackend(SimulatorBackend):
                         vbc = []
                         for h in (0, 1):
                             e = prf.prf_u32(cfg.seed, instance, r, t, h, send,
-                                            prf.BYZ_VALUE, xp=np)
+                                            prf.BYZ_VALUE, xp=np,
+                                            pack=cfg.pack_version)
                             vh = (e % np.uint32(3)).astype(np.uint8)
                             vbc.append(np.where(adv.faulty, vh, honest).astype(np.uint8))
                     else:
@@ -111,12 +113,13 @@ class CpuBackend(SimulatorBackend):
                         rep.on_deliver(t, vmat[rep.index], mask[rep.index])
             if cfg.coin == "shared":
                 shared = int(prf.prf_bit(cfg.seed, instance, r, prf.COIN_STEP, 0, 0,
-                                         prf.SHARED_COIN, xp=np))
+                                         prf.SHARED_COIN, xp=np,
+                                         pack=cfg.pack_version))
                 coin = [shared] * cfg.n
             else:
                 replica = np.arange(cfg.n, dtype=np.uint32)
                 coin = prf.prf_bit(cfg.seed, instance, r, prf.COIN_STEP, replica, 0,
-                                   prf.LOCAL_COIN, xp=np)
+                                   prf.LOCAL_COIN, xp=np, pack=cfg.pack_version)
             for rep in replicas:
                 rep.end_round(int(coin[rep.index]))
             if all(replicas[j].decided for j in correct):

@@ -27,7 +27,8 @@ _COIN = {"local": 0, "shared": 1}
 _INIT = {"random": 0, "all0": 1, "all1": 2, "split": 3}
 _DELIVERY = {"keys": 0, "urn": 1, "urn2": 2, "urn3": 3}
 
-_ABI_VERSION = 4
+# v5: sim_run carries the spec §2 packing version in the call contract.
+_ABI_VERSION = 5
 
 _lib = None
 
@@ -71,7 +72,7 @@ def _load():
         lib.sim_run.argtypes = [
             ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
-            ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
             np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
             ctypes.c_int64, ctypes.c_int,
             np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
@@ -102,6 +103,7 @@ class NativeBackend(SimulatorBackend):
                 _COIN[cfg.coin], _INIT[cfg.init],
                 ctypes.c_uint64(cfg.seed & 0xFFFFFFFFFFFFFFFF),
                 cfg.round_cap, cfg.crash_window, _DELIVERY[cfg.delivery],
+                cfg.pack_version,
                 ids, len(ids), self.n_threads, rounds, decision,
             )
         return SimResult(config=cfg, inst_ids=ids, rounds=rounds, decision=decision)

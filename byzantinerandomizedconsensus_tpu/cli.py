@@ -44,7 +44,8 @@ def _add_config_args(p: argparse.ArgumentParser, default_backend: str = "cpu") -
                         "validation model)")
     p.add_argument("--backend", default=default_backend,
                    help="cpu (oracle) | numpy | native[:threads] | jax | jax_cpu "
-                        "| jax_pallas | jax_sharded[:n_model]")
+                        "| jax_pallas | jax_sharded[:n_model] | virtual[:DxM] "
+                        "(host-side SPMD emulation of the sharded layout)")
 
 
 def _positive_int(text: str) -> int:
@@ -176,9 +177,12 @@ def cmd_sweep(args) -> int:
             return 2
     delivery = args.delivery if args.delivery is not None \
         else _announce_default_delivery()
+    from byzantinerandomizedconsensus_tpu.config import SWEEP_NS_EXTENDED
+
+    default_ns = SWEEP_NS_EXTENDED if args.extended else sweep.SWEEP_NS
     out = sweep.run_sweep(
         pathlib.Path(args.out), backend=args.backend,
-        ns=tuple(int(x) for x in args.ns) if args.ns else sweep.SWEEP_NS,
+        ns=tuple(int(x) for x in args.ns) if args.ns else default_ns,
         instances=args.instances, seed=args.seed,
         shard_instances=args.shard_instances, coin=args.coin,
         delivery=delivery, round_cap=args.round_cap,
@@ -220,6 +224,9 @@ def main(argv=None) -> int:
     p_sw.add_argument("--out", default="sweep_out")
     p_sw.add_argument("--backend", default="jax")
     p_sw.add_argument("--ns", nargs="*", type=int, default=None)
+    p_sw.add_argument("--extended", action="store_true",
+                      help="include the opt-in n=2048 point past the v1 "
+                           "packing edge (spec §2 v2; config.SWEEP_NS_EXTENDED)")
     p_sw.add_argument("--instances", type=int, default=sweep.SWEEP_INSTANCES)
     p_sw.add_argument("--shard-instances", type=int, default=500)
     p_sw.add_argument("--seed", type=int, default=0)

@@ -86,6 +86,16 @@ class SimConfig:
         """Selects Ben-Or Protocol B thresholds (spec §5.1)."""
         return self.adversary in ("byzantine", "adaptive", "adaptive_min")
 
+    @property
+    def pack_version(self) -> int:
+        """The spec §2 packing law this config draws under: 1 (the frozen
+        original) for n ≤ 1024, 2 (spec §2 v2, wider recv/send fields) above.
+        Every consumer of PRF coordinates — the vectorized ops, the oracle,
+        the Pallas kernels, the native core — must thread this through as the
+        ``pack`` argument; it is a pure function of n so the five stacks
+        cannot disagree."""
+        return prf.pack_version(self.n)
+
     def validate(self) -> "SimConfig":
         if self.delivery not in DELIVERY_KINDS:
             raise ValueError(
@@ -95,10 +105,20 @@ class SimConfig:
             raise ValueError(f"n={self.n} out of range (1..{prf.MAX_N})")
         if not (0 <= self.f < self.n):
             raise ValueError(f"f={self.f} out of range for n={self.n}")
-        if not (0 < self.instances <= prf.MAX_INSTANCES):
-            raise ValueError(f"instances={self.instances} out of range (1..{prf.MAX_INSTANCES})")
-        if not (0 < self.round_cap <= prf.MAX_ROUNDS):
-            raise ValueError(f"round_cap={self.round_cap} out of range (1..{prf.MAX_ROUNDS})")
+        # Field limits depend on the packing law (spec §2 / §2 v2): v2 buys
+        # recv/send width by narrowing the instance and round fields.
+        max_inst = prf.MAX_INSTANCES if self.pack_version == 1 \
+            else prf.V2_MAX_INSTANCES
+        max_rounds = prf.MAX_ROUNDS if self.pack_version == 1 \
+            else prf.V2_MAX_ROUNDS
+        if not (0 < self.instances <= max_inst):
+            raise ValueError(
+                f"instances={self.instances} out of range (1..{max_inst}) "
+                f"under packing v{self.pack_version} (n={self.n})")
+        if not (0 < self.round_cap <= max_rounds):
+            raise ValueError(
+                f"round_cap={self.round_cap} out of range (1..{max_rounds}) "
+                f"under packing v{self.pack_version} (n={self.n})")
         # Resilience bounds (spec §5.1/§5.2): benor Protocol A needs n > 2f, benor
         # Protocol B (lying adversaries) needs n > 5f, bracha needs n > 3f (the
         # n > 3f Byzantine benchmark pairing is Bracha — config 3).
@@ -146,6 +166,11 @@ PRESETS: dict[str, SimConfig] = {
 
 # Config 5 is a sweep (spec §7): bracha, adaptive adversary, shared coin.
 SWEEP_NS = (128, 256, 384, 512, 640, 768, 896, 1024)
+# Opt-in extension past the v1 packing edge (spec §2 v2): the first
+# count-level cost-curve point beyond the old n=1024 ceiling. Not part of the
+# default sweep — the CLI exposes it via `sweep --extended`, and checkpoints
+# written for it carry the packing-version token (utils/checkpoint.shard_name).
+SWEEP_NS_EXTENDED = SWEEP_NS + (2048,)
 SWEEP_INSTANCES = 2_000
 # The single sweep point that stands in for config 5 wherever one config is
 # needed (tools/product.py, tools/acceptance.py): benchmark n, the headline

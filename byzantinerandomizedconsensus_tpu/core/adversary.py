@@ -21,6 +21,7 @@ class Adversary:
         self.cfg = cfg
         self.seed = seed
         self.instance = instance
+        self._pack = cfg.pack_version
         self.faulty = self._pick_faulty()
         self._no_bias = np.zeros((1, cfg.n), dtype=np.uint32)
 
@@ -29,8 +30,10 @@ class Adversary:
         if self.kind == "none" or cfg.f == 0:
             return np.zeros(cfg.n, dtype=bool)
         replica = np.arange(cfg.n, dtype=np.uint32)
-        rank = prf.prf_u32(self.seed, self.instance, 0, 0, replica, 0, prf.FAULTY_RANK, xp=np)
-        key = (rank & np.uint32(0xFFFFFC00)) | replica
+        rank = prf.prf_u32(self.seed, self.instance, 0, 0, replica, 0,
+                           prf.FAULTY_RANK, xp=np, pack=self._pack)
+        # Replica field: 10 | 12 bits per packing law (spec §2 v2).
+        key = (rank & np.uint32(prf.KEY_MASK[self._pack])) | replica
         kth = np.partition(key, cfg.f - 1)[cfg.f - 1]
         return key <= kth
 
@@ -47,7 +50,8 @@ class CrashAdversary(Adversary):
     def __init__(self, cfg, seed, instance):
         super().__init__(cfg, seed, instance)
         replica = np.arange(cfg.n, dtype=np.uint32)
-        c = prf.prf_u32(seed, instance, 0, 0, replica, 0, prf.CRASH_ROUND, xp=np)
+        c = prf.prf_u32(seed, instance, 0, 0, replica, 0, prf.CRASH_ROUND,
+                        xp=np, pack=self._pack)
         self.crash_round = (c % np.uint32(cfg.crash_window)).astype(np.int32)
 
     def inject(self, rnd, t, honest_values):
@@ -66,13 +70,15 @@ class ByzantineAdversary(Adversary):
         n = cfg.n
         send = np.arange(n, dtype=np.uint32)
         if cfg.protocol == "bracha":
-            b = prf.prf_u32(self.seed, self.instance, rnd, t, 0, send, prf.BYZ_VALUE, xp=np) & 3
+            b = prf.prf_u32(self.seed, self.instance, rnd, t, 0, send,
+                            prf.BYZ_VALUE, xp=np, pack=self._pack) & 3
             silent = self.faulty & (b == 0)
             v = np.where(b == 1, 0, np.where(b == 2, 1, honest_values)).astype(np.uint8)
             values = np.where(self.faulty, v, honest_values).astype(np.uint8)
             return values, silent, self._no_bias
         recv = np.arange(n, dtype=np.uint32)[:, None]
-        e = prf.prf_u32(self.seed, self.instance, rnd, t, recv, send[None, :], prf.BYZ_VALUE, xp=np)
+        e = prf.prf_u32(self.seed, self.instance, rnd, t, recv, send[None, :],
+                        prf.BYZ_VALUE, xp=np, pack=self._pack)
         vmat = (e % np.uint32(3)).astype(np.uint8)
         values = np.where(self.faulty[None, :], vmat,
                           np.broadcast_to(honest_values, (n, n)).astype(np.uint8))

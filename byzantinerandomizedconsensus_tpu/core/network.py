@@ -19,6 +19,11 @@ class Network:
         self.seed = seed
         self.instance = instance
         self._recv = np.arange(cfg.n, dtype=np.uint32)
+        self._pack = cfg.pack_version
+        # Packing-law sub-parameters (spec §2 v2): range-reduction shifts and
+        # the combined-key field split (prf-top width, sender-index width).
+        self._rs, self._rd = prf.RED_SHIFTS[self._pack]
+        self._klow = prf.KEY_LOW_BITS[self._pack]
 
     def delivery_mask(self, rnd: int, t: int, silent: np.ndarray, bias: np.ndarray) -> np.ndarray:
         """(n, n) bool delivered(recv, send). ``silent``: (n,) bool; ``bias``: (n, n)
@@ -28,12 +33,15 @@ class Network:
         send = self._recv
         for v in range(n):
             sched = prf.prf_u32(self.seed, self.instance, rnd, t,
-                                np.uint32(v), send, prf.SCHED, xp=np)
+                                np.uint32(v), send, prf.SCHED, xp=np,
+                                pack=self._pack)
             bias_row = bias[0] if bias.shape[0] == 1 else bias[v]
+            top = np.uint32(30 - self._klow)          # prf field width: 20 | 18
             combined = (
                 (silent.astype(np.uint32) << np.uint32(31))
                 | (bias_row.astype(np.uint32) << np.uint32(30))
-                | (((sched >> np.uint32(12)) & np.uint32(0xFFFFF)) << np.uint32(10))
+                | (((sched >> np.uint32(32 - int(top)))
+                    & np.uint32((1 << int(top)) - 1)) << np.uint32(self._klow))
                 | send
             )
             combined[v] = v  # own message always delivered (spec §4)
@@ -83,14 +91,15 @@ class Network:
             else:
                 st = [False, False, False]
             s = int(prf.prf_u32(self.seed, self.instance, rnd, t,
-                                np.uint32(v), 0, prf.URN, xp=np))
+                                np.uint32(v), 0, prf.URN, xp=np,
+                                pack=self._pack))
             for _ in range(drops):
                 s = (s * prf.URN_LCG_A + prf.URN_LCG_C) & 0xFFFFFFFF
                 u32 = s ^ (s >> 16)
                 b_rem = sum(rem[w] for w in range(3) if st[w])
                 in_biased = b_rem > 0
                 r_cur = b_rem if in_biased else sum(rem) - b_rem
-                d = ((u32 >> 10) * r_cur) >> 22
+                d = ((u32 >> self._rs) * r_cur) >> self._rd
                 e = [rem[w] if st[w] == in_biased else 0 for w in range(3)]
                 w = 0 if d < e[0] else (1 if d < e[0] + e[1] else 2)
                 rem[w] -= 1
@@ -139,12 +148,13 @@ class Network:
                 else:
                     is_comp, K, P = True, comp, Dr     # COMP
                 s = int(prf.prf_u32(self.seed, self.instance, rnd, t,
-                                    np.uint32(v), seg, prf.URN2, xp=np))
+                                    np.uint32(v), seg, prf.URN2, xp=np,
+                                    pack=self._pack))
                 a = 0
                 for j in range(K):
                     s = (s * prf.URN_LCG_A + prf.URN_LCG_C) & 0xFFFFFFFF
                     u32 = s ^ (s >> 16)
-                    q = ((u32 >> 10) * (Lr - j)) >> 22
+                    q = ((u32 >> self._rs) * (Lr - j)) >> self._rd
                     if q < P - a:
                         a += 1
                 return (Dr - a) if is_comp else a
@@ -204,7 +214,8 @@ class Network:
             else:
                 st = [False, False, False]
             word = int(prf.prf_u32(self.seed, self.instance, rnd, t,
-                                   np.uint32(v), 0, prf.URN3, xp=np))
+                                   np.uint32(v), 0, prf.URN3, xp=np,
+                                   pack=self._pack))
 
             def cheap(seg: int, mm: int, Lr: int, Dr: int) -> int:
                 nib = (word >> (8 * seg)) & 0xF

@@ -28,8 +28,10 @@ def faulty_mask(cfg, seed, inst_ids, xp=np):
         return xp.zeros((B, cfg.n), dtype=bool)
     replica = xp.arange(cfg.n, dtype=xp.uint32)[None, :]
     rank = prf.prf_u32(seed, xp.asarray(inst_ids, dtype=xp.uint32)[:, None],
-                       0, 0, replica, 0, prf.FAULTY_RANK, xp=xp)
-    key = (rank & xp.uint32(0xFFFFFC00)) | replica
+                       0, 0, replica, 0, prf.FAULTY_RANK, xp=xp,
+                       pack=cfg.pack_version)
+    # Replica-index field width per packing law (10 | 12 bits, spec §2 v2).
+    key = (rank & xp.uint32(prf.KEY_MASK[cfg.pack_version])) | replica
     if xp is np:
         kth = np.partition(key, cfg.f - 1, axis=-1)[..., cfg.f - 1]
     else:
@@ -52,7 +54,8 @@ def crash_rounds(cfg, seed, inst_ids, xp=np):
     """(B, n) int32 crash round per replica (only meaningful where faulty; spec §3.3)."""
     replica = xp.arange(cfg.n, dtype=xp.uint32)[None, :]
     c = prf.prf_u32(seed, xp.asarray(inst_ids, dtype=xp.uint32)[:, None],
-                    0, 0, replica, 0, prf.CRASH_ROUND, xp=xp)
+                    0, 0, replica, 0, prf.CRASH_ROUND, xp=xp,
+                    pack=cfg.pack_version)
     return (c % xp.uint32(cfg.crash_window)).astype(xp.int32)
 
 
@@ -103,7 +106,8 @@ class AdversaryModel:
         if cfg.adversary == "byzantine":
             if cfg.protocol == "bracha":
                 # RBC count-level outcome, common to all receivers (spec §6.3).
-                b = prf.prf_u32(seed, inst, rnd, t, 0, send, prf.BYZ_VALUE, xp=xp) & xp.uint32(3)
+                b = prf.prf_u32(seed, inst, rnd, t, 0, send, prf.BYZ_VALUE,
+                                xp=xp, pack=cfg.pack_version) & xp.uint32(3)
                 silent = faulty & (b == 0)
                 v = xp.where(b == 1, xp.uint8(0),
                              xp.where(b == 2, xp.uint8(1), honest_values.astype(xp.uint8)))
@@ -118,7 +122,8 @@ class AdversaryModel:
             recv3 = recv_ids[None, :, None]
             send3 = xp.arange(n, dtype=xp.uint32)[None, None, :]
             inst3 = xp.asarray(inst_ids, dtype=xp.uint32)[:, None, None]
-            e = prf.prf_u32(seed, inst3, rnd, t, recv3, send3, prf.BYZ_VALUE, xp=xp)
+            e = prf.prf_u32(seed, inst3, rnd, t, recv3, send3, prf.BYZ_VALUE,
+                            xp=xp, pack=cfg.pack_version)
             vmat = (e % xp.uint32(3)).astype(xp.uint8)  # {0,1,2=silent-to-this-recv}
             values = xp.where(faulty[:, None, :], vmat,
                               xp.broadcast_to(honest_values[:, None, :], (B, R, n)).astype(xp.uint8))

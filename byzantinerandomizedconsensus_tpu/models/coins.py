@@ -20,7 +20,9 @@ def coin_bits(cfg, seed, inst_ids, rnd, xp=np, recv_ids=None):
         recv_ids = xp.arange(cfg.n, dtype=xp.uint32)
     replica = xp.asarray(recv_ids, dtype=xp.uint32)[None, :]
     if cfg.coin == "shared":
-        bit = prf.prf_bit(seed, inst, rnd, prf.COIN_STEP, 0, 0, prf.SHARED_COIN, xp=xp)
+        bit = prf.prf_bit(seed, inst, rnd, prf.COIN_STEP, 0, 0, prf.SHARED_COIN,
+                          xp=xp, pack=cfg.pack_version)
         return xp.broadcast_to(bit.astype(xp.uint8), (inst.shape[0], replica.shape[1]))
-    bit = prf.prf_bit(seed, inst, rnd, prf.COIN_STEP, replica, 0, prf.LOCAL_COIN, xp=xp)
+    bit = prf.prf_bit(seed, inst, rnd, prf.COIN_STEP, replica, 0, prf.LOCAL_COIN,
+                      xp=xp, pack=cfg.pack_version)
     return bit.astype(xp.uint8)

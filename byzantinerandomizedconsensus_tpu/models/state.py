@@ -21,7 +21,8 @@ def init_est(cfg, seed, inst_ids, xp=np, recv_ids=None):
     if cfg.init == "split":
         return xp.broadcast_to((replica & xp.uint32(1)).astype(xp.uint8), (B, R))
     inst = xp.asarray(inst_ids, dtype=xp.uint32)[:, None]
-    return prf.prf_bit(seed, inst, 0, 0, replica, 0, prf.INIT_EST, xp=xp).astype(xp.uint8)
+    return prf.prf_bit(seed, inst, 0, 0, replica, 0, prf.INIT_EST, xp=xp,
+                       pack=cfg.pack_version).astype(xp.uint8)
 
 
 def init_state(cfg, seed, inst_ids, xp=np, recv_ids=None):

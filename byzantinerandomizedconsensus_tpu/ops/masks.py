@@ -2,7 +2,8 @@
 
 Each receiver obtains messages from exactly the ``n-f`` live senders with the smallest
 combined scheduling key. The combined key packs, from high to low bits:
-``silent(1) | bias(1) | prf_top20(20) | sender_index(10)`` — distinct by construction,
+``silent(1) | bias(1) | prf_top20(20) | sender_index(10)`` (under the spec §2 v2
+packing, n > 1024: ``prf_top18(18) | sender_index(12)``) — distinct by construction,
 so "the n-f smallest" is exact integer selection with no ties, identical under numpy's
 ``partition`` and XLA's ``sort``.
 
@@ -34,14 +35,18 @@ def combined_keys(cfg, seed, inst_ids, rnd, t, silent, bias, xp=np, recv_ids=Non
     send = xp.arange(n, dtype=xp.uint32)[None, None, :]
     sched = prf.prf_u32(
         seed, xp.asarray(inst_ids, dtype=xp.uint32)[:, None, None],
-        rnd, t, recv, send, prf.SCHED, xp=xp,
+        rnd, t, recv, send, prf.SCHED, xp=xp, pack=cfg.pack_version,
     )
     silent_b = xp.asarray(silent, dtype=xp.uint32)[:, None, :]
     bias_b = xp.asarray(bias, dtype=xp.uint32)
+    # Combined-key field split per packing law (spec §2 v2): the sender index
+    # field widens 10 → 12 bits past n=1024, the PRF field narrows 20 → 18.
+    low = prf.KEY_LOW_BITS[cfg.pack_version]
+    top = 30 - low
     combined = (
         (silent_b << u32(31))
         | (bias_b << u32(30))
-        | (((sched >> u32(12)) & u32(0xFFFFF)) << u32(10))
+        | (((sched >> u32(32 - top)) & u32((1 << top) - 1)) << u32(low))
         | send
     )
     # A replica always receives its own message: combined = recv index (spec §4).
@@ -76,29 +81,31 @@ def delivery_mask(cfg, seed, inst_ids, rnd, t, silent, bias, xp=np, recv_ids=Non
     return mask_from_keys(combined, cfg.n - cfg.f, silent, xp=xp, recv_ids=recv_ids)
 
 
-def _smallest_k_mask_xla(combined, k: int):
+def _smallest_k_mask_xla(combined, k: int, low: int = 10):
     """jax-only: membership mask of the k smallest keys per receiver row
-    without a sort. Same (top22, sender-order tie class) decomposition as
-    ops/pallas_tally._smallest_k_mask — 22 count passes + one cumsum — here
-    over the full (B, R, n) tensor so it can be A/B'd against the XLA sort on
-    TPU without Pallas in the loop. Bit-identical to thresholding against the
-    exact k-th smallest key (keys distinct: low 10 bits are the sender)."""
+    without a sort. Same (top-bits, sender-order tie class) decomposition as
+    ops/pallas_tally._smallest_k_mask — 32−``low`` count passes + one cumsum —
+    here over the full (B, R, n) tensor so it can be A/B'd against the XLA
+    sort on TPU without Pallas in the loop. Bit-identical to thresholding
+    against the exact k-th smallest key (keys distinct: the low ``low`` bits
+    are the sender — 10 under v1 packing, 12 under §2 v2)."""
     import jax
     import jax.numpy as jnp
 
-    top22 = jax.lax.bitcast_convert_type(combined >> jnp.uint32(10), jnp.int32)
+    bits = 32 - low
+    top = jax.lax.bitcast_convert_type(combined >> jnp.uint32(low), jnp.int32)
 
     def bit_step(i, acc):
-        b = 21 - i
+        b = bits - 1 - i
         cand = acc | jnp.int32((1 << b) - 1)
-        cnt = jnp.sum((top22 <= cand).astype(jnp.int32), axis=-1,
+        cnt = jnp.sum((top <= cand).astype(jnp.int32), axis=-1,
                       keepdims=True)
         return jnp.where(cnt >= k, acc, acc | jnp.int32(1 << b))
 
     T = jax.lax.fori_loop(
-        0, 22, bit_step, jnp.zeros(combined.shape[:-1] + (1,), jnp.int32))
-    lt = top22 < T
-    tie = top22 == T
+        0, bits, bit_step, jnp.zeros(combined.shape[:-1] + (1,), jnp.int32))
+    lt = top < T
+    tie = top == T
     m = jnp.sum(lt.astype(jnp.int32), axis=-1, keepdims=True)
     rank = jnp.cumsum(tie.astype(jnp.int32), axis=-1) - tie.astype(jnp.int32)
     return lt | (tie & (rank < k - m))
@@ -138,7 +145,8 @@ def counts_nosort(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
         bias = jnp.zeros((B, 1, n), dtype=jnp.uint32)
     combined = combined_keys(cfg, seed, inst_ids, rnd, t, silent, bias, xp=jnp,
                              recv_ids=recv)
-    topk = _smallest_k_mask_xla(combined, n - cfg.f)
+    topk = _smallest_k_mask_xla(combined, n - cfg.f,
+                                low=prf.KEY_LOW_BITS[cfg.pack_version])
     own = (recv[:, None] == jnp.arange(n, dtype=jnp.uint32)[None, :])[None]
     mask = (topk & ~jnp.asarray(silent, dtype=bool)[:, None, :]) | own
     return tally.tally01(mask, values, xp=jnp)

@@ -91,29 +91,31 @@ def _kth_smallest(keys_u32, k: int):
     return acc
 
 
-def _smallest_k_mask(combined_u32, k: int):
+def _smallest_k_mask(combined_u32, k: int, low: int = 10):
     """(R, S) distinct uint32 keys -> (R, S) bool: membership in the k smallest.
 
-    Decomposition that needs only a 22-bit search: the low 10 bits of every key
-    are the sender index, so sorting by key == sorting by (top22, sender).
-    Search the k-th smallest of the top-22 projection (22 passes, and the
-    values fit in int32 so no sign-flip is needed), then resolve the tie class
-    at the threshold by sender order with one exclusive prefix count:
-    delivered = {top22 < T} ∪ {first k - |top22 < T| ties in sender order}.
+    Decomposition that needs only a (32−``low``)-bit search: the low ``low``
+    bits of every key are the sender index (10 under v1 packing, 12 under
+    spec §2 v2), so sorting by key == sorting by (top, sender). Search the
+    k-th smallest of the top projection (32−low passes, and the values fit in
+    int32 so no sign-flip is needed), then resolve the tie class at the
+    threshold by sender order with one exclusive prefix count:
+    delivered = {top < T} ∪ {first k - |top < T| ties in sender order}.
     Bit-identical to thresholding against :func:`_kth_smallest` (keys
-    distinct), at ~22/32 the pass cost.
+    distinct), at ~(32−low)/32 the pass cost.
     """
-    top22 = jax.lax.bitcast_convert_type(combined_u32 >> jnp.uint32(10),
+    bits = 32 - low
+    top22 = jax.lax.bitcast_convert_type(combined_u32 >> jnp.uint32(low),
                                          jnp.int32)
 
     def bit_step(i, acc):
-        b = 21 - i
+        b = bits - 1 - i
         cand = acc | jnp.int32((1 << b) - 1)
         cnt = jnp.sum((top22 <= cand).astype(jnp.int32), axis=-1,
                       keepdims=True)
         return jnp.where(cnt >= k, acc, acc | jnp.int32(1 << b))
 
-    T = jax.lax.fori_loop(0, 22, bit_step,
+    T = jax.lax.fori_loop(0, bits, bit_step,
                           jnp.zeros((combined_u32.shape[0], 1), jnp.int32))
     lt = top22 < T
     tie = top22 == T
@@ -151,7 +153,10 @@ def _step_kernel(params_ref, ids_ref, values_ref, silent_ref, faulty_ref,
     send = jax.lax.broadcasted_iota(jnp.uint32, (tile_r, S), 1)
     recv = (jax.lax.broadcasted_iota(jnp.uint32, (tile_r, S), 0)
             + r_tile.astype(jnp.uint32) * u(tile_r) + recv_offset)
-    x1_base = (rnd << u(16)) | (recv << u(6)) | u(step << 4)
+    sh_send, sh_rnd, sh_recv = prf.PACK_SHIFTS[prf.pack_version(n)]
+    key_low = prf.KEY_LOW_BITS[prf.pack_version(n)]  # sender field: 10 | 12
+    key_top = 30 - key_low                           # prf field: 20 | 18
+    x1_base = (rnd << u(sh_rnd)) | (recv << u(sh_recv)) | u(step << 4)
     own = send == recv
 
     for i in range(block_b):
@@ -163,7 +168,7 @@ def _step_kernel(params_ref, ids_ref, values_ref, silent_ref, faulty_ref,
             # Plain-Ben-Or Byzantine: per-(recv, send) value e % 3 for faulty
             # senders (spec §6.3), recomputed in-register.
             faulty = faulty_ref[i, :].astype(jnp.int32)[None, :]
-            e = _threefry2x32(k0, k1, (send << u(17)) | inst,
+            e = _threefry2x32(k0, k1, (send << u(sh_send)) | inst,
                               x1_base | u(prf.BYZ_VALUE))
             vmat = (e % u(3)).astype(jnp.int32)
             vals = jnp.where(faulty > 0, vmat, values)
@@ -187,15 +192,17 @@ def _step_kernel(params_ref, ids_ref, values_ref, silent_ref, faulty_ref,
         else:
             bias = jnp.zeros((tile_r, S), dtype=jnp.uint32)
 
-        sched = _threefry2x32(k0, k1, (send << u(17)) | inst,
+        sched = _threefry2x32(k0, k1, (send << u(sh_send)) | inst,
                               x1_base | u(prf.SCHED))
         combined = ((silent.astype(jnp.uint32) << u(31)) | (bias << u(30))
-                    | (((sched >> u(12)) & u(0xFFFFF)) << u(10)) | send)
+                    | (((sched >> u(32 - key_top)) & u((1 << key_top) - 1))
+                       << u(key_low)) | send)
         # Padded senders (send >= n) sort last; silenced by the caller.
         combined = jnp.where(send >= u(n), u(0xFFFFFFFF), combined)
         combined = jnp.where(own, recv, combined)
 
-        delivered = own | (_smallest_k_mask(combined, n_deliver) & (silent == 0))
+        delivered = own | (_smallest_k_mask(combined, n_deliver, low=key_low)
+                           & (silent == 0))
         c0_ref[i, :] = jnp.sum(delivered & (vals == 0), axis=-1).astype(jnp.int32)
         c1_ref[i, :] = jnp.sum(delivered & (vals == 1), axis=-1).astype(jnp.int32)
     del adv_bracha_byz  # silence handled upstream; key layout identical

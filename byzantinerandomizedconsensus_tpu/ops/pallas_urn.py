@@ -129,7 +129,9 @@ def _urn_kernel(params_ref, v0_ref, v1_ref, silent_ref, *rest, seed, step, n,
     tot0 = rem[0] + rem[1] + rem[2]
     D = jnp.maximum(tot0 - i32(quota), i32(0))
 
-    x1 = (rnd << u(16)) | (recv << u(6)) | u((step << 4) | prf.URN)
+    _, sh_rnd, sh_recv = prf.PACK_SHIFTS[prf.pack_version(n)]
+    rs, rd = prf.RED_SHIFTS[prf.pack_version(n)]
+    x1 = (rnd << u(sh_rnd)) | (recv << u(sh_recv)) | u((step << 4) | prf.URN)
     s = _threefry2x32(k0, k1, jnp.broadcast_to(inst, recv.shape), x1)
 
     if not adaptive and f > 0:
@@ -150,7 +152,7 @@ def _urn_kernel(params_ref, v0_ref, v1_ref, silent_ref, *rest, seed, step, n,
             uu = sj ^ (sj >> u(16))
             active = i32(j) < D
             R_cur = (tot0 - i32(j)).astype(u)   # garbage if inactive (masked)
-            d = ((uu >> u(10)) * R_cur) >> u(22)
+            d = ((uu >> u(rs)) * R_cur) >> u(rd)
             pick0 = d < r0.astype(u)
             pick1 = ~pick0 & (d < (r0 + r1).astype(u))
             r0 = r0 - (pick0 & active).astype(i32)
@@ -169,7 +171,7 @@ def _urn_kernel(params_ref, v0_ref, v1_ref, silent_ref, *rest, seed, step, n,
         in_biased = b_rem > 0
         tot = r0 + r1 + r2
         R_cur = jnp.where(in_biased, b_rem, tot - b_rem).astype(u)
-        d = ((uu >> u(10)) * R_cur) >> u(22)
+        d = ((uu >> u(rs)) * R_cur) >> u(rd)
         e0 = jnp.where(st[0] == in_biased, r0, 0).astype(u)
         e1 = jnp.where(st[1] == in_biased, r1, 0).astype(u)
         pick0 = d < e0

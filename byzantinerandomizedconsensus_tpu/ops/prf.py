@@ -17,9 +17,59 @@ from __future__ import annotations
 import numpy as np
 
 # Field-packing limits (spec/PROTOCOL.md §2). Asserted by backends at config time.
+# Two packing laws share the coordinate space, selected per-config by n alone
+# (pack_version): v1 is the original law, frozen — every draw of every n ≤ 1024
+# config is bit-identical to the pre-v2 code (asserted by tests/test_packing.py
+# against the committed goldens); v2 (spec §2 v2) widens recv/send to 12/13
+# bits for 1024 < n ≤ 4096 at the price of narrower instance/round fields.
 MAX_INSTANCES = 1 << 17
-MAX_N = 1 << 10
+V1_MAX_N = 1 << 10
 MAX_ROUNDS = 1 << 16
+
+# v2 field budget: x0 = send(13) | [3 reserved] | instance(16),
+#                  x1 = round(12) | recv(12) | step(4) | purpose(4).
+V2_MAX_INSTANCES = 1 << 16
+V2_MAX_N = 1 << 12
+V2_MAX_ROUNDS = 1 << 12
+
+# The overall n ceiling any config may request (the v2 law's).
+MAX_N = V2_MAX_N
+
+
+# (send, rnd, recv) bit offsets per packing law — the in-kernel Threefry
+# implementations (ops/pallas_urn.py, ops/pallas_tally.py) build x0/x1 from
+# these so their packing cannot drift from prf_u32's.
+PACK_SHIFTS = {1: (17, 16, 6), 2: (19, 20, 8)}
+
+# The two uint32 sub-laws that share the 10-bit-field assumption with the v1
+# coordinate packing, widened alongside it (spec §2 v2). Selected by the same
+# pack_version gate at every consumer, so n ≤ 1024 draws never move:
+#
+# - Range reduction (urn-family draws): v1 ``d = ((u >> 10)·R) >> 22`` needs
+#   R < 2^10 or the product leaves uint32; v2 ``d = ((u >> 12)·R) >> 20``
+#   admits R < 2^12 (n ≤ 4096) with the product still < 2^32.
+#   RED_SHIFTS[pack] = (pre_shift, post_shift).
+# - Packed sort keys (the §4 combined scheduling key's sender field, the §3.2
+#   faulty-rank key's replica field): v1 reserves the low 10 bits for the
+#   index; v2 reserves 12. KEY_LOW_BITS[pack] = index field width; the §4
+#   combined key's PRF field narrows to fit (20 → 18 bits).
+RED_SHIFTS = {1: (10, 22), 2: (12, 20)}
+KEY_LOW_BITS = {1: 10, 2: 12}
+# Rank mask for the §3.2 faulty-rank key ((rank & KEY_MASK[pack]) | replica):
+# the complement of the KEY_LOW_BITS index field, precomputed so the two
+# Python implementations (models/adversaries.py, core/adversary.py) share one
+# definition (native/simcore.cpp derives the same mask from key_low_bits()).
+KEY_MASK = {p: (0xFFFFFFFF >> low) << low for p, low in KEY_LOW_BITS.items()}
+
+
+def pack_version(n) -> int:
+    """The packing law a config of size ``n`` uses: the frozen v1 law for
+    every n ≤ 1024 (existing draws and goldens must never move), the §2 v2
+    law above it. A pure function of n so that all five stacks (oracle,
+    numpy, jax, Pallas, C++) derive the same gate from the same field."""
+    if n > V2_MAX_N:
+        raise ValueError(f"n={n} exceeds the v2 packing ceiling ({V2_MAX_N})")
+    return 1 if n <= V1_MAX_N else 2
 
 # Purposes (spec/PROTOCOL.md §2).
 INIT_EST = 0
@@ -107,7 +157,7 @@ def seed_key(seed):
     return np.uint32(seed & 0xFFFFFFFF), np.uint32((seed >> 32) & 0xFFFFFFFF)
 
 
-def prf_u32(seed, instance, rnd, step, recv, send, purpose, xp=np):
+def prf_u32(seed, instance, rnd, step, recv, send, purpose, xp=np, pack=1):
     """One PRF evaluation per spec/PROTOCOL.md §2.
 
     ``seed`` is a python int, or an already-split (k0, k1) key (tuple or (2,)
@@ -115,9 +165,13 @@ def prf_u32(seed, instance, rnd, step, recv, send, purpose, xp=np):
     are integers or integer arrays (mutually broadcastable). Returns uint32 of
     the broadcast shape.
 
-    Packing:
+    ``pack`` selects the packing law (:func:`pack_version`; a static python
+    int, never traced). v1 — the frozen original, every existing draw:
         x0 = (send << 17) | instance
         x1 = (rnd << 16) | (recv << 6) | (step << 4) | purpose
+    v2 (spec §2 v2, configs with n > 1024):
+        x0 = (send << 19) | instance
+        x1 = (rnd << 20) | (recv << 8) | (step << 4) | purpose
     """
     k0, k1 = seed_key(seed)
     u32 = xp.uint32
@@ -125,10 +179,17 @@ def prf_u32(seed, instance, rnd, step, recv, send, purpose, xp=np):
     rnd = xp.asarray(rnd, dtype=xp.uint32)
     recv = xp.asarray(recv, dtype=xp.uint32)
     send = xp.asarray(send, dtype=xp.uint32)
-    x0 = (send << u32(17)) | instance
-    x1 = (rnd << u32(16)) | (recv << u32(6)) | (u32(int(step) << 4)) | u32(int(purpose))
+    if pack == 1:
+        x0 = (send << u32(17)) | instance
+        x1 = (rnd << u32(16)) | (recv << u32(6)) | (u32(int(step) << 4)) | u32(int(purpose))
+    elif pack == 2:
+        x0 = (send << u32(19)) | instance
+        x1 = (rnd << u32(20)) | (recv << u32(8)) | (u32(int(step) << 4)) | u32(int(purpose))
+    else:
+        raise ValueError(f"unknown packing version {pack!r}")
     return threefry2x32(k0, k1, x0, x1, xp=xp)
 
 
-def prf_bit(seed, instance, rnd, step, recv, send, purpose, xp=np):
-    return prf_u32(seed, instance, rnd, step, recv, send, purpose, xp=xp) & xp.uint32(1)
+def prf_bit(seed, instance, rnd, step, recv, send, purpose, xp=np, pack=1):
+    return prf_u32(seed, instance, rnd, step, recv, send, purpose, xp=xp,
+                   pack=pack) & xp.uint32(1)

@@ -29,7 +29,8 @@ def byz_class_values(cfg, seed, inst_ids, rnd, t, honest, faulty, xp=np):
     send = xp.arange(cfg.n, dtype=xp.uint32)[None, :]
     out = []
     for h in (0, 1):
-        e = prf.prf_u32(seed, inst, rnd, t, h, send, prf.BYZ_VALUE, xp=xp)
+        e = prf.prf_u32(seed, inst, rnd, t, h, send, prf.BYZ_VALUE, xp=xp,
+                        pack=cfg.pack_version)
         vh = (e % xp.uint32(3)).astype(xp.uint8)
         out.append(xp.where(faulty, vh, honest).astype(xp.uint8))
     return out[0], out[1]
@@ -128,8 +129,12 @@ def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
     adaptive = cfg.adversary in ("adaptive", "adaptive_min")
 
     inst = xp.asarray(inst_ids, dtype=xp.uint32)[:, None]
-    s0 = prf.prf_u32(seed, inst, rnd, t, recv[None, :], 0, prf.URN, xp=xp)
+    s0 = prf.prf_u32(seed, inst, rnd, t, recv[None, :], 0, prf.URN, xp=xp,
+                     pack=cfg.pack_version)
     s0 = xp.broadcast_to(s0, (B, recv.shape[0])).astype(u32)
+    # Range-reduction shifts per packing law (spec §2 v2: urn sizes up to
+    # n-1 > 2^10 need the wider 12/20 split to stay inside uint32).
+    rs, rd = prf.RED_SHIFTS[cfg.pack_version]
 
     def step(j, carry):
         """General (two-stratum) draw — spec §4b verbatim."""
@@ -142,7 +147,7 @@ def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
         in_biased = b_rem > 0
         tot = (r0 + r1 + r2).astype(i32)
         R_cur = xp.where(in_biased, b_rem, tot - b_rem).astype(u32)
-        d = ((u >> u32(10)) * R_cur) >> u32(22)
+        d = ((u >> u32(rs)) * R_cur) >> u32(rd)
         # Remaining counts of the *active* stratum, in value order 0,1,2.
         e0 = xp.where(st[0] == in_biased, r0, 0).astype(u32)
         e1 = xp.where(st[1] == in_biased, r1, 0).astype(u32)
@@ -160,16 +165,17 @@ def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
         Algebraically identical draws to :func:`step` with st ≡ False: the urn
         size is deterministic (L − j: one live message leaves per active draw),
         so no remaining-count sum is needed, and the bot class r2 is never read
-        by the outputs, so it is not tracked. The two tracked counts fit in 10
-        bits each and ride one uint32 plane (r0 | r1 << 16) — a third less
-        loop-carry to stream between unroll segments.
+        by the outputs, so it is not tracked. The two tracked counts fit well
+        inside 16 bits each (≤ n ≤ 4096) and ride one uint32 plane
+        (r0 | r1 << 16) — a third less loop-carry to stream between unroll
+        segments.
         """
         s, packed = carry
         s = (s * u32(prf.URN_LCG_A) + u32(prf.URN_LCG_C)).astype(u32)
         u = s ^ (s >> u32(16))
         active = xp.asarray(j, dtype=i32) < D
         R_cur = (L - xp.asarray(j, dtype=i32)).astype(u32)  # garbage if inactive
-        d = ((u >> u32(10)) * R_cur) >> u32(22)
+        d = ((u >> u32(rs)) * R_cur) >> u32(rd)
         e0 = packed & u32(0xFFFF)
         pick0 = d < e0
         pick1 = ~pick0 & (d < e0 + (packed >> u32(16)))

@@ -25,7 +25,7 @@ from byzantinerandomizedconsensus_tpu.ops import prf, urn
 _UNROLL = 8
 
 
-def _chain(seed, inst_ids, rnd, t, recv, seg, m, Lr, Dr, xp):
+def _chain(seed, inst_ids, rnd, t, recv, seg, m, Lr, Dr, xp, pack=1):
     """One §4b-v2 segment: d ~ HG(Lr, m, Dr) via the corner-minimal chain.
 
     ``m``/``Lr``/``Dr`` are (B, R) int32 (non-negative). Returns (B, R) int32
@@ -43,18 +43,21 @@ def _chain(seed, inst_ids, rnd, t, recv, seg, m, Lr, Dr, xp):
     P = xp.where(is_draw, m, Dr).astype(u32)
 
     inst = xp.asarray(inst_ids, dtype=xp.uint32)[:, None]
-    s = prf.prf_u32(seed, inst, rnd, t, recv[None, :], seg, prf.URN2, xp=xp)
+    s = prf.prf_u32(seed, inst, rnd, t, recv[None, :], seg, prf.URN2, xp=xp,
+                    pack=pack)
     s = xp.broadcast_to(s, (B, recv.shape[0])).astype(u32)
     # zeros_like (not zeros): under shard_map the while_loop carry must enter
     # with the same device-variance as it leaves with, and ``a`` becomes
     # recv-varying after one draw.
     a = xp.zeros_like(s)
 
+    rs, rd = prf.RED_SHIFTS[pack]             # spec §2 v2: wide urns need 12/20
+
     def draw(j, s, a):
         s = (s * u32(prf.URN_LCG_A) + u32(prf.URN_LCG_C)).astype(u32)
         u = s ^ (s >> u32(16))
         den = (Lr - j).astype(u32)            # >= 1 while j < K; garbage masked
-        q = ((u >> u32(10)) * den) >> u32(22)
+        q = ((u >> u32(rs)) * den) >> u32(rd)
         acc = (q < (P - a)) & (j < K)
         return s, (a + acc.astype(u32)).astype(u32)
 
@@ -104,7 +107,8 @@ def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
         # Segments 0-1: biased stratum, values 0 then 1.
         Lr, Dr = Lb, Db
         for w in (0, 1):
-            d[w] = _chain(seed, inst_ids, rnd, t, recv, w, mb[w], Lr, Dr, xp)
+            d[w] = _chain(seed, inst_ids, rnd, t, recv, w, mb[w], Lr, Dr, xp,
+                          pack=cfg.pack_version)
             Lr = (Lr - mb[w]).astype(i32)
             Dr = (Dr - d[w]).astype(i32)
         # Segments 2-3: unbiased stratum, values 0 then 1.
@@ -112,7 +116,8 @@ def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
         Lr = (L - Lb).astype(i32)
         Dr = (D - Db).astype(i32)
         for w in (0, 1):
-            du = _chain(seed, inst_ids, rnd, t, recv, 2 + w, mu[w], Lr, Dr, xp)
+            du = _chain(seed, inst_ids, rnd, t, recv, 2 + w, mu[w], Lr, Dr, xp,
+                        pack=cfg.pack_version)
             d[w] = (d[w] + du).astype(i32)
             Lr = (Lr - mu[w]).astype(i32)
             Dr = (Dr - du).astype(i32)
@@ -121,7 +126,8 @@ def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
         # skipped; segment indices 2-3 are used for seeding per the spec.
         Lr, Dr = L, D
         for w in (0, 1):
-            d[w] = _chain(seed, inst_ids, rnd, t, recv, 2 + w, m[w], Lr, Dr, xp)
+            d[w] = _chain(seed, inst_ids, rnd, t, recv, 2 + w, m[w], Lr, Dr, xp,
+                          pack=cfg.pack_version)
             Lr = (Lr - m[w]).astype(i32)
             Dr = (Dr - d[w]).astype(i32)
 

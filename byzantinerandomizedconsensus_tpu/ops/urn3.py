@@ -66,7 +66,8 @@ def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
     inst = xp.asarray(inst_ids, dtype=xp.uint32)[:, None]
     # One PRF word per (instance, round, step, receiver); (B, 1) x (1, R)
     # broadcast yields the (B, R) lane plane directly.
-    u = prf.prf_u32(seed, inst, rnd, t, recv[None, :], 0, prf.URN3, xp=xp)
+    u = prf.prf_u32(seed, inst, rnd, t, recv[None, :], 0, prf.URN3, xp=xp,
+                    pack=cfg.pack_version)
 
     d = [None, None]  # total drops attributed to tracked values 0, 1
     if adaptive:

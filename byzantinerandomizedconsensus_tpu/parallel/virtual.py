@@ -1,0 +1,157 @@
+"""Virtual-mesh SPMD emulation — the host-side twin of parallel/sharded.py.
+
+Runs the (data, model) sharding layout of the jax shard_map driver in pure
+numpy, with each model shard on its own OS thread and ``gather`` implemented
+as a barrier + concatenate (a faithful all-gather: every shard blocks until
+all shards have contributed their (B, R_local) slab, then each reads the full
+(B, n) row). The data axis is plain instance partitioning (independent
+Monte-Carlo trials), exactly as on a real mesh.
+
+Purpose: the sharding *semantics* — state arrays carrying only a receiver
+shard, per-step all-gather of wire values, termination by cross-shard count —
+are what the PRF's global-coordinate addressing must survive (spec §2: a
+replica shard computes exactly the oracle's draws for its rows). This backend
+lets that property be asserted end-to-end on any host, including against the
+native C++ core at sizes where no accelerator (or no modern-jax install) is
+present — e.g. the (2, 2) mesh at n=2048 under the §2 v2 packing law
+(tests/test_packing.py, artifacts/n2048_r7.json). It executes the same
+models/ round bodies through the same ``recv_ids``/``gather`` seams as
+parallel/sharded.py's mapped function, so a semantic drift between the
+sharded program and the unsharded one shows up here without a TPU.
+
+This is a validation instrument, not a performance path: thread barriers per
+step cost far more than the numpy work they fence at small B.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu.backends.base import SimResult, SimulatorBackend
+from byzantinerandomizedconsensus_tpu.config import SimConfig
+from byzantinerandomizedconsensus_tpu.models import benor, bracha, state as state_mod
+from byzantinerandomizedconsensus_tpu.models.adversaries import AdversaryModel
+
+
+class _AllGather:
+    """Barrier-fenced all-gather along the model axis: shard ``m`` contributes
+    a (B, R_local) slab, every shard receives the (B, n) concatenation. Two
+    barrier phases per call (contribute, then read) so a shard cannot race
+    ahead and overwrite the slot list while a peer still reads it."""
+
+    def __init__(self, n_model: int):
+        self.n_model = n_model
+        self.slots: list[Optional[np.ndarray]] = [None] * n_model
+        self.barrier = threading.Barrier(n_model)
+
+    def __call__(self, m: int, v: np.ndarray) -> np.ndarray:
+        self.slots[m] = v
+        self.barrier.wait()
+        full = np.concatenate(self.slots, axis=-1)
+        self.barrier.wait()
+        return full
+
+
+def _run_data_shard(cfg: SimConfig, ids_local: np.ndarray, n_model: int):
+    """One data shard: n_model lockstep model-shard threads over ids_local.
+    Returns (rounds, decision) for the shard."""
+    n = cfg.n
+    if n % n_model:
+        raise ValueError(f"n={n} not divisible by model-axis size {n_model}")
+    n_local = n // n_model
+    round_body = benor.round_body if cfg.protocol == "benor" else bracha.round_body
+    ag = _AllGather(n_model)
+    adv = AdversaryModel(cfg)
+    # Adversary setup is sender-width (full (B, n)) on every shard, exactly as
+    # in parallel/sharded.py's mapped function.
+    setup = adv.setup(cfg.seed, ids_local, xp=np)
+    faulty = setup["faulty"]
+    states: list[Optional[dict]] = [None] * n_model
+    done_b = threading.Barrier(n_model)
+    B = ids_local.shape[0]
+    decided_counts = np.zeros((n_model, B), dtype=np.int32)
+    done_at = np.full(B, -1, dtype=np.int32)
+
+    errors: list[BaseException] = []
+
+    def worker(m: int):
+        try:
+            recv_ids = np.arange(m * n_local, (m + 1) * n_local,
+                                 dtype=np.uint32)
+            st = state_mod.init_state(cfg, cfg.seed, ids_local, xp=np,
+                                      recv_ids=recv_ids)
+            faulty_local = faulty[:, m * n_local:(m + 1) * n_local]
+            for r in range(cfg.round_cap):
+                st = round_body(cfg, cfg.seed, ids_local, r, st, adv, setup,
+                                xp=np, recv_ids=recv_ids,
+                                gather=lambda v: ag(m, v))
+                # psum equivalent: every shard contributes its decided count,
+                # the full-mesh sum decides termination for all shards alike.
+                decided_counts[m] = (st["decided"] | faulty_local).sum(
+                    axis=-1, dtype=np.int32)
+                done_b.wait()
+                if m == 0:
+                    cnt = decided_counts.sum(axis=0)
+                    np.copyto(
+                        done_at,
+                        np.where((done_at < 0) & (cnt == n), r + 1, done_at))
+                done_b.wait()
+                if np.all(done_at >= 0):
+                    break
+            states[m] = st
+        except threading.BrokenBarrierError:
+            return  # a sibling shard failed and aborted the barriers
+        except BaseException as e:  # noqa: BLE001 — re-raised on the main thread
+            errors.append(e)
+            # Abort both barriers so sibling shards blocked in wait() unwind
+            # (as BrokenBarrierError) instead of deadlocking the process.
+            ag.barrier.abort()
+            done_b.abort()
+
+    threads = [threading.Thread(target=worker, args=(m,))
+               for m in range(n_model)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise RuntimeError(
+            f"virtual-mesh shard died: {errors[0]!r}") from errors[0]
+    if any(s is None for s in states):
+        raise RuntimeError("virtual-mesh shard died (see thread traceback)")
+    # Reassemble full-width state; decision per spec §1 (lowest-indexed
+    # correct replica), as in the sharded driver's psum-select.
+    decided_val = np.concatenate([s["decided_val"] for s in states], axis=-1)
+    done = done_at >= 0
+    rounds = np.where(done, done_at, cfg.round_cap).astype(np.int32)
+    first_correct = np.argmax(~faulty, axis=-1)
+    val = np.take_along_axis(decided_val, first_correct[:, None], axis=-1)[:, 0]
+    decision = np.where(done, val, 2).astype(np.uint8)
+    return rounds, decision
+
+
+class VirtualMeshBackend(SimulatorBackend):
+    """``virtual:DxM`` — D data shards × M model (replica) shards, threads."""
+
+    name = "virtual"
+
+    def __init__(self, n_data: int = 2, n_model: int = 2):
+        self.n_data = max(1, n_data)
+        self.n_model = max(1, n_model)
+
+    def run(self, cfg: SimConfig, inst_ids: Optional[np.ndarray] = None) -> SimResult:
+        cfg = cfg.validate()
+        ids = self._resolve_inst_ids(cfg, inst_ids)
+        rounds = np.empty(len(ids), dtype=np.int32)
+        decision = np.empty(len(ids), dtype=np.uint8)
+        for sl in np.array_split(np.arange(len(ids)), self.n_data):
+            if not len(sl):
+                continue
+            r, d = _run_data_shard(cfg, ids[sl], self.n_model)
+            rounds[sl] = r
+            decision[sl] = d
+        return SimResult(config=cfg, inst_ids=ids, rounds=rounds,
+                         decision=decision)

@@ -1,0 +1,179 @@
+"""Count-level cost curve past the v1 packing edge (ISSUE 2 / VERDICT r5 next #4).
+
+Measures the config-5 shape (bracha, adaptive, shared coin, f = (n-1)//3) at
+n ∈ {512, 1024, 2048} — the last point only reachable through the spec §2 v2
+packing — under the §4b-v2 ``urn2`` chains and the §4c ``urn3`` cheap law,
+with the shared product methodology (tools/product.run_config: warmed
+best-of-N walls, device-busy leg or its honest error, rounds histogram).
+
+Why this shape: the §4b-v2 chains pay ``K = min(m, L−m, D)`` per segment,
+which on near-balanced wires degenerates to the full ``K = D`` — and D grows
+like n/3 along the config-5 curve while §4c stays O(1) per receiver-step. The
+n=2048 point is where that asymptotic separation first gets room to show
+(docs/PERF.md round 7 reads the bend off this artifact).
+
+The artifact also carries the **(2, 2) virtual-mesh sharded bit-match vs
+native** at n=2048 (parallel/virtual.py — the host-side SPMD emulation of the
+sharded layout; the jax shard_map leg needs a modern jax + device session and
+is recorded as blocked when absent), so the wide-n point lands with its
+correctness evidence attached, not just its timings.
+
+    python -m byzantinerandomizedconsensus_tpu.tools.cost_curve
+
+writes ``artifacts/n2048_r{N}.json``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import pathlib
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu.config import sweep_point
+from byzantinerandomizedconsensus_tpu.tools.product import run_config
+from byzantinerandomizedconsensus_tpu.utils.rounds import default_artifact
+
+
+def _point(n: int, delivery: str, instances: int, backend: str,
+           round_cap: int | None = None) -> dict:
+    cfg = dataclasses.replace(sweep_point(n, instances=instances),
+                              delivery=delivery)
+    if round_cap is not None:
+        cfg = dataclasses.replace(cfg, round_cap=round_cap)
+    cfg = cfg.validate()
+    entry, raw_walls = run_config(cfg, backend)
+    entry["_wall_raw"] = min(raw_walls)
+    entry["n"] = n
+    entry["f"] = cfg.f
+    entry["delivery"] = delivery
+    entry["pack_version"] = cfg.pack_version
+    return entry
+
+
+def sharded_bitmatch_n2048(delivery: str, instances: int, mesh: str = "2x2") -> dict:
+    """(2, 2) virtual-mesh vs native bit-match record for the artifact."""
+    from byzantinerandomizedconsensus_tpu.backends import get_backend
+
+    cfg = dataclasses.replace(
+        sweep_point(2048, instances=instances), delivery=delivery).validate()
+    try:
+        a = get_backend(f"virtual:{mesh}").run(cfg)
+        b = get_backend("native").run(cfg)
+        match = bool(np.array_equal(a.rounds, b.rounds)
+                     and np.array_equal(a.decision, b.decision))
+        return {"mesh": mesh, "delivery": delivery, "instances": instances,
+                "match": match}
+    except Exception as e:  # no g++, etc. — record, don't die mid-artifact
+        return {"mesh": mesh, "delivery": delivery, "error": repr(e)}
+
+
+def jax_sharded_leg(delivery: str, instances: int) -> dict:
+    """The real shard_map leg — runs when the installed jax has the API and
+    devices; records the blocker otherwise (same honesty convention as the
+    device-busy error entries)."""
+    from byzantinerandomizedconsensus_tpu.backends import get_backend
+
+    cfg = dataclasses.replace(
+        sweep_point(2048, instances=instances), delivery=delivery).validate()
+    try:
+        a = get_backend("jax_sharded:2").run(cfg)
+        b = get_backend("native").run(cfg)
+        match = bool(np.array_equal(a.rounds, b.rounds)
+                     and np.array_equal(a.decision, b.decision))
+        return {"backend": "jax_sharded:2", "delivery": delivery,
+                "instances": instances, "match": match}
+    except Exception as e:
+        return {"backend": "jax_sharded:2", "delivery": delivery,
+                "blocked": repr(e)}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default=default_artifact("n2048"))
+    ap.add_argument("--backend", default="jax")
+    ap.add_argument("--ns", nargs="*", type=int, default=[512, 1024, 2048])
+    ap.add_argument("--deliveries", nargs="*", default=["urn2", "urn3"])
+    ap.add_argument("--instances", type=int, default=2000,
+                    help="instances per timed point (config-5's sweep count)")
+    ap.add_argument("--bitmatch-instances", type=int, default=8)
+    args = ap.parse_args(argv)
+
+    from byzantinerandomizedconsensus_tpu.utils.devices import ensure_live_backend
+
+    ensure_live_backend()
+    import jax
+
+    legs = []
+    for n in args.ns:
+        for d in args.deliveries:
+            e = _point(n, d, args.instances, args.backend)
+            print(json.dumps({k: v for k, v in e.items()
+                              if k != "round_histogram"}), flush=True)
+            legs.append(e)
+
+    # Pairwise per-n comparison (urn3 relative to urn2, >1 = urn3 faster),
+    # from unrounded walls; device ratio only when both legs measured one.
+    curve = {}
+    for n in args.ns:
+        by_d = {e["delivery"]: e for e in legs if e["n"] == n}
+        if "urn2" in by_d and "urn3" in by_d:
+            u, v = by_d["urn2"], by_d["urn3"]
+            cmp = {"wall_speedup_urn3_vs_urn2":
+                   round(u["_wall_raw"] / v["_wall_raw"], 3)
+                   if v["_wall_raw"] > 0 else None,
+                   "mean_rounds_delta": round(
+                       v["mean_rounds_decided"] - u["mean_rounds_decided"], 4)}
+            if u.get("device_busy_s", 0) and v.get("device_busy_s", 0):
+                cmp["device_busy_speedup_urn3_vs_urn2"] = round(
+                    u["device_busy_s"] / v["device_busy_s"], 3)
+            curve[str(n)] = cmp
+            print(json.dumps({f"n{n}": cmp}), flush=True)
+    # Per-delivery wall scaling across n (cost per instance-step, normalized
+    # to the smallest measured n) — the curve whose bend PERF.md reads.
+    scaling = {}
+    for d in args.deliveries:
+        pts = sorted((e for e in legs if e["delivery"] == d),
+                     key=lambda e: e["n"])
+        if len(pts) >= 2 and pts[0]["_wall_raw"] > 0:
+            base = pts[0]
+            scaling[d] = {
+                str(e["n"]): round(e["_wall_raw"] / base["_wall_raw"], 3)
+                for e in pts}
+    bitmatch = [sharded_bitmatch_n2048(d, args.bitmatch_instances)
+                for d in args.deliveries]
+    jax_leg = jax_sharded_leg(args.deliveries[0], args.bitmatch_instances)
+    for leg in legs:
+        leg.pop("_wall_raw", None)
+        # Keep one histogram per delivery at the headline n only — the point
+        # the artifact exists for; smaller-n histograms live in the sweeps.
+        if leg["n"] != max(args.ns):
+            leg.pop("round_histogram", None)
+
+    doc = {
+        "description": "count-level cost curve past the v1 packing edge "
+                       "(spec §2 v2): config-5 shape at n=512/1024/2048, "
+                       "urn2 vs urn3, walls + device-busy-or-error + "
+                       "rounds histograms at the headline n, with the (2,2) "
+                       "virtual-mesh sharded bit-match vs native "
+                       "(tools/cost_curve.py)",
+        "platform": jax.default_backend(),
+        "backend": args.backend,
+        "instances": args.instances,
+        "legs": legs,
+        "urn3_vs_urn2_by_n": curve,
+        "wall_scaling_vs_smallest_n": scaling,
+        "sharded_bitmatch_virtual_2x2_n2048": bitmatch,
+        "sharded_bitmatch_jax_shard_map": jax_leg,
+    }
+    out = pathlib.Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(doc, indent=1) + "\n")
+    print(json.dumps({"out": str(out)}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

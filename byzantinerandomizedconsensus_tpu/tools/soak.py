@@ -1,0 +1,158 @@
+"""Randomized differential soak — the committed instrument behind the
+"N configs, 0 mismatches" claims (ROADMAP / VERDICT r5 next #3).
+
+A seeded random config generator sweeps the full semantic surface at small n
+(protocols × adversaries × coins × inits × all four delivery models, n ≤ 40,
+both packing-law sides are out of range here by construction — n ≤ 40 is
+always v1) and runs every config through the vectorized numpy backend and the
+native C++ core, asserting the per-instance (rounds, decision) arrays equal
+bit-for-bit. Every ``--oracle-every``-th config additionally runs a subsample
+of instances through the scalar CPU oracle — the third independent
+implementation — anchoring the pair to the spec, not just to each other.
+
+One command reproduces the claim and stamps the artifact:
+
+    python -m byzantinerandomizedconsensus_tpu.tools.soak --configs 120
+
+writes ``artifacts/soak_r{N}.json`` with the seed, the generator version, the
+per-family config tally and the mismatch list (empty = the claim). The
+reduced CI leg is tests/test_soak.py (a handful of configs, every delivery).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import pathlib
+import random
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu.backends import get_backend
+from byzantinerandomizedconsensus_tpu.config import DELIVERY_KINDS, SimConfig
+from byzantinerandomizedconsensus_tpu.utils.rounds import default_artifact
+
+# Bumped whenever the draw sequence below changes shape: an artifact's config
+# population is reproducible only by (generator_version, seed) together.
+GENERATOR_VERSION = 1
+
+MAX_SOAK_N = 40
+
+_PROTOCOLS = ("benor", "bracha")
+_ADVERSARIES = ("none", "crash", "byzantine", "adaptive", "adaptive_min")
+_COINS = ("local", "shared")
+_INITS = ("random", "all0", "all1", "split")
+
+
+def _f_ceiling(protocol: str, adversary: str, n: int) -> int:
+    """Largest valid f for the resilience bound (config.validate §5.1/§5.2)."""
+    lying = adversary in ("byzantine", "adaptive", "adaptive_min")
+    if protocol == "bracha":
+        return (n - 1) // 3
+    if lying:
+        return (n - 1) // 5
+    return (n - 1) // 2
+
+
+def random_config(rng: random.Random) -> SimConfig:
+    """One uniform-ish draw over the supported semantic surface, n ≤ 40."""
+    while True:
+        protocol = rng.choice(_PROTOCOLS)
+        adversary = rng.choice(_ADVERSARIES)
+        n = rng.randrange(4, MAX_SOAK_N + 1)
+        fmax = _f_ceiling(protocol, adversary, n)
+        if fmax < 1 and adversary != "none":
+            continue  # too small to host a faulty set; redraw
+        f = rng.randrange(0, fmax + 1) if adversary == "none" \
+            else rng.randrange(1, fmax + 1)
+        return SimConfig(
+            protocol=protocol, n=n, f=f,
+            instances=rng.randrange(8, 33),
+            adversary=adversary,
+            coin=rng.choice(_COINS),
+            init=rng.choice(_INITS),
+            seed=rng.randrange(1 << 32),
+            round_cap=rng.choice((32, 64, 128)),
+            delivery=rng.choice(DELIVERY_KINDS),
+        ).validate()
+
+
+def run_soak(n_configs: int, seed: int = 0, oracle_every: int = 10,
+             oracle_instances: int = 3, progress=print) -> dict:
+    """Run the differential; returns the artifact document (never raises on a
+    mismatch — a soak must report every divergence it finds, not stop at the
+    first)."""
+    rng = random.Random(seed)
+    mismatches = []
+    by_delivery: dict[str, int] = {d: 0 for d in DELIVERY_KINDS}
+    by_adversary: dict[str, int] = {a: 0 for a in _ADVERSARIES}
+    oracle_checked = 0
+    numpy_be = get_backend("numpy")
+    native_be = get_backend("native")
+    cpu_be = get_backend("cpu")
+
+    for k in range(n_configs):
+        cfg = random_config(rng)
+        by_delivery[cfg.delivery] += 1
+        by_adversary[cfg.adversary] += 1
+        a = numpy_be.run(cfg)
+        b = native_be.run(cfg)
+        ok = (np.array_equal(a.rounds, b.rounds)
+              and np.array_equal(a.decision, b.decision))
+        record = None
+        if not ok:
+            record = {"config": dataclasses.asdict(cfg),
+                      "leg": "numpy_vs_native"}
+        elif k % max(1, oracle_every) == 0:
+            ids = np.arange(min(oracle_instances, cfg.instances),
+                            dtype=np.int64)
+            c = cpu_be.run(cfg, ids)
+            oracle_checked += 1
+            if not (np.array_equal(a.rounds[: len(ids)], c.rounds)
+                    and np.array_equal(a.decision[: len(ids)], c.decision)):
+                record = {"config": dataclasses.asdict(cfg),
+                          "leg": "numpy_vs_oracle"}
+        if record is not None:
+            mismatches.append(record)
+            progress(f"soak[{k}]: MISMATCH {record['leg']} {cfg}")
+        elif (k + 1) % 25 == 0:
+            progress(f"soak[{k + 1}/{n_configs}]: 0 mismatches so far")
+
+    return {
+        "description": "randomized numpy-vs-native differential with a scalar"
+                       "-oracle subsample (tools/soak.py; VERDICT r5 next #3)",
+        "generator_version": GENERATOR_VERSION,
+        "seed": seed,
+        "configs": n_configs,
+        "oracle_subsampled_configs": oracle_checked,
+        "oracle_instances_per_check": oracle_instances,
+        "by_delivery": by_delivery,
+        "by_adversary": by_adversary,
+        "mismatches": mismatches,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--configs", type=int, default=120)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--oracle-every", type=int, default=10,
+                    help="every k-th config also runs an oracle subsample")
+    ap.add_argument("--oracle-instances", type=int, default=3)
+    ap.add_argument("--out", default=default_artifact("soak"))
+    args = ap.parse_args(argv)
+
+    doc = run_soak(args.configs, seed=args.seed,
+                   oracle_every=args.oracle_every,
+                   oracle_instances=args.oracle_instances)
+    out = pathlib.Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(doc, indent=1) + "\n")
+    print(json.dumps({"out": str(out),
+                      "mismatches": len(doc["mismatches"])}))
+    return 1 if doc["mismatches"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

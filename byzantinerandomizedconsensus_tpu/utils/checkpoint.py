@@ -22,11 +22,16 @@ def shard_name(cfg: SimConfig, lo: int, hi: int) -> str:
     # naming scheme; keys / the default cap keep the legacy name so existing
     # sweep checkpoints stay resumable. A non-default cap MUST be encoded:
     # round histograms and the overflow bucket depend on it, so a resumed
-    # sweep may never reuse shards computed under a different cap.
+    # sweep may never reuse shards computed under a different cap. Likewise
+    # the spec §2 packing version: v1 (every n ≤ 1024 config) keeps the
+    # legacy name; a v2 config carries the "_p2" token so that if the v2 law
+    # ever revs, stale wide-n shards are detectable instead of silently
+    # resuming a different draw sequence (utils/sweep._warn_stale_shards).
     deliv = "" if cfg.delivery == "keys" else f"_{cfg.delivery}"
     cap = "" if cfg.round_cap == DEFAULT_ROUND_CAP else f"_c{cfg.round_cap}"
+    pack = "" if cfg.pack_version == 1 else f"_p{cfg.pack_version}"
     return (f"{cfg.protocol}_n{cfg.n}_f{cfg.f}_{cfg.adversary}_{cfg.coin}"
-            f"{deliv}{cap}_s{cfg.seed}_i{lo}-{hi}.npz")
+            f"{deliv}{cap}{pack}_s{cfg.seed}_i{lo}-{hi}.npz")
 
 
 def save_shard(out_dir: pathlib.Path, cfg: SimConfig, res: SimResult) -> pathlib.Path:

@@ -1,8 +1,9 @@
 """Multi-seed sharding for runs beyond the PRF packing limit (spec §2).
 
-The counter packing caps one seed at 2^17 instances; larger Monte-Carlo totals
-shard across *derived seeds* — shard k simulates ``instances_k ≤ MAX_INSTANCES``
-instances under ``seed_k = splitmix64(seed + k)``, and per-shard results remain
+The counter packing caps one seed at 2^17 instances (2^16 under the §2 v2
+wide-n law); larger Monte-Carlo totals shard across *derived seeds* — shard k
+simulates ``instances_k ≤`` the cap under ``seed_k = splitmix64(seed + k)``,
+and per-shard results remain
 individually bit-matchable (a shard is just an ordinary run of its derived
 config). SplitMix64 (Steele et al., OOPSLA 2014) is the standard seed-spacing
 finaliser; consecutive inputs map to statistically independent outputs.
@@ -34,7 +35,7 @@ def shard_seed(seed: int, k: int) -> int:
 
 
 def run_large(cfg: SimConfig, total_instances: int, backend: str = "jax",
-              shard_instances: int = prf.MAX_INSTANCES, progress=None):
+              shard_instances: int = 0, progress=None):
     """Run ``total_instances`` Monte-Carlo trials of ``cfg`` across derived seeds.
 
     Returns ``(result, shards)``: ``result`` is a merged :class:`SimResult`
@@ -46,7 +47,11 @@ def run_large(cfg: SimConfig, total_instances: int, backend: str = "jax",
     """
     if total_instances <= 0:
         raise ValueError("total_instances must be positive")
-    shard_instances = min(shard_instances, prf.MAX_INSTANCES)
+    # The per-seed instance ceiling depends on the spec §2 packing law the
+    # config draws under (v2 narrows the instance field); 0 = "the cap".
+    per_seed_cap = prf.MAX_INSTANCES if cfg.pack_version == 1 \
+        else prf.V2_MAX_INSTANCES
+    shard_instances = min(shard_instances or per_seed_cap, per_seed_cap)
     be = get_backend(backend)
     rounds, decisions, shards = [], [], []
     k = 0

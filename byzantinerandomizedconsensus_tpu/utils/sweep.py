@@ -65,14 +65,18 @@ def run_sweep(
 def _warn_stale_shards(out_dir: pathlib.Path, delivery: str, round_cap: int,
                        progress) -> None:
     """Surface checkpoint shards that cannot resume under the current delivery
-    model or round cap — e.g. keys-named shards from before the urn default
-    flip, or cap-128 shards against a cap-256 sweep. They are ignored (shard
-    names encode both fields; a different cap MUST invalidate shards — see
-    checkpoint.shard_name), which silently restarts the sweep from zero unless
-    the user is told."""
+    model, round cap, or packing version — e.g. keys-named shards from before
+    the urn default flip, cap-128 shards against a cap-256 sweep, or wide-n
+    shards whose "_pN" token names a different spec §2 packing law than the
+    current code derives for their n. They are ignored (shard names encode all
+    three fields — see checkpoint.shard_name), which silently restarts the
+    sweep from zero unless the user is told."""
+    from byzantinerandomizedconsensus_tpu.ops import prf
+
     if not out_dir.is_dir():
         return
     stale = []
+    pack_stale = []
     for p in out_dir.glob("*.npz"):
         if "_urn3_" in p.name:
             named_delivery = "urn3"
@@ -82,9 +86,21 @@ def _warn_stale_shards(out_dir: pathlib.Path, delivery: str, round_cap: int,
             named_delivery = "urn"
         else:
             named_delivery = "keys"  # legacy names carry no delivery token
-        m = re.search(r"_c(\d+)_s", p.name)
+        m = re.search(r"_c(\d+)_", p.name)
         named_cap = int(m.group(1)) if m else DEFAULT_ROUND_CAP  # legacy names
-        if delivery != named_delivery or named_cap != round_cap:
+        # Packing-version token: legacy (token-less) names are v1 shards. A
+        # shard whose token disagrees with what pack_version(n) derives today
+        # was written under a different §2 law and may never resume.
+        m_p = re.search(r"_p(\d+)_s", p.name)
+        named_pack = int(m_p.group(1)) if m_p else 1
+        m_n = re.search(r"_n(\d+)_", p.name)
+        try:
+            current_pack = prf.pack_version(int(m_n.group(1))) if m_n else 1
+        except ValueError:  # n beyond any law this code knows — stale by definition
+            current_pack = -1
+        if named_pack != current_pack:
+            pack_stale.append(p.name)
+        elif delivery != named_delivery or named_cap != round_cap:
             stale.append(p.name)
     if stale:
         progress(
@@ -92,6 +108,13 @@ def _warn_stale_shards(out_dir: pathlib.Path, delivery: str, round_cap: int,
             f"different delivery model or round cap (e.g. {stale[0]}) and will "
             f"NOT resume this delivery={delivery!r} round_cap={round_cap} sweep; "
             "pass matching --delivery/--round-cap or use a fresh --out directory")
+    if pack_stale:
+        progress(
+            f"warning: {len(pack_stale)} checkpoint shard(s) in {out_dir} carry "
+            f"a stale spec §2 packing-version token (e.g. {pack_stale[0]}): "
+            "they were written under a different packing law than the current "
+            "code uses at their n and will NOT resume; re-run those points in "
+            "a fresh --out directory")
 
 
 def _merge(cfg, shards):

@@ -70,16 +70,27 @@ constexpr uint32_t kCoinStep = 3;
 constexpr uint32_t kUrnLcgA = 0x915F77F5u;
 constexpr uint32_t kUrnLcgC = 0x6A09E667u;
 
+// The key carries the spec §2 packing version (1 or 2) alongside the split
+// seed, so every prf_u32 call site stays a pure function of (key, coords)
+// without threading an extra argument through the whole round body.
 struct Key {
   uint32_t k0, k1;
+  uint32_t pack;  // spec §2 packing law: 1 (n <= 1024, frozen) or 2 (§2 v2)
 };
 
-// Field packing per spec §2: x0 = (send << 17) | instance,
-// x1 = (rnd << 16) | (recv << 6) | (step << 4) | purpose.
+// Field packing per spec §2.
+//   v1: x0 = (send << 17) | instance,
+//       x1 = (rnd << 16) | (recv << 6) | (step << 4) | purpose
+//   v2 (spec §2 v2, configs with n > 1024):
+//       x0 = (send << 19) | instance,
+//       x1 = (rnd << 20) | (recv << 8) | (step << 4) | purpose
 inline uint32_t prf_u32(Key k, uint32_t instance, uint32_t rnd, uint32_t step,
                         uint32_t recv, uint32_t send, uint32_t purpose) {
-  const uint32_t x0 = (send << 17) | instance;
-  const uint32_t x1 = (rnd << 16) | (recv << 6) | (step << 4) | purpose;
+  const uint32_t x0 = (k.pack == 2) ? (send << 19) | instance
+                                    : (send << 17) | instance;
+  const uint32_t x1 = (k.pack == 2)
+      ? (rnd << 20) | (recv << 8) | (step << 4) | purpose
+      : (rnd << 16) | (recv << 6) | (step << 4) | purpose;
   return threefry2x32(k.k0, k.k1, x0, x1);
 }
 
@@ -87,6 +98,16 @@ inline uint32_t prf_bit(Key k, uint32_t instance, uint32_t rnd, uint32_t step,
                         uint32_t recv, uint32_t send, uint32_t purpose) {
   return prf_u32(k, instance, rnd, step, recv, send, purpose) & 1u;
 }
+
+// Sub-laws widened with the v2 packing (spec §2 v2; ops/prf.py RED_SHIFTS /
+// KEY_LOW_BITS): the urn range reduction (v1 needs R < 2^10 to keep the
+// product in uint32; v2 uses 12/20 for R < 2^12) and the packed sort keys'
+// index field width (sender/replica: 10 | 12 bits).
+inline uint32_t range_reduce(Key k, uint32_t u, uint32_t R) {
+  return (k.pack == 2) ? ((u >> 12) * R) >> 20 : ((u >> 10) * R) >> 22;
+}
+
+inline int key_low_bits(Key k) { return (k.pack == 2) ? 12 : 10; }
 
 // ------------------------------------------------------------------- config
 
@@ -178,7 +199,8 @@ void setup_instance(const Cfg& cfg, Key k, uint32_t inst, Scratch& s) {
     for (int j = 0; j < n; ++j) {
       const uint32_t rank =
           prf_u32(k, inst, 0, 0, uint32_t(j), 0, kFaultyRank);
-      s.keys[j] = (rank & 0xFFFFFC00u) | uint32_t(j);
+      s.keys[j] = (rank & ((0xFFFFFFFFu >> key_low_bits(k)) << key_low_bits(k)))
+                  | uint32_t(j);
     }
     s.combined = s.keys;  // scratch copy for nth_element
     std::nth_element(s.combined.begin(), s.combined.begin() + (cfg.f - 1),
@@ -338,12 +360,15 @@ void deliver_and_tally(const Cfg& cfg, Key k, uint32_t inst, uint32_t rnd,
   const int n_deliver = n - f;
   for (int v = 0; v < n; ++v) {
     const uint8_t* bias_row = s.bias_per_recv ? &s.bias[size_t(v) * n] : nullptr;
+    const int low = key_low_bits(k);      // sender field: 10 | 12 bits (§2 v2)
+    const int top = 30 - low;             // prf field: 20 | 18 bits
     for (int j = 0; j < n; ++j) {
       const uint32_t sched =
           prf_u32(k, inst, rnd, t, uint32_t(v), uint32_t(j), kSched);
       const uint32_t b = bias_row ? bias_row[j] : 0u;
       s.combined[j] = (uint32_t(s.silent[j]) << 31) | (b << 30) |
-                      (((sched >> 12) & 0xFFFFFu) << 10) | uint32_t(j);
+                      (((sched >> (32 - top)) & ((1u << top) - 1u)) << low) |
+                      uint32_t(j);
     }
     s.combined[v] = uint32_t(v);  // own message always delivered (spec §4)
     s.keys = s.combined;          // keep original keys; nth_element permutes
@@ -398,7 +423,7 @@ void urn_deliver_and_tally(const Cfg& cfg, Key k, uint32_t inst, uint32_t rnd,
                         (st[2] ? rem[2] : 0);
       const bool in_biased = b_rem > 0;
       const int r_cur = in_biased ? b_rem : (rem[0] + rem[1] + rem[2]) - b_rem;
-      const uint32_t d = ((u >> 10) * uint32_t(r_cur)) >> 22;
+      const uint32_t d = range_reduce(k, u, uint32_t(r_cur));
       const uint32_t e0 = (st[0] == in_biased) ? uint32_t(rem[0]) : 0u;
       const uint32_t e1 = (st[1] == in_biased) ? uint32_t(rem[1]) : 0u;
       const int w = (d < e0) ? 0 : ((d < e0 + e1) ? 1 : 2);
@@ -437,7 +462,7 @@ inline int hg_chain(Key k, uint32_t inst, uint32_t rnd, uint32_t t, uint32_t v,
   for (int j = 0; j < K; ++j) {
     s = s * kUrnLcgA + kUrnLcgC;
     const uint32_t u = s ^ (s >> 16);
-    const uint32_t q = ((u >> 10) * uint32_t(Lr - j)) >> 22;
+    const uint32_t q = range_reduce(k, u, uint32_t(Lr - j));
     if (q < uint32_t(P - a)) ++a;
   }
   return is_comp ? (Dr - a) : a;
@@ -690,11 +715,12 @@ extern "C" {
 // rounds_out (int32) and decision_out (uint8), both length `count`.
 void sim_run(int protocol, int n, int f, int adversary, int coin, int init,
              uint64_t seed, int round_cap, int crash_window, int delivery,
-             const int64_t* ids, int64_t count, int n_threads,
+             int pack, const int64_t* ids, int64_t count, int n_threads,
              int32_t* rounds_out, uint8_t* decision_out) {
   const Cfg cfg{protocol, n,    f,         adversary,   coin,
                 init,     seed, round_cap, crash_window, delivery};
-  const Key k{uint32_t(seed & 0xFFFFFFFFu), uint32_t((seed >> 32) & 0xFFFFFFFFu)};
+  const Key k{uint32_t(seed & 0xFFFFFFFFu), uint32_t((seed >> 32) & 0xFFFFFFFFu),
+              uint32_t(pack)};
 
   if (n_threads < 1) n_threads = 1;
   if (int64_t(n_threads) > count) n_threads = int(count);
@@ -723,6 +749,8 @@ void sim_run(int protocol, int n, int f, int adversary, int coin, int init,
 
 // ABI version stamp so the Python loader can detect stale cached builds.
 // v4: delivery enum grew kUrn3Delivery (spec §4c).
-int sim_abi_version() { return 4; }
+// v5: sim_run takes the spec §2 packing version (1 = frozen original law for
+//     n <= 1024, 2 = §2 v2 wide-recv/send law) in the call contract.
+int sim_abi_version() { return 5; }
 
 }  // extern "C"

@@ -23,7 +23,7 @@ Bracha's echo/ready/accept protocol [Bracha, Information & Computation 75, 1987]
   reproduce the count-level oracle (backends/cpu.py) exactly: per-step RBC
   outcomes equal the count-level wire, the per-receiver deliveries equal the
   count-level model under the delivery-realizing schedule (the §4 mask rows via
-  :func:`_make_mask_hold`, or the §4b/§4b-v2 per-class delivered-count vectors
+  :func:`_make_mask_hold`, or the §4b/§4b-v2/§4c per-class delivered-count vectors
   via :func:`_make_counts_hold` — VERDICT r4 #3), and the final
   (rounds, decision) equals ``CpuBackend.run``.
 
@@ -359,7 +359,7 @@ def _make_mask_hold(mask) -> Callable[[Engine, Msg], bool]:
 
 
 def _make_counts_hold(values, silent_all, targets) -> Callable[[Engine, Msg], bool]:
-    """Scheduler realizing a §4b/§4b-v2 delivered-count vector at message level
+    """Scheduler realizing a count-level delivered-count vector at message level
     — the count-domain analog of :func:`_make_mask_hold` (VERDICT r4 #3): the
     accept-causing READY of a live non-own sender whose wire-value class is
     already full at the receiver is withheld until the receiver's whole quota
@@ -396,7 +396,7 @@ def _make_counts_hold(values, silent_all, targets) -> Callable[[Engine, Msg], bo
 
 def _urn_counts_and_targets(cfg, net, adv, r: int, t: int, honest, values,
                             silent_all):
-    """Count-level §4b/§4b-v2 delivery for one step: the (c0, c1) arrays from
+    """Count-level §4b/§4b-v2/§4c delivery for one step: the (c0, c1) arrays from
     the oracle's urn sampler (strata per adversary, mirroring backends/cpu.py)
     plus the per-receiver non-own per-class targets they induce."""
     n, f = cfg.n, cfg.f
@@ -406,15 +406,14 @@ def _urn_counts_and_targets(cfg, net, adv, r: int, t: int, honest, values,
         strata, minority = "minority", adv.observed_minority(honest)
     else:
         strata, minority = "none", 0
-    if cfg.delivery == "urn3":
-        # The count-realizing hold machinery realizes the §4b-family law; a
-        # §4c-aware hold (clamped-law counts are still within the delivered
-        # quota, so one should exist) is future work — fail loudly rather
-        # than silently realize the wrong model's counts (ROADMAP open item).
-        raise NotImplementedError(
-            "message-level realization of the §4c cheap law is not built; "
-            "use delivery='urn'/'urn2' for the count-realizing instrument")
-    counts = net.urn_counts if cfg.delivery == "urn" else net.urn2_counts
+    # The hold machinery (:func:`_make_counts_hold`) is law-agnostic: it
+    # realizes ANY feasible per-class count vector (t_w ≤ m_w, Σ t_w =
+    # min(L, n−f−1)). The §4c cheap law's support clamp guarantees exactly
+    # that feasibility (d_w ∈ [max(0, Dr−(Lr−m_w)), min(m_w, Dr)], so the
+    # remaining drops always fit the remaining classes) — the §4c-aware hold
+    # is therefore the same hold fed §4c counts (ROADMAP r5 next #7).
+    counts = {"urn": net.urn_counts, "urn2": net.urn2_counts,
+              "urn3": net.urn3_counts}[cfg.delivery]
     c0, c1 = counts(r, t, [values, values], silent_all,
                     strata=strata, minority=minority)
     targets = []
@@ -471,8 +470,8 @@ def run_message_instance(cfg, instance: int, rng: random.Random,
     to the global count-level predicate; and under the delivery-realizing
     schedule each receiver's wait-quota (first n−f valid accepts, own message
     in-head) is asserted equal to the count-level delivery — the §4 mask row
-    under ``delivery="keys"`` (:func:`_make_mask_hold`), or the §4b/§4b-v2
-    per-class delivered-count vector under ``delivery="urn"``/``"urn2"``
+    under ``delivery="keys"`` (:func:`_make_mask_hold`), or the §4b/§4b-v2/§4c
+    per-class delivered-count vector under ``delivery="urn"``/``"urn2"``/``"urn3"``
     (:func:`_make_counts_hold`, VERDICT r4 #3). State then evolves through the
     same ``Replica`` machine as backends/cpu.py; the caller compares the
     returned ``(rounds, decision)`` with ``CpuBackend.run``.
@@ -486,7 +485,7 @@ def run_message_instance(cfg, instance: int, rng: random.Random,
     cfg = cfg.validate()
     assert cfg.protocol == "bracha", \
         "message-level validation targets the bracha protocol"
-    count_level = cfg.delivery in ("urn", "urn2")
+    count_level = cfg.delivery in ("urn", "urn2", "urn3")
     if realize_rng is None:
         realize_rng = random.Random(rng.randrange(1 << 30))
     n, f = cfg.n, cfg.f
@@ -555,7 +554,7 @@ def run_message_instance(cfg, instance: int, rng: random.Random,
             # wait-quota == the count-level delivery (leg 3): the first
             # n−f−1 valid non-own accepts in message-arrival order, plus the
             # own message in-head — set-equal to the §4 mask row (keys), or
-            # class-count-equal to the §4b/§4b-v2 delivered-count vector (urn).
+            # class-count-equal to the count-level delivered-count vector (urn*).
             if count_level:
                 for v in range(n):
                     seq = [u for (u, _w) in eng.accept_order[v]
@@ -581,12 +580,13 @@ def run_message_instance(cfg, instance: int, rng: random.Random,
 
         if cfg.coin == "shared":
             shared = int(prf.prf_bit(cfg.seed, instance, r, prf.COIN_STEP, 0, 0,
-                                     prf.SHARED_COIN, xp=np))
+                                     prf.SHARED_COIN, xp=np,
+                                     pack=cfg.pack_version))
             coin = [shared] * n
         else:
             replica = np.arange(n, dtype=np.uint32)
             coin = prf.prf_bit(cfg.seed, instance, r, prf.COIN_STEP, replica, 0,
-                               prf.LOCAL_COIN, xp=np)
+                               prf.LOCAL_COIN, xp=np, pack=cfg.pack_version)
         for rep in reps:
             rep.end_round(int(coin[rep.index]))
         if all(reps[j].decided for j in correct):
@@ -660,12 +660,13 @@ def run_message_instance_free(cfg, instance: int, rng: random.Random,
                 rep.on_deliver(t, vmat[rep.index], mask[rep.index])
         if cfg.coin == "shared":
             shared = int(prf.prf_bit(cfg.seed, instance, r, prf.COIN_STEP, 0, 0,
-                                     prf.SHARED_COIN, xp=np))
+                                     prf.SHARED_COIN, xp=np,
+                                     pack=cfg.pack_version))
             coin = [shared] * n
         else:
             replica = np.arange(n, dtype=np.uint32)
             coin = prf.prf_bit(cfg.seed, instance, r, prf.COIN_STEP, replica, 0,
-                               prf.LOCAL_COIN, xp=np)
+                               prf.LOCAL_COIN, xp=np, pack=cfg.pack_version)
         for rep in reps:
             rep.end_round(int(coin[rep.index]))
         check_agreement()

@@ -86,8 +86,10 @@ def test_benchmark_n_sharded_vs_native(n_model):
 
 @pytest.mark.slow
 def test_max_n_sharded_vs_native():
-    """n=1024 — the packing limit (prf.MAX_N) and config-5's top sweep point —
-    under replica-axis sharding ((2,4) mesh), bit-matched against native."""
+    """n=1024 — the v1 packing limit (prf.V1_MAX_N) and config-5's top sweep
+    point — under replica-axis sharding ((2,4) mesh), bit-matched against
+    native. (The overall ceiling is prf.MAX_N=4096 via the §2 v2 law;
+    tests/test_packing.py covers the far side of the gate.)"""
     from byzantinerandomizedconsensus_tpu.config import sweep_point
 
     cfg = sweep_point(1024, instances=64)

@@ -80,11 +80,14 @@ def test_native_differential_random_configs(cfg):
     recv=st.integers(min_value=0, max_value=prf.MAX_N - 1),
     send=st.integers(min_value=0, max_value=prf.MAX_N - 1),
     purpose=st.integers(min_value=0, max_value=6),
+    pack=st.sampled_from((1, 2)),
 )
-def test_prf_determinism_and_range(seed, inst, rnd, step, recv, send, purpose):
-    a = prf.prf_u32(seed, inst, rnd, step, recv, send, purpose, xp=np)
-    b = prf.prf_u32(seed, inst, rnd, step, recv, send, purpose, xp=np)
+def test_prf_determinism_and_range(seed, inst, rnd, step, recv, send, purpose,
+                                   pack):
+    a = prf.prf_u32(seed, inst, rnd, step, recv, send, purpose, xp=np, pack=pack)
+    b = prf.prf_u32(seed, inst, rnd, step, recv, send, purpose, xp=np, pack=pack)
     assert int(a) == int(b)
     assert 0 <= int(a) <= 0xFFFFFFFF
-    bit = prf.prf_bit(seed, inst, rnd, step, recv, send, purpose, xp=np)
+    bit = prf.prf_bit(seed, inst, rnd, step, recv, send, purpose, xp=np,
+                      pack=pack)
     assert int(bit) == int(a) & 1

@@ -1,0 +1,53 @@
+"""Installability surface (ROADMAP / VERDICT r5 next #2): the pyproject's
+dynamic version and console-script target must stay wired to real objects.
+The full fresh-venv `pip install -e .` + wheel smoke test is a manual/release
+check (README Install section documents the air-gapped variant); this is the
+reduced CI leg that catches the common breakages — a renamed entry point, a
+moved `__version__`, a package dir dropped from the find-include list —
+without invoking pip."""
+
+import pathlib
+import re
+
+import byzantinerandomizedconsensus_tpu as pkg
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+PYPROJECT = ROOT / "pyproject.toml"
+
+
+def test_pyproject_exists_and_version_is_dynamic():
+    text = PYPROJECT.read_text()
+    assert 'dynamic = ["version"]' in text
+    assert 'version = { attr = "byzantinerandomizedconsensus_tpu.__version__" }' in text
+    # The attr it names must resolve and look like a version.
+    assert re.fullmatch(r"\d+\.\d+\.\d+", pkg.__version__)
+
+
+def test_console_script_target_is_callable():
+    text = PYPROJECT.read_text()
+    m = re.search(r'brc-tpu = "([\w.]+):(\w+)"', text)
+    assert m, "brc-tpu console script missing from pyproject"
+    module, func = m.groups()
+    import importlib
+
+    target = getattr(importlib.import_module(module), func)
+    assert callable(target)
+    # argparse exits 0 on --help: the standard console-script smoke.
+    import pytest
+
+    with pytest.raises(SystemExit) as e:
+        target(["--help"])
+    assert e.value.code == 0
+
+
+def test_only_namespaced_package_ships():
+    """The wheel must never claim generic top-level module names: only the
+    byzantinerandomizedconsensus_tpu namespace is packaged — the repo-side
+    `spec/` layer (which would install as top-level `spec`) stays a checkout
+    resource."""
+    text = PYPROJECT.read_text()
+    m = re.search(r"include = \[([^\]]*)\]", text)
+    assert m, "packages.find include list missing"
+    assert m.group(1).strip() == '"byzantinerandomizedconsensus_tpu*"'
+    # The goldens the repo tests pin still live in the checkout.
+    assert (ROOT / "spec" / "golden" / "golden.npz").exists()

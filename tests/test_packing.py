@@ -1,0 +1,336 @@
+"""Spec §2 v2 coordinate packing: the n > 1024 gate (ISSUE 2 tentpole).
+
+Three invariants:
+
+1. **Frozen v1 law** — every draw of every n ≤ 1024 config is bit-identical to
+   the pre-v2 code: pinned raw PRF words, plus a golden re-pin asserting the
+   committed golden vectors (all n ≤ 1024, all four delivery models) still
+   reproduce exactly under the v2-gated code path.
+2. **The gate itself** — ``pack_version`` is a pure function of n; ``validate()``
+   accepts n=2048/4096 and enforces the narrower v2 instance/round fields.
+3. **Cross-stack agreement past the old cap** — numpy vs native (and a scalar
+   oracle subsample on the slow leg) bit-match at n=2048 under the v2 law.
+"""
+
+import shutil
+
+import numpy as np
+import pytest
+
+from byzantinerandomizedconsensus_tpu.backends import get_backend
+from byzantinerandomizedconsensus_tpu.config import SimConfig
+from byzantinerandomizedconsensus_tpu.ops import prf
+
+needs_gxx = pytest.mark.skipif(shutil.which("g++") is None,
+                               reason="no C++ toolchain")
+
+
+# ---------------------------------------------------------------- v1 frozen law
+
+# Raw prf_u32 words captured from the pre-v2 code (commit beb3814). If any of
+# these move, every golden vector and checkpoint in the repo is invalidated.
+V1_PINNED = [
+    ((42, 3, 1, 0, 1, 1, prf.SCHED), 0x9A1E6B74),
+    ((1234567890123, 99, 7, 2, 1023, 1023, prf.SCHED), 0xE07854E8),
+    ((0, 0, 0, 0, 0, 0, prf.INIT_EST), 0x6B200159),
+    ((7, 131071, 65535, 3, 512, 700, prf.URN2), 0x41BC3C2C),
+    ((2**63 + 5, 1, 255, 1, 17, 0, prf.URN3), 0xA86FDA36),
+]
+
+
+@pytest.mark.parametrize("coords,expect", V1_PINNED)
+def test_v1_words_pinned(coords, expect):
+    assert int(prf.prf_u32(*coords, xp=np)) == expect           # default pack=1
+    assert int(prf.prf_u32(*coords, xp=np, pack=1)) == expect
+
+
+def test_golden_byte_identical_under_v2_gate():
+    """Every committed golden vector (n ≤ 1024, all deliveries) reproduces
+    byte-for-byte under the v2-gated code — the 'goldens must not move'
+    acceptance gate, pinned independently of test_golden.py so a regen there
+    cannot silently absorb a packing regression."""
+    from spec.golden.regen import GOLDEN_CONFIGS, PATH
+
+    assert PATH.exists(), "golden.npz missing"
+    data = np.load(PATH)
+    from byzantinerandomizedconsensus_tpu import Simulator
+
+    for name, cfg in GOLDEN_CONFIGS.items():
+        assert cfg.pack_version == 1, f"{name}: goldens must be v1 configs"
+        res = Simulator(cfg, "cpu").run()
+        np.testing.assert_array_equal(
+            res.rounds, data[f"{name}__rounds"], err_msg=f"{name} rounds moved")
+        np.testing.assert_array_equal(
+            res.decision, data[f"{name}__decision"],
+            err_msg=f"{name} decision moved")
+
+
+# ------------------------------------------------------------------- the gate
+
+def test_pack_version_is_pure_function_of_n():
+    assert prf.pack_version(1) == 1
+    assert prf.pack_version(1024) == 1
+    assert prf.pack_version(1025) == 2
+    assert prf.pack_version(2048) == 2
+    assert prf.pack_version(4096) == 2
+    with pytest.raises(ValueError):
+        prf.pack_version(4097)
+
+
+def test_v2_law_differs_from_v1():
+    """The gate is non-vacuous: the two laws give different words on shared
+    coordinates (same seed, same logical draw)."""
+    coords = (42, 3, 1, 0, 1, 1, prf.SCHED)
+    assert int(prf.prf_u32(*coords, xp=np, pack=1)) != \
+        int(prf.prf_u32(*coords, xp=np, pack=2))
+    with pytest.raises(ValueError):
+        prf.prf_u32(*coords, xp=np, pack=3)
+
+
+def test_v2_numpy_matches_jax():
+    jnp = pytest.importorskip("jax.numpy")
+    inst = np.arange(50, dtype=np.uint32)[:, None]
+    recv = np.arange(2048, dtype=np.uint32)[None, :]
+    a = prf.prf_u32(99, inst, 5, 2, recv, 0, prf.URN3, xp=np, pack=2)
+    b = prf.prf_u32(99, jnp.asarray(inst), 5, 2, jnp.asarray(recv), 0,
+                    prf.URN3, xp=jnp, pack=2)
+    np.testing.assert_array_equal(a, np.asarray(b))
+
+
+def test_v2_recv_field_no_longer_collides():
+    """The v1 failure mode that motivated v2: under v1 packing, recv=1024 at
+    rnd=0 aliases recv=0 at rnd=1 (recv bits overflow into the round field).
+    Under v2 the same pair of coordinates is distinct."""
+    a1 = prf.prf_u32(7, 0, 0, 0, 1024, 0, prf.URN, xp=np, pack=1)
+    b1 = prf.prf_u32(7, 0, 1, 0, 0, 0, prf.URN, xp=np, pack=1)
+    assert int(a1) == int(b1)  # the v1 overflow, demonstrated
+    a2 = prf.prf_u32(7, 0, 0, 0, 1024, 0, prf.URN, xp=np, pack=2)
+    b2 = prf.prf_u32(7, 0, 1, 0, 0, 0, prf.URN, xp=np, pack=2)
+    assert int(a2) != int(b2)
+
+
+def test_validate_accepts_v2_sizes():
+    c2048 = SimConfig(protocol="bracha", n=2048, f=682, instances=100,
+                      adversary="adaptive", coin="shared",
+                      delivery="urn2").validate()
+    assert c2048.pack_version == 2
+    c4096 = SimConfig(protocol="bracha", n=4096, f=1365, instances=10,
+                      adversary="none", coin="shared",
+                      delivery="urn3").validate()
+    assert c4096.pack_version == 2
+    with pytest.raises(ValueError):
+        SimConfig(protocol="bracha", n=4097, f=1365, instances=1).validate()
+
+
+def test_validate_rejects_v2_field_overflow():
+    """v2 narrows the instance field to 16 bits and the round field to 12:
+    counts legal under v1 must be rejected once n crosses the gate."""
+    big_inst = prf.V2_MAX_INSTANCES + 1          # fine under v1 (2^17 cap)
+    SimConfig(protocol="bracha", n=1024, f=341, instances=big_inst).validate()
+    with pytest.raises(ValueError, match="packing v2"):
+        SimConfig(protocol="bracha", n=2048, f=682,
+                  instances=big_inst).validate()
+    big_cap = prf.V2_MAX_ROUNDS + 1              # fine under v1 (2^16 cap)
+    SimConfig(protocol="bracha", n=1024, f=341, instances=1,
+              round_cap=big_cap).validate()
+    with pytest.raises(ValueError, match="packing v2"):
+        SimConfig(protocol="bracha", n=2048, f=682, instances=1,
+                  round_cap=big_cap).validate()
+    # At the exact v2 limits validate() still accepts.
+    SimConfig(protocol="bracha", n=2048, f=682,
+              instances=prf.V2_MAX_INSTANCES,
+              round_cap=prf.V2_MAX_ROUNDS).validate()
+
+
+# ------------------------------------------- cross-stack agreement at n = 2048
+
+def _cfg2048(delivery, instances=4, adversary="adaptive", round_cap=48):
+    return SimConfig(protocol="bracha", n=2048, f=682, instances=instances,
+                     adversary=adversary, coin="shared", seed=7,
+                     round_cap=round_cap, delivery=delivery).validate()
+
+
+@needs_gxx
+@pytest.mark.parametrize("delivery", ["urn2", "urn3"])
+def test_numpy_native_bitmatch_n2048(delivery):
+    cfg = _cfg2048(delivery)
+    a = get_backend("numpy").run(cfg)
+    b = get_backend("native").run(cfg)
+    np.testing.assert_array_equal(a.rounds, b.rounds)
+    np.testing.assert_array_equal(a.decision, b.decision)
+
+
+@needs_gxx
+@pytest.mark.slow
+def test_oracle_subsample_n2048():
+    """One scalar-oracle instance at n=2048 (the oracle is O(n²) python per
+    step, so one instance is the budget) — anchors the numpy and native legs
+    to the third independent implementation under the v2 law."""
+    cfg = _cfg2048("urn2", instances=1)
+    a = get_backend("cpu").run(cfg)
+    b = get_backend("numpy").run(cfg)
+    c = get_backend("native").run(cfg)
+    np.testing.assert_array_equal(a.rounds, b.rounds)
+    np.testing.assert_array_equal(a.decision, b.decision)
+    np.testing.assert_array_equal(a.rounds, c.rounds)
+    np.testing.assert_array_equal(a.decision, c.decision)
+
+
+def test_virtual_mesh_shard_equivalence_n2048():
+    """Model-axis sharding semantics at n=2048 on a virtual (2,2) layout,
+    host-side: the count-level delivery ops address randomness by *global*
+    receiver coordinates, so computing each receiver shard independently
+    (recv_ids slices — exactly what parallel/sharded.py's model axis does)
+    must reassemble to the full-width result bit-for-bit under the v2 law."""
+    from byzantinerandomizedconsensus_tpu.models import state as state_mod
+    from byzantinerandomizedconsensus_tpu.models.adversaries import AdversaryModel
+    from byzantinerandomizedconsensus_tpu.ops import delivery_counts_fn
+
+    cfg = _cfg2048("urn2", instances=2)
+    inst_ids = np.arange(2, dtype=np.int64)
+    adv = AdversaryModel(cfg)
+    setup = adv.setup(cfg.seed, inst_ids, xp=np)
+    est = state_mod.init_est(cfg, cfg.seed, inst_ids, xp=np)
+    values, silent, _bias = adv.inject(cfg.seed, inst_ids, 0, 0, est, setup,
+                                       xp=np)
+    counts = delivery_counts_fn(cfg.delivery)
+    full = counts(cfg, cfg.seed, inst_ids, 0, 0, values, silent,
+                  setup["faulty"], est, xp=np)
+    n_model = 2
+    n_local = cfg.n // n_model
+    for part in range(2):  # both (c0, c1) planes
+        shards = []
+        for m in range(n_model):
+            recv_ids = np.arange(m * n_local, (m + 1) * n_local,
+                                 dtype=np.uint32)
+            shards.append(counts(cfg, cfg.seed, inst_ids, 0, 0, values,
+                                 silent, setup["faulty"], est,
+                                 recv_ids=recv_ids, xp=np)[part])
+        np.testing.assert_array_equal(np.concatenate(shards, axis=-1),
+                                      full[part])
+
+
+# -------------------------------------------- checkpoint packing-version token
+
+def test_shard_name_packing_token():
+    """v1 configs keep the legacy shard name (existing checkpoints stay
+    resumable); v2 configs carry the _p2 token."""
+    from byzantinerandomizedconsensus_tpu.utils import checkpoint
+
+    v1 = SimConfig(protocol="bracha", n=1024, f=341, instances=10,
+                   adversary="adaptive", coin="shared", delivery="urn2")
+    assert "_p" not in checkpoint.shard_name(v1, 0, 10)
+    v2 = _cfg2048("urn2")
+    name = checkpoint.shard_name(v2, 0, 4)
+    assert "_p2_s" in name and "_n2048_" in name
+
+
+def test_stale_packing_token_warning(tmp_path):
+    """A wide-n shard whose _pN token names a law other than what the current
+    code derives for its n must be flagged, not silently ignored."""
+    from byzantinerandomizedconsensus_tpu.utils.sweep import _warn_stale_shards
+
+    # A forged pre-v2 shard name at n=2048 (no _p token => claims v1).
+    (tmp_path / "bracha_n2048_f682_adaptive_shared_urn2_s0_i0-500.npz").touch()
+    # A healthy v2 shard and a healthy v1 shard: neither may warn.
+    (tmp_path / "bracha_n2048_f682_adaptive_shared_urn2_p2_s0_i500-1000.npz").touch()
+    (tmp_path / "bracha_n512_f170_adaptive_shared_urn2_s0_i0-500.npz").touch()
+    msgs = []
+    _warn_stale_shards(tmp_path, "urn2", 256, msgs.append)
+    assert len(msgs) == 1
+    assert "packing-version token" in msgs[0]
+    assert "i0-500" in msgs[0] and "n2048" in msgs[0]
+
+
+@needs_gxx
+@pytest.mark.parametrize("delivery", ["urn2", "urn3"])
+def test_virtual_mesh_2x2_vs_native_n2048(delivery):
+    """End-to-end sharded bit-match at n=2048 on a (2, 2) virtual mesh
+    (parallel/virtual.py: the host-side SPMD emulation of the sharded
+    layout — data×model threads, barrier all-gather through the same
+    recv_ids/gather seams as parallel/sharded.py) against the native C++
+    core: the §2 v2 global-coordinate addressing must make replica shards
+    compute exactly the oracle's draws for their rows."""
+    cfg = _cfg2048(delivery)
+    a = get_backend("virtual:2x2").run(cfg)
+    b = get_backend("native").run(cfg)
+    np.testing.assert_array_equal(a.rounds, b.rounds)
+    np.testing.assert_array_equal(a.decision, b.decision)
+
+
+@needs_gxx
+def test_virtual_mesh_small_grid_vs_native():
+    """The virtual-mesh emulation itself, cross-checked at oracle-fast sizes
+    over mesh shapes and both protocol/coin families (its n=2048 leg above
+    then stands on a verified instrument)."""
+    from byzantinerandomizedconsensus_tpu.config import SimConfig as C
+
+    cases = [
+        (C(protocol="bracha", n=16, f=5, instances=20, adversary="adaptive_min",
+           coin="shared", seed=9, round_cap=64, delivery="keys"), "2x2"),
+        (C(protocol="benor", n=8, f=1, instances=20, adversary="byzantine",
+           coin="local", seed=4, round_cap=64, delivery="urn2"), "4x2"),
+        (C(protocol="bracha", n=12, f=3, instances=16, adversary="crash",
+           coin="shared", seed=5, round_cap=64, delivery="urn3"), "1x4"),
+        (C(protocol="benor", n=10, f=4, instances=16, adversary="none",
+           coin="local", seed=6, round_cap=128, delivery="urn"), "3x2"),
+    ]
+    for cfg, mesh in cases:
+        cfg = cfg.validate()
+        a = get_backend(f"virtual:{mesh}").run(cfg)
+        b = get_backend("native").run(cfg)
+        np.testing.assert_array_equal(a.rounds, b.rounds,
+                                      err_msg=f"{mesh} {cfg}")
+        np.testing.assert_array_equal(a.decision, b.decision,
+                                      err_msg=f"{mesh} {cfg}")
+
+
+@pytest.mark.parametrize("delivery", ["urn", "urn2", "urn3"])
+def test_oracle_counts_match_numpy_at_v2_size(delivery):
+    """Single-step delivered-count agreement, scalar python-int oracle vs the
+    vectorized uint32 numpy sampler, at a v2 size (n=1536): pins the widened
+    §2 v2 range reduction — under the v1 10/22 shifts the numpy product
+    (u >> 10)·R wraps uint32 for urn sizes ≥ 2^10 while the oracle's python
+    ints never wrap, so any reduction-law drift shows here immediately
+    (without waiting for the slow full-instance subsample)."""
+    from byzantinerandomizedconsensus_tpu.core.network import Network
+    from byzantinerandomizedconsensus_tpu.models import state as state_mod
+    from byzantinerandomizedconsensus_tpu.models.adversaries import AdversaryModel
+    from byzantinerandomizedconsensus_tpu.ops import delivery_counts_fn
+
+    cfg = SimConfig(protocol="bracha", n=1536, f=511, instances=2,
+                    adversary="adaptive_min", coin="shared", seed=11,
+                    delivery=delivery).validate()
+    assert cfg.pack_version == 2
+    inst_ids = np.arange(2, dtype=np.int64)
+    adv = AdversaryModel(cfg)
+    setup = adv.setup(cfg.seed, inst_ids, xp=np)
+    est = state_mod.init_est(cfg, cfg.seed, inst_ids, xp=np)
+    values, silent, _ = adv.inject(cfg.seed, inst_ids, 0, 0, est, setup, xp=np)
+    c0, c1 = delivery_counts_fn(cfg.delivery)(
+        cfg, cfg.seed, inst_ids, 0, 0, values, silent, setup["faulty"], est,
+        xp=np)
+    oracle_counts = {"urn": "urn_counts", "urn2": "urn2_counts",
+                     "urn3": "urn3_counts"}[delivery]
+    for k, inst in enumerate(inst_ids):
+        net = Network(cfg, cfg.seed, int(inst))
+        from byzantinerandomizedconsensus_tpu.core.adversary import make_adversary
+
+        o_adv = make_adversary(cfg, cfg.seed, int(inst))
+        oc0, oc1 = getattr(net, oracle_counts)(
+            0, 0, [values[k], values[k]], silent[k], strata="minority",
+            minority=int(o_adv.observed_minority(est[k])))
+        np.testing.assert_array_equal(c0[k], oc0, err_msg=f"inst {inst} c0")
+        np.testing.assert_array_equal(c1[k], oc1, err_msg=f"inst {inst} c1")
+
+
+@needs_gxx
+def test_numpy_native_bitmatch_n2048_single_stratum():
+    """The non-adaptive (single-stratum) §4b draw path — including the
+    packed-carry step_single specialisation and the v2 range reduction at
+    full urn sizes R ≈ n−1 > 2^10 — at n=2048, numpy vs native."""
+    cfg = _cfg2048("urn", instances=3, adversary="none", round_cap=32)
+    a = get_backend("numpy").run(cfg)
+    b = get_backend("native").run(cfg)
+    np.testing.assert_array_equal(a.rounds, b.rounds)
+    np.testing.assert_array_equal(a.decision, b.decision)

@@ -1,0 +1,62 @@
+"""Reduced CI leg of the randomized differential soak (tools/soak.py).
+
+The committed artifact (artifacts/soak_r7.json) is the full run; this keeps
+the instrument itself honest on every suite run: the generator only emits
+valid configs covering all four delivery models, and a small soak finds zero
+numpy-vs-native mismatches with the oracle subsample on.
+"""
+
+import random
+import shutil
+
+import pytest
+
+from byzantinerandomizedconsensus_tpu.config import DELIVERY_KINDS
+from byzantinerandomizedconsensus_tpu.tools import soak
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="no C++ toolchain")
+
+
+def test_generator_emits_valid_configs_all_deliveries():
+    rng = random.Random(7)
+    seen = set()
+    for _ in range(80):
+        cfg = soak.random_config(rng)          # .validate() runs inside
+        assert cfg.n <= soak.MAX_SOAK_N
+        assert cfg.pack_version == 1           # soak stays on the v1 side
+        seen.add(cfg.delivery)
+    assert seen == set(DELIVERY_KINDS)
+
+
+def test_small_soak_zero_mismatches():
+    doc = soak.run_soak(8, seed=123, oracle_every=4, oracle_instances=2,
+                        progress=lambda *a: None)
+    assert doc["configs"] == 8
+    assert doc["oracle_subsampled_configs"] == 2
+    assert doc["mismatches"] == []
+
+
+def test_soak_reports_mismatch_instead_of_raising(monkeypatch):
+    """A soak that stops at the first divergence (or asserts) is useless as an
+    instrument — it must record and keep going."""
+    import numpy as np
+
+    from byzantinerandomizedconsensus_tpu.backends import get_backend
+    real = get_backend("native").run
+
+    class Liar:
+        name = "native"
+
+        def run(self, cfg, inst_ids=None):
+            res = real(cfg, inst_ids)
+            res.rounds[0] += 1  # corrupt one leg
+            return res
+
+    monkeypatch.setattr(soak, "get_backend",
+                        lambda name: Liar() if name == "native"
+                        else get_backend(name))
+    doc = soak.run_soak(3, seed=5, oracle_every=100,
+                        progress=lambda *a: None)
+    assert len(doc["mismatches"]) == 3
+    assert all(m["leg"] == "numpy_vs_native" for m in doc["mismatches"])
