@@ -57,6 +57,24 @@ class SimulatorBackend(abc.ABC):
     def run(self, cfg: SimConfig, inst_ids: Optional[np.ndarray] = None) -> SimResult:
         """Simulate the given instances (default: all of them) to termination."""
 
+    def run_with_counters(self, cfg: SimConfig,
+                          inst_ids: Optional[np.ndarray] = None):
+        """``run`` plus the protocol-counter side output (obs/counters.py):
+        returns ``(SimResult, counters_doc)``. The counter leg is a pure side
+        output — the result arrays are bit-identical to ``run``'s.
+
+        Default: unsupported (the native core's ABI has no counter channel;
+        meshes and custom kernels don't thread the side channel). Raises
+        :class:`~byzantinerandomizedconsensus_tpu.obs.counters.CountersUnsupported`
+        so record builders can degrade to an honest ``supported: false``
+        block (obs/record.collect_counters) instead of dying.
+        """
+        from byzantinerandomizedconsensus_tpu.obs.counters import (
+            CountersUnsupported)
+
+        raise CountersUnsupported(
+            f"backend {self.name!r} has no protocol-counter channel")
+
     @staticmethod
     def _run_chunked(fn, ids: np.ndarray, chunk: int, extra_args=()):
         """Run ``fn(chunk_ids) -> (rounds, decision)`` over fixed-size chunks.
@@ -72,18 +90,36 @@ class SimulatorBackend(abc.ABC):
         device-side concatenate would also work but costs a multi-second XLA
         compile of the throwaway concat program on first use.)
         """
+        rounds_out, decision_out = SimulatorBackend._run_chunked_multi(
+            fn, ids, chunk, extra_args)[:2]
+        return rounds_out, decision_out
+
+    @staticmethod
+    def _run_chunked_multi(fn, ids: np.ndarray, chunk: int,
+                           extra_args=(), n_extra: int = 0) -> tuple:
+        """:meth:`_run_chunked` generalized to variable output arity: the
+        chunk fn returns ``(rounds, decision, *extras)`` with ``n_extra``
+        extra leading-batch-axis outputs (e.g. the counter accumulator).
+        One copy of the dispatch / batched-fetch / tail-padding-discard
+        invariant serves the product and counter paths alike."""
         import jax
 
         pending = SimulatorBackend._dispatch_chunks(fn, ids, chunk, extra_args)
         fetched = jax.device_get(pending)
-        rounds_out = np.empty(len(ids), dtype=np.int32)
-        decision_out = np.empty(len(ids), dtype=np.uint8)
-        for i, (r, d) in enumerate(fetched):
-            lo = i * chunk
-            hi = min(lo + chunk, len(ids))
-            rounds_out[lo:hi] = r[: hi - lo]
-            decision_out[lo:hi] = d[: hi - lo]
-        return rounds_out, decision_out
+        if not fetched:  # empty inst_ids: keep run()'s empty-result support
+            return (np.empty(0, dtype=np.int32),
+                    np.empty(0, dtype=np.uint8)) + (None,) * n_extra
+        outs = []
+        for pos in range(len(fetched[0])):
+            parts = []
+            for i, ch in enumerate(fetched):
+                lo = i * chunk
+                hi = min(lo + chunk, len(ids))
+                parts.append(np.asarray(ch[pos])[: hi - lo])
+            outs.append(np.concatenate(parts))
+        outs[0] = outs[0].astype(np.int32, copy=False)
+        outs[1] = outs[1].astype(np.uint8, copy=False)
+        return tuple(outs)
 
     @staticmethod
     def _dispatch_chunks(fn, ids: np.ndarray, chunk: int, extra_args=()) -> list:

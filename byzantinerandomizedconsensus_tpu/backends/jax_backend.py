@@ -23,14 +23,16 @@ import jax
 import jax.numpy as jnp
 
 from byzantinerandomizedconsensus_tpu.backends.base import (
-    JitChunkedBackend, check_pallas_delivery)
+    JitChunkedBackend, SimResult, check_pallas_delivery)
 from byzantinerandomizedconsensus_tpu.config import SimConfig
 from byzantinerandomizedconsensus_tpu.models import benor, bracha, state as state_mod
 from byzantinerandomizedconsensus_tpu.models.adversaries import AdversaryModel
 
 
-def _run_chunk(cfg: SimConfig, inst_ids: jnp.ndarray, key=None, counts_fn=None):
-    """Simulate one padded chunk; returns (rounds (B,), decision (B,)).
+def _run_chunk(cfg: SimConfig, inst_ids: jnp.ndarray, key=None, counts_fn=None,
+               counters: bool = False):
+    """Simulate one padded chunk; returns (rounds (B,), decision (B,)) — plus
+    the (B, C, 2) uint32 per-instance counter accumulator when ``counters``.
 
     ``counts_fn`` selects the delivery+tally implementation: None = the XLA
     masks+tally path; ops/pallas_tally.counts_fn = the fused Pallas kernel.
@@ -38,7 +40,15 @@ def _run_chunk(cfg: SimConfig, inst_ids: jnp.ndarray, key=None, counts_fn=None):
     cfg.seed statically — required by the Pallas kernels, whose in-kernel
     threefry needs concrete key words): with a dynamic key, runs that differ
     only in seed (multi-seed sharding, seed sweeps) reuse one program.
+
+    ``counters`` (static) adds the opt-in side-output leg (obs/counters.py)
+    to the while-loop carry: the round body records per-step count outputs,
+    which fold under the same ``done_at < 0`` activity mask that gates state
+    updates. Nothing flows from the accumulator back into the round math, so
+    the (rounds, decision) surface is bit-identical either way.
     """
+    from byzantinerandomizedconsensus_tpu.obs import counters as _c
+
     seed = cfg.seed if key is None else key
     round_body = benor.round_body if cfg.protocol == "benor" else bracha.round_body
     adv = AdversaryModel(cfg)
@@ -46,23 +56,35 @@ def _run_chunk(cfg: SimConfig, inst_ids: jnp.ndarray, key=None, counts_fn=None):
     faulty = setup["faulty"]
     st = state_mod.init_state(cfg, seed, inst_ids, xp=jnp)
     done_at = jnp.full(inst_ids.shape[0], -1, dtype=jnp.int32)
+    # The accumulator joins the carry only when collecting, so the
+    # counters-off program is structurally identical to the pre-obs kernel.
+    init = (jnp.int32(0), st, done_at) + (
+        (_c.zeros(cfg, inst_ids.shape[0], jnp),) if counters else ())
 
     def cond(carry):
-        r, _, done_at = carry
+        r, _, done_at = carry[:3]
         return (r < cfg.round_cap) & ~jnp.all(done_at >= 0)
 
     def body(carry):
-        r, st, done_at = carry
+        r, st, done_at = carry[:3]
+        obs = {} if counters else None
         st = round_body(cfg, seed, inst_ids, r, st, adv, setup, xp=jnp,
-                        counts_fn=counts_fn)
+                        counts_fn=counts_fn, obs=obs)
+        out = (r + 1, st)
+        if counters:
+            acc = _c.accumulate(carry[3], _c.round_increments(cfg, obs, jnp),
+                                done_at < 0, cfg, jnp)
         done_now = state_mod.all_correct_decided(st, faulty, xp=jnp)
         done_at = jnp.where((done_at < 0) & done_now, r + 1, done_at)
-        return r + 1, st, done_at
+        return out + (done_at,) + ((acc,) if counters else ())
 
-    _, st, done_at = jax.lax.while_loop(cond, body, (jnp.int32(0), st, done_at))
+    final = jax.lax.while_loop(cond, body, init)
+    _, st, done_at = final[:3]
     done = done_at >= 0
     rounds = jnp.where(done, done_at, cfg.round_cap).astype(jnp.int32)
     decision = state_mod.extract_decision(st, faulty, done, xp=jnp)
+    if counters:
+        return rounds, decision, final[3]
     return rounds, decision
 
 
@@ -132,3 +154,49 @@ class JaxBackend(JitChunkedBackend):
         if self.device is None:
             return super()._device_ctx()
         return jax.default_device(jax.devices(self.device)[0])
+
+    def _fn_counters(self, cfg: SimConfig):
+        """Compiled chunk function with the counter side-output leg — cached
+        separately so counted runs never evict (or retrace) the product
+        program of the same config."""
+        cfg_key = self._cache_key(cfg)
+        cache = self.__dict__.setdefault("_compiled_counters", {})
+        if cfg_key not in cache:
+            # counts_fn=None: the default XLA masks+tally / count-level
+            # registry paths — the only ones with the obs side channel.
+            cache[cfg_key] = jax.jit(
+                partial(_run_chunk, cfg_key, counts_fn=None, counters=True))
+        return cache[cfg_key]
+
+    def run_with_counters(self, cfg: SimConfig,
+                          inst_ids: Optional[np.ndarray] = None):
+        """``run`` plus the protocol-counter totals (obs/counters.py).
+
+        Counter collection is implemented for the default XLA kernels only:
+        the Pallas paths compute delivery+tally in-kernel and expose no side
+        channel, and ``xla_nosort`` is a keys-only A/B kernel — both raise
+        :class:`CountersUnsupported` rather than silently measuring a
+        different code path.
+        """
+        from byzantinerandomizedconsensus_tpu.obs import counters as _counters
+
+        if self.kernel != "xla":
+            raise _counters.CountersUnsupported(
+                f"kernel={self.kernel!r} has no counter side channel; "
+                "protocol counters require the default 'xla' kernels")
+        cfg = cfg.validate()
+        self._check_config(cfg)
+        ids = self._resolve_inst_ids(cfg, inst_ids)
+        chunk = self._clamp_chunk(cfg, min(self._chunk_size(cfg), max(1, len(ids))))
+        fn = self._fn_counters(cfg)
+        with self._device_ctx():
+            # The product path's dispatch/fetch/unpad invariant, with one
+            # extra output column (the per-instance counter accumulator).
+            rounds_out, decision_out, rows = self._run_chunked_multi(
+                fn, ids, chunk, self._extra_args(cfg), n_extra=1)
+        if rows is None:  # empty inst_ids
+            rows = _counters.zeros(cfg, 0, np)
+        res = SimResult(config=cfg, inst_ids=ids, rounds=rounds_out,
+                        decision=decision_out)
+        totals = _counters.finalize(cfg, rows)
+        return res, _counters.counters_doc(cfg, totals, backend=self.name)

@@ -33,8 +33,24 @@ class NumpyBackend(SimulatorBackend):
         return max(1, min(1 << 14, self.chunk_bytes // per_inst))
 
     def run(self, cfg: SimConfig, inst_ids: Optional[np.ndarray] = None) -> SimResult:
-        res, _, _ = self._run_impl(cfg, inst_ids, collect_state=False)
+        res, _, _, _ = self._run_impl(cfg, inst_ids, collect_state=False)
         return res
+
+    def run_with_counters(self, cfg: SimConfig,
+                          inst_ids: Optional[np.ndarray] = None):
+        """``run`` plus the protocol-counter totals (obs/counters.py).
+
+        The counter leg is a pure side output of the shared round bodies
+        (``obs=`` hook) folded under the same ``done_at < 0`` activity mask
+        that gates state updates, so the (rounds, decision) arrays are
+        bit-identical to ``run``'s — asserted by tests/test_obs_counters.py.
+        """
+        from byzantinerandomizedconsensus_tpu.obs import counters as _counters
+
+        res, _, _, rows = self._run_impl(cfg, inst_ids, collect_state=False,
+                                         counters=True)
+        totals = _counters.finalize(res.config, rows)
+        return res, _counters.counters_doc(res.config, totals, backend=self.name)
 
     def run_with_adversary(self, cfg: SimConfig, adv: AdversaryModel,
                            inst_ids: Optional[np.ndarray] = None) -> SimResult:
@@ -44,7 +60,7 @@ class NumpyBackend(SimulatorBackend):
         swap in AdversaryModel subclasses (e.g. alternative scheduling-bias
         rules) without forking the round loop. Product configs never need this
         — ``run`` always uses the spec §6 model."""
-        res, _, _ = self._run_impl(cfg, inst_ids, collect_state=False, adv=adv)
+        res, _, _, _ = self._run_impl(cfg, inst_ids, collect_state=False, adv=adv)
         return res
 
     def run_with_state(self, cfg: SimConfig,
@@ -60,9 +76,12 @@ class NumpyBackend(SimulatorBackend):
         Agreement — at-scale tests must instead assert Agreement/Validity
         over every replica of the state the product path actually computed.
         """
-        return self._run_impl(cfg, inst_ids, collect_state=True)
+        return self._run_impl(cfg, inst_ids, collect_state=True)[:3]
 
-    def _run_impl(self, cfg: SimConfig, inst_ids, collect_state: bool, adv=None):
+    def _run_impl(self, cfg: SimConfig, inst_ids, collect_state: bool, adv=None,
+                  counters: bool = False):
+        if counters:
+            from byzantinerandomizedconsensus_tpu.obs import counters as _c
         cfg = cfg.validate()
         ids = self._resolve_inst_ids(cfg, inst_ids)
         round_body = benor.round_body if cfg.protocol == "benor" else bracha.round_body
@@ -72,7 +91,7 @@ class NumpyBackend(SimulatorBackend):
 
         rounds_out = np.full(len(ids), cfg.round_cap, dtype=np.int32)
         decision_out = np.full(len(ids), 2, dtype=np.uint8)
-        states, faulties = [], []
+        states, faulties, counter_rows = [], [], []
 
         for lo in range(0, len(ids), chunk):
             sl = slice(lo, min(lo + chunk, len(ids)))
@@ -81,10 +100,16 @@ class NumpyBackend(SimulatorBackend):
             st = state_mod.init_state(cfg, cfg.seed, cids, xp=np)
             faulty = setup["faulty"]
             done_at = np.full(len(cids), -1, dtype=np.int32)
+            acc = _c.zeros(cfg, len(cids), np) if counters else None
             for r in range(cfg.round_cap):
                 if np.all(done_at >= 0):
                     break
-                st = round_body(cfg, cfg.seed, cids, r, st, adv, setup, xp=np)
+                obs = {} if counters else None
+                st = round_body(cfg, cfg.seed, cids, r, st, adv, setup, xp=np,
+                                obs=obs)
+                if counters:
+                    acc = _c.accumulate(acc, _c.round_increments(cfg, obs, np),
+                                        done_at < 0, cfg, np)
                 done_now = state_mod.all_correct_decided(st, faulty, xp=np)
                 done_at = np.where((done_at < 0) & done_now, r + 1, done_at)
             done = done_at >= 0
@@ -93,12 +118,18 @@ class NumpyBackend(SimulatorBackend):
             if collect_state:
                 states.append(st)
                 faulties.append(faulty)
+            if counters:
+                counter_rows.append(acc)
 
         res = SimResult(config=cfg, inst_ids=ids, rounds=rounds_out, decision=decision_out)
+        rows = None
+        if counters:
+            rows = (np.concatenate(counter_rows) if counter_rows
+                    else _c.zeros(cfg, 0, np))
         if not collect_state:
-            return res, None, None
+            return res, None, None, rows
         if not states:  # empty inst_ids: mirror run()'s empty-result support
             empty = state_mod.init_state(cfg, cfg.seed, ids, xp=np)
-            return res, empty, np.zeros((0, cfg.n), dtype=bool)
+            return res, empty, np.zeros((0, cfg.n), dtype=bool), rows
         state = {k: np.concatenate([s[k] for s in states]) for k in states[0]}
-        return res, state, np.concatenate(faulties)
+        return res, state, np.concatenate(faulties), rows

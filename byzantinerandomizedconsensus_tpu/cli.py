@@ -94,16 +94,42 @@ def cmd_run(args) -> int:
     from byzantinerandomizedconsensus_tpu.utils import profiling
 
     cfg = _config_from(args)
+    counters_doc = None
     with profiling.trace(args.profile):
         if args.total_instances:
             from byzantinerandomizedconsensus_tpu.utils import multiseed
 
+            if args.counters:
+                print("--counters is not supported with --total-instances "
+                      "(multi-seed shards have no counter channel yet)",
+                      file=sys.stderr)
+                return 2
             res, shards = multiseed.run_large(
                 cfg, args.total_instances, backend=args.backend,
                 progress=lambda msg: print(msg, file=sys.stderr))
+        elif args.counters:
+            # The protocol-counter side output (obs/counters.py): same run,
+            # bit-identical results, plus the flight-recorder totals. Backends
+            # without a counter channel degrade to an honest JSON block.
+            from byzantinerandomizedconsensus_tpu.backends import get_backend
+            from byzantinerandomizedconsensus_tpu.obs import counters as _c
+
+            import time
+
+            try:
+                t0 = time.perf_counter()
+                res, counters_doc = get_backend(
+                    args.backend).run_with_counters(cfg)
+                res.wall_s = time.perf_counter() - t0  # same leg timed_run sets
+            except _c.CountersUnsupported as e:
+                print(f"[cli] {e}", file=sys.stderr)
+                counters_doc = _c.unsupported_doc(e)
+                res = Simulator(cfg, args.backend).run()
         else:
             res = Simulator(cfg, args.backend).run()
     out = metrics.summary(res)
+    if counters_doc is not None:
+        out["counters"] = counters_doc
     if args.total_instances:
         # summary already reports the base seed and the grand total (the merged
         # result carries the user's config); the derived per-shard seeds are
@@ -180,7 +206,7 @@ def cmd_sweep(args) -> int:
     from byzantinerandomizedconsensus_tpu.config import SWEEP_NS_EXTENDED
 
     default_ns = SWEEP_NS_EXTENDED if args.extended else sweep.SWEEP_NS
-    out = sweep.run_sweep(
+    points = sweep.run_sweep(
         pathlib.Path(args.out), backend=args.backend,
         ns=tuple(int(x) for x in args.ns) if args.ns else default_ns,
         instances=args.instances, seed=args.seed,
@@ -188,11 +214,13 @@ def cmd_sweep(args) -> int:
         delivery=delivery, round_cap=args.round_cap,
         progress=lambda msg: print(msg, file=sys.stderr),
     )
-    print(json.dumps(out))
+    # One artifact format across all tools (obs/record.py): the per-n
+    # summaries ride under "points", next to the record head.
+    print(json.dumps(sweep.sweep_record(points, args.backend, delivery)))
     if args.plot:
         from byzantinerandomizedconsensus_tpu.utils import plot
 
-        plot.plot_sweep(out, args.plot)
+        plot.plot_sweep(points, args.plot)
         print(f"wrote {args.plot}", file=sys.stderr)
     return 0
 
@@ -208,7 +236,14 @@ def main(argv=None) -> int:
                        help="run this many instances via multi-seed sharding "
                             "(beyond the 2^17 per-seed limit — spec §2)")
     p_run.add_argument("--profile", default=None, metavar="DIR",
-                       help="write a jax.profiler trace (TensorBoard/Perfetto) to DIR")
+                       help="write a jax.profiler trace (TensorBoard/Perfetto) "
+                            "to DIR — phase spans (brc/mask, brc/urn2, "
+                            "brc/coin, ...) label the timeline")
+    p_run.add_argument("--counters", action="store_true",
+                       help="collect the protocol-counter side output "
+                            "(obs/counters.py): delivered/dropped per phase, "
+                            "coin flips, sampler cost counters — results stay "
+                            "bit-identical")
     p_run.set_defaults(fn=cmd_run)
 
     p_bm = sub.add_parser("bitmatch", help="sampled oracle-vs-backend bit-match")
@@ -248,15 +283,19 @@ def main(argv=None) -> int:
     sub.add_parser("product",
                    help="five-preset as-shipped product-run artifact "
                         "(tools/product.py; all further options pass through)")
+    sub.add_parser("ledger",
+                   help="regression-chain ledger over every committed "
+                        "BENCH/MULTICHIP/artifact JSON (tools/ledger.py; "
+                        "all further options pass through)")
 
     if argv is None:
         argv = sys.argv[1:]
-    if argv and argv[0] in ("accept", "slack", "product"):
+    if argv and argv[0] in ("accept", "slack", "product", "ledger"):
         from byzantinerandomizedconsensus_tpu.tools import (
-            acceptance, product, slack)
+            acceptance, ledger, product, slack)
 
         tool = {"accept": acceptance, "slack": slack,
-                "product": product}[argv[0]]
+                "product": product, "ledger": ledger}[argv[0]]
         return tool.main(argv[1:])
     args = ap.parse_args(argv)
     if getattr(args, "backend", "").startswith("jax"):

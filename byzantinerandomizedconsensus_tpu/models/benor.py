@@ -11,17 +11,12 @@ from __future__ import annotations
 import numpy as np
 
 from byzantinerandomizedconsensus_tpu.models import coins
-from byzantinerandomizedconsensus_tpu.ops import delivery_counts_fn, masks, tally
-
-
-def _step_counts(cfg, seed, inst_ids, rnd, t, values, silent, bias, xp, recv_ids=None):
-    m = masks.delivery_mask(cfg, seed, inst_ids, rnd, t, silent, bias, xp=xp,
-                            recv_ids=recv_ids)
-    return tally.tally01(m, values, xp=xp)
+from byzantinerandomizedconsensus_tpu.models.delivery import make_counts
+from byzantinerandomizedconsensus_tpu.utils import profiling
 
 
 def round_body(cfg, seed, inst_ids, rnd, state, adv, setup, xp=np,
-               recv_ids=None, gather=None, counts_fn=None):
+               recv_ids=None, gather=None, counts_fn=None, obs=None):
     """Execute one Ben-Or round; returns the new state dict.
 
     ``recv_ids``/``gather`` support the replica-sharded path (parallel/sharded.py):
@@ -32,43 +27,42 @@ def round_body(cfg, seed, inst_ids, rnd, state, adv, setup, xp=np,
     kernel, ops/pallas_tally.py) for the default masks+tally path; it receives
     the pre-inject honest vector so equivocation matrices can be recomputed
     in-kernel (the unused inject output is dead-code-eliminated under jit).
+
+    ``obs``, when a dict, collects the opt-in counter side outputs per step
+    (models/delivery.py; obs/counters.py) — a pure side channel the round
+    math never reads, so the bit-match surface is identical either way.
     """
     n, f = cfg.n, cfg.f
     if gather is None:
         gather = lambda v: v
     est, decided = state["est"], state["decided"]
-
-    def counts(t, honest, v, s, b):
-        if counts_fn is not None:
-            return counts_fn(cfg, seed, inst_ids, rnd, t, v, s,
-                             setup["faulty"], honest, recv_ids=recv_ids)
-        if cfg.count_level:
-            return delivery_counts_fn(cfg.delivery)(
-                cfg, seed, inst_ids, rnd, t, v, s,
-                setup["faulty"], honest, recv_ids=recv_ids, xp=xp)
-        return _step_counts(cfg, seed, inst_ids, rnd, t, v, s, b, xp, recv_ids)
+    counts = make_counts(cfg, seed, inst_ids, rnd, setup, xp,
+                         recv_ids=recv_ids, counts_fn=counts_fn, obs=obs)
 
     # Protocol A (benign) vs Protocol B (lying) thresholds — spec §5.1.
     quorum_rhs = n + f if cfg.lying_adversary else n
     adopt_min = f + 1 if cfg.lying_adversary else 1
 
     # Step 0 — report: broadcast est.
-    h0 = gather(est)
-    v0, silent0, bias0 = adv.inject(seed, inst_ids, rnd, 0, h0, setup,
-                                    xp=xp, recv_ids=recv_ids)
-    r0, r1 = counts(0, h0, v0, silent0, bias0)
-    prop = xp.where(2 * r1 > quorum_rhs, xp.uint8(1),
-                    xp.where(2 * r0 > quorum_rhs, xp.uint8(0), xp.uint8(2)))
+    with profiling.annotate("brc/benor/report"):
+        h0 = gather(est)
+        v0, silent0, bias0 = adv.inject(seed, inst_ids, rnd, 0, h0, setup,
+                                        xp=xp, recv_ids=recv_ids)
+        r0, r1 = counts(0, h0, v0, silent0, bias0)
+        prop = xp.where(2 * r1 > quorum_rhs, xp.uint8(1),
+                        xp.where(2 * r0 > quorum_rhs, xp.uint8(0), xp.uint8(2)))
 
     # Step 1 — propose: broadcast prop (bot = 2 excluded from counts).
-    h1 = gather(prop)
-    v1, silent1, bias1 = adv.inject(seed, inst_ids, rnd, 1, h1, setup,
-                                    xp=xp, recv_ids=recv_ids)
-    p0, p1 = counts(1, h1, v1, silent1, bias1)
-    w = (p1 >= p0).astype(xp.uint8)
-    c = xp.where(w == 1, p1, p0)
+    with profiling.annotate("brc/benor/propose"):
+        h1 = gather(prop)
+        v1, silent1, bias1 = adv.inject(seed, inst_ids, rnd, 1, h1, setup,
+                                        xp=xp, recv_ids=recv_ids)
+        p0, p1 = counts(1, h1, v1, silent1, bias1)
+        w = (p1 >= p0).astype(xp.uint8)
+        c = xp.where(w == 1, p1, p0)
 
-    coin = coins.coin_bits(cfg, seed, inst_ids, rnd, xp=xp, recv_ids=recv_ids)
+    with profiling.annotate("brc/coin"):
+        coin = coins.coin_bits(cfg, seed, inst_ids, rnd, xp=xp, recv_ids=recv_ids)
     new_est = xp.where(c >= adopt_min, w, coin).astype(xp.uint8)
     decide_now = (2 * c > n + f) if cfg.lying_adversary else (c >= f + 1)
 

@@ -15,17 +15,12 @@ from __future__ import annotations
 import numpy as np
 
 from byzantinerandomizedconsensus_tpu.models import coins, validation
-from byzantinerandomizedconsensus_tpu.ops import delivery_counts_fn, masks, tally
-
-
-def _step_counts(cfg, seed, inst_ids, rnd, t, values, silent, bias, xp, recv_ids=None):
-    m = masks.delivery_mask(cfg, seed, inst_ids, rnd, t, silent, bias, xp=xp,
-                            recv_ids=recv_ids)
-    return tally.tally01(m, values, xp=xp)
+from byzantinerandomizedconsensus_tpu.models.delivery import make_counts
+from byzantinerandomizedconsensus_tpu.utils import profiling
 
 
 def round_body(cfg, seed, inst_ids, rnd, state, adv, setup, xp=np,
-               recv_ids=None, gather=None, counts_fn=None):
+               recv_ids=None, gather=None, counts_fn=None, obs=None):
     """Execute one Bracha round; returns the new state dict.
 
     ``recv_ids``/``gather`` support the replica-sharded path (parallel/sharded.py):
@@ -35,51 +30,53 @@ def round_body(cfg, seed, inst_ids, rnd, state, adv, setup, xp=np,
 
     ``counts_fn`` swaps the delivery+tally implementation (the fused Pallas
     kernel, ops/pallas_tally.py) for the default masks+tally path.
+
+    ``obs``, when a dict, collects the opt-in counter side outputs per step
+    (models/delivery.py; obs/counters.py) — a pure side channel the round
+    math never reads, so the bit-match surface is identical either way. The
+    recorded per-step ``silent`` includes the spec §5.1b validation
+    silences, matching what the delivery law actually saw.
     """
     n, f = cfg.n, cfg.f
     if gather is None:
         gather = lambda v: v
     est, decided = state["est"], state["decided"]
-
-    def counts(t, honest, v, s, b):
-        if counts_fn is not None:
-            return counts_fn(cfg, seed, inst_ids, rnd, t, v, s,
-                             setup["faulty"], honest, recv_ids=recv_ids)
-        if cfg.count_level:
-            return delivery_counts_fn(cfg.delivery)(
-                cfg, seed, inst_ids, rnd, t, v, s,
-                setup["faulty"], honest, recv_ids=recv_ids, xp=xp)
-        return _step_counts(cfg, seed, inst_ids, rnd, t, v, s, b, xp, recv_ids)
+    counts = make_counts(cfg, seed, inst_ids, rnd, setup, xp,
+                         recv_ids=recv_ids, counts_fn=counts_fn, obs=obs)
 
     # Step 0 — broadcast est; majority of delivered (ties -> 1).
-    h0 = gather(est)
-    v0, s0, b0 = adv.inject(seed, inst_ids, rnd, 0, h0, setup, xp=xp,
-                            recv_ids=recv_ids)
-    g0_0, g0_1 = validation.live_counts(v0, s0, xp=xp)
-    c0_0, c0_1 = counts(0, h0, v0, s0, b0)
-    m = (c0_1 >= c0_0).astype(xp.uint8)
+    with profiling.annotate("brc/bracha/initial"):
+        h0 = gather(est)
+        v0, s0, b0 = adv.inject(seed, inst_ids, rnd, 0, h0, setup, xp=xp,
+                                recv_ids=recv_ids)
+        g0_0, g0_1 = validation.live_counts(v0, s0, xp=xp)
+        c0_0, c0_1 = counts(0, h0, v0, s0, b0)
+        m = (c0_1 >= c0_0).astype(xp.uint8)
 
     # Step 1 — broadcast m; invalid messages silenced pre-delivery (spec §5.1b);
     # decide-proposal needs an absolute > n/2 quorum.
-    h1 = gather(m)
-    v1, s1, b1 = adv.inject(seed, inst_ids, rnd, 1, h1, setup, xp=xp,
-                            recv_ids=recv_ids)
-    s1 = s1 | validation.validate_step1(cfg, v1, g0_0, g0_1, xp=xp)
-    g1_0, g1_1 = validation.live_counts(v1, s1, xp=xp)
-    c1_0, c1_1 = counts(1, h1, v1, s1, b1)
-    d = xp.where(2 * c1_1 > n, xp.uint8(1),
-                 xp.where(2 * c1_0 > n, xp.uint8(0), xp.uint8(2)))
+    with profiling.annotate("brc/bracha/echo"):
+        h1 = gather(m)
+        v1, s1, b1 = adv.inject(seed, inst_ids, rnd, 1, h1, setup, xp=xp,
+                                recv_ids=recv_ids)
+        s1 = s1 | validation.validate_step1(cfg, v1, g0_0, g0_1, xp=xp)
+        g1_0, g1_1 = validation.live_counts(v1, s1, xp=xp)
+        c1_0, c1_1 = counts(1, h1, v1, s1, b1)
+        d = xp.where(2 * c1_1 > n, xp.uint8(1),
+                     xp.where(2 * c1_0 > n, xp.uint8(0), xp.uint8(2)))
 
     # Step 2 — broadcast d (bot = 2 excluded from counts); validated against G1.
-    h2 = gather(d)
-    v2, s2, b2 = adv.inject(seed, inst_ids, rnd, 2, h2, setup, xp=xp,
-                            recv_ids=recv_ids)
-    s2 = s2 | validation.validate_step2(cfg, v2, g1_0, g1_1, xp=xp)
-    c2_0, c2_1 = counts(2, h2, v2, s2, b2)
-    w = (c2_1 >= c2_0).astype(xp.uint8)
-    c = xp.where(w == 1, c2_1, c2_0)
+    with profiling.annotate("brc/bracha/ready"):
+        h2 = gather(d)
+        v2, s2, b2 = adv.inject(seed, inst_ids, rnd, 2, h2, setup, xp=xp,
+                                recv_ids=recv_ids)
+        s2 = s2 | validation.validate_step2(cfg, v2, g1_0, g1_1, xp=xp)
+        c2_0, c2_1 = counts(2, h2, v2, s2, b2)
+        w = (c2_1 >= c2_0).astype(xp.uint8)
+        c = xp.where(w == 1, c2_1, c2_0)
 
-    coin = coins.coin_bits(cfg, seed, inst_ids, rnd, xp=xp, recv_ids=recv_ids)
+    with profiling.annotate("brc/coin"):
+        coin = coins.coin_bits(cfg, seed, inst_ids, rnd, xp=xp, recv_ids=recv_ids)
     decide_now = c >= 2 * f + 1
     adopt = c >= f + 1
     new_est = xp.where(adopt, w, coin).astype(xp.uint8)

@@ -1,0 +1,58 @@
+"""Shared delivery-count dispatch of the two round bodies (benor/bracha).
+
+One closure decides, per step, which delivery+tally implementation runs: a
+caller-supplied custom kernel (the fused Pallas paths), the registered
+count-level sampler (spec §4b / §4b-v2 / §4c), or the spec-§4 masks+tally
+path — and, when the opt-in counter side channel is enabled, records each
+step's count outputs into ``obs`` for obs/counters.py. Factored here so the
+two protocols cannot drift in either the dispatch rule or the side-channel
+shape.
+"""
+
+from __future__ import annotations
+
+from byzantinerandomizedconsensus_tpu.ops import delivery_counts_fn, masks, tally
+from byzantinerandomizedconsensus_tpu.utils import profiling
+
+
+def make_counts(cfg, seed, inst_ids, rnd, setup, xp, recv_ids=None,
+                counts_fn=None, obs=None):
+    """Build the ``counts(t, honest, values, silent, bias) -> (c0, c1)``
+    closure a round body calls once per broadcast step.
+
+    ``obs``, when a dict, receives per-step entries
+    ``obs[t] = {"c0", "c1", "silent", "stats"}`` — a pure side channel that
+    the step math never reads, so enabling it cannot move the bit-match
+    surface. ``stats`` carries the sampler-owned cost counters (chain trips
+    etc.; see the ``stats`` parameter of the ops/urn*.py samplers). Custom
+    kernels (``counts_fn`` given) have no side channel — backends gate
+    counter collection to the default paths (obs/counters.CountersUnsupported).
+    """
+
+    def counts(t, honest, values, silent, bias):
+        if counts_fn is not None:
+            return counts_fn(cfg, seed, inst_ids, rnd, t, values, silent,
+                             setup["faulty"], honest, recv_ids=recv_ids)
+        if cfg.count_level:
+            fn = delivery_counts_fn(cfg.delivery)
+            with profiling.annotate(f"brc/{cfg.delivery}"):
+                if obs is None:
+                    return fn(cfg, seed, inst_ids, rnd, t, values, silent,
+                              setup["faulty"], honest, recv_ids=recv_ids,
+                              xp=xp)
+                stats = {}
+                c0, c1 = fn(cfg, seed, inst_ids, rnd, t, values, silent,
+                            setup["faulty"], honest, recv_ids=recv_ids, xp=xp,
+                            stats=stats)
+                obs[t] = {"c0": c0, "c1": c1, "silent": silent, "stats": stats}
+                return c0, c1
+        with profiling.annotate("brc/mask"):
+            m = masks.delivery_mask(cfg, seed, inst_ids, rnd, t, silent, bias,
+                                    xp=xp, recv_ids=recv_ids)
+        with profiling.annotate("brc/tally"):
+            c0, c1 = tally.tally01(m, values, xp=xp)
+        if obs is not None:
+            obs[t] = {"c0": c0, "c1": c1, "silent": silent, "stats": {}}
+        return c0, c1
+
+    return counts

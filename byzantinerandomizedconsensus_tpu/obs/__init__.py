@@ -1,0 +1,26 @@
+"""Observability subsystem (flight recorder): protocol counters harvested from
+the round kernels (obs/counters.py), the unified versioned run-record schema
+every artifact-writing tool emits (obs/record.py), and the committed-artifact
+regression-chain ledger (tools/ledger.py). See docs/OBSERVABILITY.md."""
+
+from byzantinerandomizedconsensus_tpu.obs.counters import (
+    COUNTER_SCHEMA_VERSION,
+    CountersUnsupported,
+    counter_names,
+    phase_names,
+)
+from byzantinerandomizedconsensus_tpu.obs.record import (
+    RECORD_VERSION,
+    env_fingerprint,
+    new_record,
+)
+
+__all__ = [
+    "COUNTER_SCHEMA_VERSION",
+    "CountersUnsupported",
+    "counter_names",
+    "phase_names",
+    "RECORD_VERSION",
+    "env_fingerprint",
+    "new_record",
+]

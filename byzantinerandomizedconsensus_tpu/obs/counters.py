@@ -1,0 +1,198 @@
+"""Protocol counters — the opt-in side-output leg of the round kernel.
+
+The perf story (docs/PERF.md) hangs on *internal* signals the result surface
+never shows: how many messages each phase delivered/dropped, how many coin
+bits the run consumed, and above all how much sequential work the count-level
+samplers actually paid — the §4b drop draws, the §4b-v2 conditional-Bernoulli
+chain trips (is a balanced n=2048 shape really paying ``K = D ≈ 682``?), the
+§4c one-word draws. This module defines those counters once, for every stack
+that can harvest them:
+
+- the **vectorized stacks** (numpy / jax — the shared round bodies) collect
+  the full set, including the sampler-owned cost counters, via a pure side
+  output: ``round_body(..., obs=...)`` records per-round per-instance
+  increment vectors, and the backend folds them under the same
+  ``done_at < 0`` activity mask that gates state updates. Nothing feeds back
+  into the round math, so enabling counters leaves the bit-match surface
+  (``rounds``/``decision``) bit-identical by construction — and proven by
+  tests/test_obs_counters.py;
+- the **scalar oracle** (backends/cpu.py) collects the message-level subset
+  (delivered/dropped per phase, coin flips, rounds) with independent python
+  arithmetic, which is what the small-n cross-check anchors the vectorized
+  totals against;
+- the **native core** has no counter channel in its ABI and reports
+  unsupported cleanly (:class:`CountersUnsupported` from the backend seam).
+
+Accumulator representation: per-instance ``(B, C, 2)`` uint32 — a manual
+(lo, hi) 64-bit pair per counter, because jax without x64 silently narrows
+int64 and a chunk-total of delivered messages overflows uint32 within a few
+rounds at benchmark scale. Per-*round* per-instance increments provably fit
+uint32 (≤ steps·n² ≤ 3·4096² < 2³²), so one add-with-carry per round is
+exact. ``chain_trips_max`` is a max-merged counter (hi word unused). The
+host-side :func:`finalize` folds rows to exact python ints.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+COUNTER_SCHEMA_VERSION = 1
+
+# Step-index → phase-name mapping per protocol. Ben-Or's two broadcast steps
+# are the classic report/propose pair (models/benor.py); Bracha's three are
+# named after the reliable-broadcast ladder the count-level simulation stands
+# in for (spec §5.2): initial value, echo quorum, ready/decide amplification.
+PHASE_NAMES = {
+    "benor": ("report", "propose"),
+    "bracha": ("initial", "echo", "ready"),
+}
+
+
+class CountersUnsupported(RuntimeError):
+    """Raised by backends that have no counter channel (native ABI, Pallas
+    kernels, sharded meshes). Callers that build run records catch this and
+    record ``{"supported": false, "reason": ...}`` instead of dying."""
+
+
+def phase_names(cfg) -> tuple[str, ...]:
+    return PHASE_NAMES[cfg.protocol]
+
+
+def counter_names(cfg) -> tuple[str, ...]:
+    """The counter schema for one config, in accumulator column order.
+
+    Per phase: ``delivered0@ph`` / ``delivered1@ph`` (value-bearing messages
+    delivered, own self-delivery included — the oracle counts the same way),
+    ``dropped@ph`` (the §4/§4b drop total ``Σ_v max(0, L_v − (n−f−1))``,
+    identical across all four delivery laws because it depends only on the
+    silent set). Then ``coin_flips`` (logical coin draws: n per round local,
+    1 shared), ``rounds_active`` (Σ rounds executed ≡ ``rounds.sum()`` — a
+    built-in self-check), and the sampler-owned cost counter of the config's
+    delivery law.
+    """
+    names = []
+    for ph in phase_names(cfg):
+        names += [f"delivered0@{ph}", f"delivered1@{ph}", f"dropped@{ph}"]
+    names += ["coin_flips", "rounds_active"]
+    names += _SAMPLER_COUNTERS.get(cfg.delivery, ())
+    return tuple(names)
+
+
+# Sampler-owned cost counters (filled by the ops/ samplers via their ``stats``
+# out-param; see ops/urn.py, ops/urn2.py, ops/urn3.py):
+#   urn_draws        — §4b sequential LCG draws (= the drop total, by law)
+#   chain_trips      — §4b-v2 conditional-Bernoulli trips Σ_segments Σ_lanes K
+#   chain_trips_max  — max per-(lane, segment) K seen (the "K = D?" signal)
+#   urn3_words       — §4c Threefry words (one per receiver-step)
+_SAMPLER_COUNTERS = {
+    "urn": ("urn_draws",),
+    "urn2": ("chain_trips", "chain_trips_max"),
+    "urn3": ("urn3_words",),
+}
+
+_MAX_COUNTERS = frozenset({"chain_trips_max"})
+
+
+def max_mask(cfg) -> np.ndarray:
+    """(C,) bool — True where the counter merges by max, not sum. A static
+    numpy constant in both eager and traced code."""
+    return np.array([n in _MAX_COUNTERS for n in counter_names(cfg)])
+
+
+def zeros(cfg, batch: int, xp=np):
+    """(B, C, 2) uint32 accumulator — [..., 0] = lo word, [..., 1] = hi."""
+    return xp.zeros((batch, len(counter_names(cfg)), 2), dtype=xp.uint32)
+
+
+def round_increments(cfg, obs: dict, xp=np):
+    """(B, C) uint32 — one round's per-instance counter increments, assembled
+    from the per-step entries ``round_body`` recorded into ``obs``:
+    ``obs[t] = {"c0", "c1", "silent", "stats"}`` for every step t.
+    """
+    u32, i32 = xp.uint32, xp.int32
+    steps = cfg.steps_per_round
+    if sorted(obs) != list(range(steps)):
+        raise ValueError(f"obs is missing step entries: have {sorted(obs)}")
+    batch = obs[0]["c0"].shape[0]
+    k = i32(cfg.n - cfg.f - 1)
+
+    cols = []
+    for t in range(steps):
+        e = obs[t]
+        cols.append(e["c0"].sum(axis=-1).astype(u32))
+        cols.append(e["c1"].sum(axis=-1).astype(u32))
+        # Drop total from the silent set alone (spec §4: every delivery law
+        # drops exactly max(0, L_v − (n−f−1)) live messages per receiver).
+        live = ~xp.asarray(e["silent"], dtype=bool)
+        tot = live.sum(axis=-1, dtype=i32)
+        L = (tot[:, None] - live.astype(i32)).astype(i32)
+        cols.append(xp.maximum(L - k, i32(0)).sum(axis=-1).astype(u32))
+    coin = cfg.n if cfg.coin == "local" else 1
+    cols.append(xp.full((batch,), coin, dtype=xp.uint32))
+    cols.append(xp.full((batch,), 1, dtype=xp.uint32))
+    for name in _SAMPLER_COUNTERS.get(cfg.delivery, ()):
+        if name == "chain_trips_max":
+            per_step = [obs[t]["stats"][name] for t in range(steps)]
+            acc = per_step[0]
+            for v in per_step[1:]:
+                acc = xp.maximum(acc, v)
+            cols.append(acc.astype(u32))
+        else:
+            acc = obs[0]["stats"][name].astype(u32)
+            for t in range(1, steps):
+                acc = (acc + obs[t]["stats"][name].astype(u32)).astype(u32)
+            cols.append(acc)
+    return xp.stack(cols, axis=1)
+
+
+def accumulate(acc, inc, active, cfg, xp=np):
+    """Fold one round's increments into the (B, C, 2) accumulator.
+
+    ``active`` is the (B,) bool undecided-at-round-entry mask — the same
+    eligibility the oracle realizes by stopping its per-instance round loop,
+    so per-instance totals agree across stacks. Sum counters add with an
+    explicit uint32 carry; max counters max-merge the lo word.
+    """
+    u32 = xp.uint32
+    inc = xp.where(active[:, None], inc, u32(0)).astype(u32)
+    lo, hi = acc[..., 0], acc[..., 1]
+    lo_sum = (lo + inc).astype(u32)
+    hi_sum = (hi + (lo_sum < inc).astype(u32)).astype(u32)
+    ismax = max_mask(cfg)[None, :]
+    new_lo = xp.where(ismax, xp.maximum(lo, inc), lo_sum)
+    new_hi = xp.where(ismax, hi, hi_sum)
+    return xp.stack([new_lo, new_hi], axis=-1).astype(u32)
+
+
+def finalize(cfg, rows: np.ndarray) -> dict:
+    """Fold per-instance (I, C, 2) uint32 accumulator rows (padding already
+    dropped) into exact python-int totals keyed by counter name."""
+    names = counter_names(cfg)
+    rows = np.asarray(rows, dtype=np.uint64)
+    totals = {}
+    for c, name in enumerate(names):
+        lo, hi = rows[:, c, 0], rows[:, c, 1]
+        if name in _MAX_COUNTERS:
+            totals[name] = int(lo.max()) if len(lo) else 0
+        else:
+            totals[name] = int(lo.sum()) + (int(hi.sum()) << 32)
+    return totals
+
+
+def counters_doc(cfg, totals: dict, backend: str = "?") -> dict:
+    """The counters block a run record carries (docs/OBSERVABILITY.md)."""
+    return {
+        "schema": COUNTER_SCHEMA_VERSION,
+        "supported": True,
+        "backend": backend,
+        "protocol": cfg.protocol,
+        "delivery": cfg.delivery,
+        "phases": list(phase_names(cfg)),
+        "totals": dict(totals),
+    }
+
+
+def unsupported_doc(reason) -> dict:
+    """The honest degradation block (same convention as device_busy_error)."""
+    return {"schema": COUNTER_SCHEMA_VERSION, "supported": False,
+            "reason": str(reason)}

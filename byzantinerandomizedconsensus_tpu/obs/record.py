@@ -1,0 +1,162 @@
+"""Run records — one versioned schema for every artifact this repo commits.
+
+Through round 7 each tool (bench.py, soak, cost_curve, ab_delivery, product,
+sweep) invented its own artifact dict, so auditing the r1–r7 trajectory meant
+reverse-engineering six formats. A v1 run record standardizes the parts every
+artifact needs while leaving each tool its payload keys:
+
+- ``record_version`` / ``kind`` — schema version and the producing tool;
+- ``env`` — the environment fingerprint (:func:`env_fingerprint`): jax/numpy/
+  python versions, device platform+kind when initialized, package version,
+  native ABI version, known spec §2 packing laws. The fields a regression
+  hunt asks for first and the old artifacts never carried;
+- timing legs in the one shape utils/timing.py prescribes
+  (:func:`timing_block`): best-of wall + full ``walls_s`` + spread, and the
+  device-busy leg or its honest error;
+- optional ``counters`` blocks (obs/counters.py) via
+  :func:`collect_counters`, which degrades unsupported backends to a
+  ``{"supported": false}`` block instead of dying;
+- config provenance via :func:`config_block` (dataclasses.asdict + the
+  derived pack_version).
+
+tools/ledger.py consumes both this format and the legacy r1–r7 shapes;
+:func:`validate_record` is the schema check the tier-1 tests pin.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+RECORD_VERSION = 1
+
+
+def env_fingerprint() -> dict:
+    """Environment identity for a run record. Never *initializes* a jax
+    backend (a dead TPU tunnel must not hang record assembly): device fields
+    appear only when the calling tool already brought the backend up."""
+    import platform
+
+    from byzantinerandomizedconsensus_tpu import __version__
+    from byzantinerandomizedconsensus_tpu.ops import prf
+
+    out = {
+        "package": __version__,
+        "python": platform.python_version(),
+        "numpy": np.__version__,
+        "pack_versions": sorted(prf.PACK_SHIFTS),
+    }
+    try:
+        from byzantinerandomizedconsensus_tpu.backends.native_backend import (
+            _ABI_VERSION)
+
+        out["native_abi"] = _ABI_VERSION
+    except Exception:  # never let an optional stack break record assembly
+        out["native_abi"] = None
+    try:
+        import jax
+
+        out["jax"] = jax.__version__
+    except Exception:
+        out["jax"] = None
+        return out
+    # Device fields are best-effort and must never clobber the version
+    # already captured: the private xla_bridge probe can drift across jax
+    # releases, and jax.devices() on an initialized-but-dead tunnel raises —
+    # both degrade to platform="unknown", not to jax=None.
+    try:
+        from jax._src import xla_bridge as xb
+
+        if xb.backends_are_initialized():
+            out["platform"] = jax.default_backend()
+            devs = jax.devices()
+            out["device_kind"] = devs[0].device_kind if devs else None
+            out["device_count"] = len(devs)
+        else:
+            out["platform"] = "uninitialized"
+    except Exception:
+        out["platform"] = "unknown"
+    return out
+
+
+def new_record(kind: str, description: str | None = None,
+               config=None) -> dict:
+    """The shared head every artifact document merges its payload into."""
+    out = {"record_version": RECORD_VERSION, "kind": kind}
+    if description is not None:
+        out["description"] = description
+    out["env"] = env_fingerprint()
+    if config is not None:
+        out["config"] = config_block(config)
+    return out
+
+
+def config_block(cfg) -> dict:
+    d = dataclasses.asdict(cfg)
+    d["pack_version"] = cfg.pack_version
+    return d
+
+
+def timing_block(walls, device: dict | None = None) -> dict:
+    """The canonical timing leg (utils/timing.py discipline): best-of wall,
+    the full walls list + spread, and the device-busy measurement or its
+    honest error — absence-of-signal 0.0s (``device_busy_suspect``) are
+    errors, never measurements (VERDICT r5 weak #1)."""
+    from byzantinerandomizedconsensus_tpu.utils.timing import spread
+
+    best = min(walls)
+    out = {
+        "wall_s": round(best, 3),
+        "walls_s": [round(w, 3) for w in walls],
+        "walls_spread": round(spread(walls), 3),
+    }
+    if device is not None:
+        if "device_busy_suspect" in device:
+            out["device_busy_error"] = device["device_busy_suspect"]
+        elif "device_busy_s" in device:
+            out["device_busy_s"] = device["device_busy_s"]
+        else:
+            out["device_busy_error"] = device.get("error", "?")
+    return out
+
+
+def collect_counters(be, cfg, inst_ids=None) -> dict:
+    """Run ``cfg`` once more with the counter leg enabled and return the
+    counters block; backends without a counter channel (native, Pallas,
+    meshes) degrade to an ``unsupported`` block. The counted run is separate
+    from any timed run by design — the timed window stays counter-free."""
+    from byzantinerandomizedconsensus_tpu.obs import counters as _c
+
+    try:
+        _res, doc = be.run_with_counters(cfg, inst_ids)
+        return doc
+    except _c.CountersUnsupported as e:
+        return _c.unsupported_doc(e)
+
+
+def validate_record(doc: dict) -> list:
+    """Schema check: returns a list of problems (empty = valid v1 record)."""
+    problems = []
+    if not isinstance(doc, dict):
+        return [f"record is {type(doc).__name__}, not a dict"]
+    if doc.get("record_version") != RECORD_VERSION:
+        problems.append(f"record_version {doc.get('record_version')!r} != "
+                        f"{RECORD_VERSION}")
+    if not isinstance(doc.get("kind"), str) or not doc.get("kind"):
+        problems.append("missing/empty 'kind'")
+    env = doc.get("env")
+    if not isinstance(env, dict):
+        problems.append("missing 'env' fingerprint")
+    else:
+        for key in ("package", "python", "numpy"):
+            if key not in env:
+                problems.append(f"env missing {key!r}")
+    counters = doc.get("counters")
+    if counters is not None and isinstance(counters, dict):
+        if "supported" not in counters:
+            problems.append("counters block missing 'supported'")
+        elif counters["supported"] and not isinstance(
+                counters.get("totals"), dict):
+            problems.append("supported counters block missing 'totals'")
+    return problems

@@ -111,7 +111,7 @@ def lane_setup(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
 
 
 def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
-              recv_ids=None, xp=np):
+              recv_ids=None, xp=np, stats=None):
     """(c0, c1) delivered-value counts per receiver lane — spec §4b.
 
     Signature matches the round-body ``counts_fn`` hook. ``values`` is the
@@ -119,6 +119,11 @@ def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
     keys model is ignored here — §4b replaces it with two-faced class values
     recomputed from ``honest``/``faulty``). ``silent`` (B, n) includes
     validation silences. Returns two (B, R) int32.
+
+    ``stats``, when a dict, receives this sampler's cost counter as a pure
+    side output (obs/counters.py): ``urn_draws`` (B,) — the §4b sequential
+    LCG draws, which the law fixes at the drop total ΣD (the vectorized
+    f-iteration loop masks the rest). Never read back into the draw math.
     """
     f = cfg.f
     u32, i32 = xp.uint32, xp.int32
@@ -126,6 +131,8 @@ def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
     recv, own_val, m, st, L, D = lane_setup(
         cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
         recv_ids=recv_ids, xp=xp)
+    if stats is not None:
+        stats["urn_draws"] = D.sum(axis=-1).astype(u32)
     adaptive = cfg.adversary in ("adaptive", "adaptive_min")
 
     inst = xp.asarray(inst_ids, dtype=xp.uint32)[:, None]

@@ -84,18 +84,43 @@ def _chain(seed, inst_ids, rnd, t, recv, seg, m, Lr, Dr, xp, pack=1):
     return xp.where(is_comp, Dr - a, a).astype(i32)
 
 
+def _trips(mm, Lr, Dr, xp):
+    """Per-lane chain length of one segment: K = min(m, L−m, D) — the exact
+    trip count :func:`_chain`'s corner selection derives. Recomputed here (3
+    elementwise ops) for the opt-in counter side output rather than plumbed
+    out of ``_chain``, so the sampler's own dataflow is untouched."""
+    return xp.minimum(xp.minimum(mm, (Lr - mm).astype(xp.int32)), Dr)
+
+
 def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
-              recv_ids=None, xp=np):
+              recv_ids=None, xp=np, stats=None):
     """(c0, c1) delivered-value counts per receiver lane — spec §4b-v2.
 
     Same hook signature and same class/stratum state (ops/urn.py::lane_setup)
     as the §4b sampler; only the drop sampling differs.
+
+    ``stats``, when a dict, receives the sampler's cost counters as pure side
+    outputs (obs/counters.py): ``chain_trips`` (B,) — Σ over segments and
+    lanes of the conditional-Bernoulli chain length K — and
+    ``chain_trips_max`` (B,) — the max per-(lane, segment) K, the direct
+    "is this shape paying K = D?" signal. Never read back into the draws.
     """
     i32 = xp.int32
     recv, own_val, m, st, L, D = urn.lane_setup(
         cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
         recv_ids=recv_ids, xp=xp)
     adaptive = cfg.adversary in ("adaptive", "adaptive_min")
+
+    trips_sum = trips_max = None
+
+    def note_trips(mm, Lr, Dr):
+        nonlocal trips_sum, trips_max
+        if stats is None:
+            return
+        K = _trips(mm, Lr, Dr, xp)
+        s, mx = K.sum(axis=-1).astype(xp.uint32), K.max(axis=-1).astype(xp.uint32)
+        trips_sum = s if trips_sum is None else (trips_sum + s).astype(xp.uint32)
+        trips_max = mx if trips_max is None else xp.maximum(trips_max, mx)
 
     d = [None, None]  # total drops attributed to tracked values 0, 1
     if adaptive:
@@ -107,6 +132,7 @@ def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
         # Segments 0-1: biased stratum, values 0 then 1.
         Lr, Dr = Lb, Db
         for w in (0, 1):
+            note_trips(mb[w], Lr, Dr)
             d[w] = _chain(seed, inst_ids, rnd, t, recv, w, mb[w], Lr, Dr, xp,
                           pack=cfg.pack_version)
             Lr = (Lr - mb[w]).astype(i32)
@@ -116,6 +142,7 @@ def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
         Lr = (L - Lb).astype(i32)
         Dr = (D - Db).astype(i32)
         for w in (0, 1):
+            note_trips(mu[w], Lr, Dr)
             du = _chain(seed, inst_ids, rnd, t, recv, 2 + w, mu[w], Lr, Dr, xp,
                         pack=cfg.pack_version)
             d[w] = (d[w] + du).astype(i32)
@@ -126,11 +153,15 @@ def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
         # skipped; segment indices 2-3 are used for seeding per the spec.
         Lr, Dr = L, D
         for w in (0, 1):
+            note_trips(m[w], Lr, Dr)
             d[w] = _chain(seed, inst_ids, rnd, t, recv, 2 + w, m[w], Lr, Dr, xp,
                           pack=cfg.pack_version)
             Lr = (Lr - m[w]).astype(i32)
             Dr = (Dr - d[w]).astype(i32)
 
+    if stats is not None:
+        stats["chain_trips"] = trips_sum
+        stats["chain_trips_max"] = trips_max
     c0 = (m[0] - d[0] + (own_val == 0).astype(i32)).astype(i32)
     c1 = (m[1] - d[1] + (own_val == 1).astype(i32)).astype(i32)
     return c0, c1

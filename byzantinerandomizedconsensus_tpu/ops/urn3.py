@@ -50,17 +50,24 @@ def _cheap(u, seg, m, Lr, Dr, xp):
 
 
 def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
-              recv_ids=None, xp=np):
+              recv_ids=None, xp=np, stats=None):
     """(c0, c1) delivered-value counts per receiver lane — spec §4c.
 
     Same hook signature and same class/stratum state (ops/urn.py::lane_setup)
     as the §4b/§4b-v2 samplers; only the drop law differs (and is cheaper by
     construction, not by inversion).
+
+    ``stats``, when a dict, receives the sampler's cost counter as a pure
+    side output (obs/counters.py): ``urn3_words`` (B,) — the §4c Threefry
+    words drawn, exactly one per receiver lane per step by construction.
     """
     i32 = xp.int32
     recv, own_val, m, st, L, D = urn.lane_setup(
         cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
         recv_ids=recv_ids, xp=xp)
+    if stats is not None:
+        stats["urn3_words"] = xp.full((silent.shape[0],), recv.shape[0],
+                                      dtype=xp.uint32)
     adaptive = cfg.adversary in ("adaptive", "adaptive_min")
 
     inst = xp.asarray(inst_ids, dtype=xp.uint32)[:, None]

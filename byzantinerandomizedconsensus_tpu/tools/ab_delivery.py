@@ -42,16 +42,21 @@ def _shape_config(shape: str, delivery: str, instances: int):
     raise ValueError(f"unknown shape {shape!r}")
 
 
-def measure(shape: str, delivery: str, backend: str, instances: int) -> dict:
+def measure(shape: str, delivery: str, backend: str, instances: int,
+            counters: bool = False) -> dict:
     """One A/B leg — the shared product measurement record (tools/product.py
     run_config: warmed best-of-N walls + device-busy), trimmed of the bulky
     histogram and keyed by delivery. ``_wall_raw`` carries the unrounded best
-    for ratio-forming (rounded wall_s can be a valid 0.0)."""
+    for ratio-forming (rounded wall_s can be a valid 0.0). ``counters`` adds
+    the protocol-counter block (one extra untimed run): the per-sampler cost
+    counters — §4b-v2 ``chain_trips``/``chain_trips_max`` vs §4c
+    ``urn3_words`` — are the internal evidence behind the A/B's wall/device
+    split (docs/OBSERVABILITY.md)."""
     cfg = _shape_config(shape, delivery, instances)
-    entry, raw_walls = run_config(cfg, backend)
+    entry, raw_walls = run_config(cfg, backend, counters=counters)
     keep = ("wall_s", "walls_s", "walls_spread", "instances_per_sec",
             "mean_rounds_decided", "undecided_at_cap", "device_busy_s",
-            "device_busy_error")
+            "device_busy_error", "counters")
     return {"delivery": delivery, "_wall_raw": min(raw_walls),
             **{k: entry[k] for k in keep if k in entry}}
 
@@ -81,6 +86,9 @@ def main(argv=None) -> int:
                     default="config4")
     ap.add_argument("--deliveries", nargs="*", default=["urn", "urn2", "urn3"],
                     choices=list(DELIVERY_KINDS))
+    ap.add_argument("--counters", action="store_true",
+                    help="attach the protocol-counter block per leg "
+                         "(obs/counters.py; one extra untimed run each)")
     args = ap.parse_args(argv)
 
     from byzantinerandomizedconsensus_tpu.utils.devices import ensure_live_backend
@@ -90,10 +98,14 @@ def main(argv=None) -> int:
 
     legs = {}
     for d in args.deliveries:
-        legs[d] = measure(args.shape, d, args.backend, args.instances)
+        legs[d] = measure(args.shape, d, args.backend, args.instances,
+                          counters=args.counters)
         print(json.dumps(legs[d]), flush=True)
 
+    from byzantinerandomizedconsensus_tpu.obs import record
+
     doc = {
+        **record.new_record("ab_delivery"),
         "description": f"{args.shape} delivery-sampler A/B: walls (best-of-N)"
                        " + profiler device-busy per sampler "
                        "(tools/ab_delivery.py; VERDICT r4 #1/#2, r5 next #1)",

@@ -37,18 +37,37 @@ from byzantinerandomizedconsensus_tpu.tools.product import run_config
 from byzantinerandomizedconsensus_tpu.utils.rounds import default_artifact
 
 
+def shape_config(shape: str, n: int, delivery: str, instances: int):
+    """``config5`` — the adaptive sweep shape (bracha, adaptive, shared coin)
+    — or ``balanced``: the config-4 analog at arbitrary n (bracha, NO
+    adversary, shared coin, f = (n−1)//3). The adaptive family's bias strata
+    are value-homogeneous, so the §4b-v2 chains sit in their deterministic
+    corner (K ≈ 0) along the whole config-5 curve (docs/PERF.md round 7);
+    ``balanced`` is the wire-balance regime where the chains genuinely pay —
+    the first real ``K = D`` test at n=2048 (ROADMAP open item #3). Pair it
+    with ``--counters`` to read the measured ``chain_trips_max`` directly.
+    """
+    cfg = sweep_point(n, instances=instances)
+    if shape == "balanced":
+        cfg = dataclasses.replace(cfg, adversary="none")
+    elif shape != "config5":
+        raise ValueError(f"unknown shape {shape!r}")
+    return dataclasses.replace(cfg, delivery=delivery)
+
+
 def _point(n: int, delivery: str, instances: int, backend: str,
-           round_cap: int | None = None) -> dict:
-    cfg = dataclasses.replace(sweep_point(n, instances=instances),
-                              delivery=delivery)
+           round_cap: int | None = None, shape: str = "config5",
+           counters: bool = False) -> dict:
+    cfg = shape_config(shape, n, delivery, instances)
     if round_cap is not None:
         cfg = dataclasses.replace(cfg, round_cap=round_cap)
     cfg = cfg.validate()
-    entry, raw_walls = run_config(cfg, backend)
+    entry, raw_walls = run_config(cfg, backend, counters=counters)
     entry["_wall_raw"] = min(raw_walls)
     entry["n"] = n
     entry["f"] = cfg.f
     entry["delivery"] = delivery
+    entry["shape"] = shape
     entry["pack_version"] = cfg.pack_version
     return entry
 
@@ -99,6 +118,16 @@ def main(argv=None) -> int:
     ap.add_argument("--instances", type=int, default=2000,
                     help="instances per timed point (config-5's sweep count)")
     ap.add_argument("--bitmatch-instances", type=int, default=8)
+    ap.add_argument("--shape", choices=["config5", "balanced"],
+                    default="config5",
+                    help="config5 = the adaptive sweep shape (chains "
+                         "deterministic, K≈0); balanced = the config-4 analog "
+                         "(bracha, no adversary, shared coin) where the "
+                         "§4b-v2 chains genuinely pay — the K=D test shape")
+    ap.add_argument("--counters", action="store_true",
+                    help="attach the protocol-counter block per point "
+                         "(obs/counters.py; chain_trips/chain_trips_max is "
+                         "the direct K=D evidence)")
     args = ap.parse_args(argv)
 
     from byzantinerandomizedconsensus_tpu.utils.devices import ensure_live_backend
@@ -109,7 +138,8 @@ def main(argv=None) -> int:
     legs = []
     for n in args.ns:
         for d in args.deliveries:
-            e = _point(n, d, args.instances, args.backend)
+            e = _point(n, d, args.instances, args.backend, shape=args.shape,
+                       counters=args.counters)
             print(json.dumps({k: v for k, v in e.items()
                               if k != "round_histogram"}), flush=True)
             legs.append(e)
@@ -152,15 +182,19 @@ def main(argv=None) -> int:
         if leg["n"] != max(args.ns):
             leg.pop("round_histogram", None)
 
+    from byzantinerandomizedconsensus_tpu.obs import record
+
     doc = {
+        **record.new_record("cost_curve"),
         "description": "count-level cost curve past the v1 packing edge "
-                       "(spec §2 v2): config-5 shape at n=512/1024/2048, "
+                       "(spec §2 v2): config-5 or balanced shape, "
                        "urn2 vs urn3, walls + device-busy-or-error + "
                        "rounds histograms at the headline n, with the (2,2) "
                        "virtual-mesh sharded bit-match vs native "
                        "(tools/cost_curve.py)",
         "platform": jax.default_backend(),
         "backend": args.backend,
+        "shape": args.shape,
         "instances": args.instances,
         "legs": legs,
         "urn3_vs_urn2_by_n": curve,

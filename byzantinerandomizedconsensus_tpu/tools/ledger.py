@@ -1,0 +1,290 @@
+"""Regression-chain ledger — ROADMAP open item #2 as a checked report.
+
+Parses every committed per-round artifact — ``BENCH_r*.json`` /
+``MULTICHIP_r*.json`` at the repo root, plus ``artifacts/*.json`` — and
+reconstructs the round-over-round regression chain the repo's measurement
+discipline prescribes (utils/timing.regression_verdict; docs/PERF.md):
+
+- the **wall-keyed chain**: consecutive BENCH rounds' instances/sec ratios,
+  recomputed from the committed walls and cross-checked against each
+  artifact's recorded ``vs_prev_round`` (drift between the two means the
+  artifact format or the rule changed under us — the ledger says so);
+- the **device-keyed chain**: the noise-immune ``device_busy_s`` legs. A
+  round without a device leg cannot extend this chain; the ledger names the
+  **anchor** (the newest round that has one — r5's 0.1602 s as of round 7),
+  lists every later round as **broken** with the committed evidence for why
+  (rounds 6–7: no BENCH artifact at all; their artifacts/*_r{6,7}.json all
+  report ``platform: cpu`` / device_busy_error — CPU-only sessions), and
+  prints the exact re-run that closes the gap;
+- a parse census: every committed artifact JSON must load (zero errors is a
+  tier-1 assertion — tests/test_ledger.py — so artifact-format drift fails
+  loudly instead of silently un-auditing a round).
+
+CLI: ``brc-tpu ledger`` (or ``python -m
+byzantinerandomizedconsensus_tpu.tools.ledger``); ``--json FILE`` also writes
+the machine-readable record (kind="ledger"). Exit code 0 iff zero parse
+errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import re
+
+from byzantinerandomizedconsensus_tpu.utils import timing
+from byzantinerandomizedconsensus_tpu.utils.rounds import repo_root
+
+_ROUND_RE = re.compile(r"_r0*(\d+)\.json$")
+
+
+def _round_of(name: str):
+    m = _ROUND_RE.search(name)
+    return int(m.group(1)) if m else None
+
+
+def _parsed(doc):
+    """The payload of a driver-captured artifact ({"parsed": {...}} wrapper)
+    or the document itself when it was written directly."""
+    return doc.get("parsed", doc) if isinstance(doc, dict) else {}
+
+
+def _bench_entry(name: str, doc) -> dict:
+    p = _parsed(doc)
+    detail = p.get("detail") if isinstance(p.get("detail"), dict) else {}
+    try:
+        value = float(p.get("value"))
+    except (TypeError, ValueError):
+        value = None
+    return {
+        "artifact": name,
+        "round": _round_of(name),
+        "value": value,
+        "unit": p.get("unit"),
+        "walls_s": detail.get("walls_s"),
+        "device_busy_s": detail.get("device_busy_s"),
+        "device_busy_error": detail.get("device_busy_error"),
+        "platform": detail.get("platform"),
+        "recorded_vs_prev_round": p.get("vs_prev_round"),
+        "recorded_vs_prev_round_device": p.get("vs_prev_round_device"),
+        "recorded_regression_signal": p.get("regression_signal"),
+    }
+
+
+def _round_span(rounds) -> str:
+    """"6-7" for a contiguous run, "6, 8" otherwise."""
+    rounds = sorted(rounds)
+    if len(rounds) > 1 and rounds == list(range(rounds[0], rounds[-1] + 1)):
+        return f"{rounds[0]}-{rounds[-1]}"
+    return ", ".join(str(r) for r in rounds)
+
+
+def _artifact_round_evidence(artifacts: dict) -> dict:
+    """{round: {"artifacts": [...], "platforms": {...}, "cpu_only": bool}}
+    from the committed artifacts/*.json — the session evidence for rounds
+    that have no BENCH record of their own."""
+    rounds: dict = {}
+    for name, doc in artifacts.items():
+        rnd = _round_of(name)
+        if rnd is None:
+            continue
+        if isinstance(doc, dict) and doc.get("kind") == "ledger":
+            continue  # a committed ledger is an audit, not round evidence
+        e = rounds.setdefault(rnd, {"artifacts": [], "platforms": set(),
+                                    "device_legs": 0, "device_errors": 0})
+        e["artifacts"].append(name)
+        p = _parsed(doc)
+        if isinstance(p, dict):
+            plat = p.get("platform")
+            if plat:
+                e["platforms"].add(str(plat))
+            text = json.dumps(p)
+            e["device_legs"] += text.count('"device_busy_s"')
+            e["device_errors"] += text.count('"device_busy_error"')
+    for e in rounds.values():
+        e["artifacts"].sort()
+        e["cpu_only"] = (e["device_legs"] == 0
+                         and ("cpu" in e["platforms"] or e["device_errors"]))
+        e["platforms"] = sorted(e["platforms"])
+    return rounds
+
+
+def build_ledger(root=None) -> dict:
+    """Assemble the full ledger document from the committed artifacts."""
+    root = pathlib.Path(root or repo_root())
+    files = sorted(root.glob("BENCH_r*.json")) \
+        + sorted(root.glob("MULTICHIP_r*.json")) \
+        + sorted((root / "artifacts").glob("*.json"))
+
+    docs: dict = {}
+    parse_errors = []
+    for p in files:
+        rel = str(p.relative_to(root))
+        try:
+            docs[rel] = json.loads(p.read_text())
+        except (OSError, ValueError) as e:
+            parse_errors.append({"artifact": rel, "error": repr(e)})
+
+    bench = {e["round"]: e for e in
+             (_bench_entry(n, d) for n, d in docs.items()
+              if n.startswith("BENCH_r"))
+             if e["round"] is not None}
+    multichip = {
+        _round_of(n): {"artifact": n, "ok": _parsed(d).get("ok"),
+                       "rc": _parsed(d).get("rc"),
+                       "n_devices": _parsed(d).get("n_devices")}
+        for n, d in docs.items() if n.startswith("MULTICHIP_r")}
+    evidence = _artifact_round_evidence(
+        {n: d for n, d in docs.items() if n.startswith("artifacts/")})
+
+    # ---- the wall-keyed chain: recompute every consecutive-round link and
+    # cross-check the recorded ratio (utils/timing.regression_verdict).
+    chain = []
+    rounds_seen = sorted(bench)
+    for prev_rnd, rnd in zip(rounds_seen, rounds_seen[1:]):
+        a, b = bench[prev_rnd], bench[rnd]
+        link = {"from_round": prev_rnd, "to_round": rnd,
+                "consecutive": rnd == prev_rnd + 1}
+        if a["value"] and b["value"] and b["walls_s"]:
+            verdict = timing.regression_verdict(
+                b["walls_s"], prev_wall_rate=a["value"], rate=b["value"],
+                device_busy_s=b["device_busy_s"],
+                prev_device_busy_s=a["device_busy_s"])
+            link.update(verdict)
+            rec = b["recorded_vs_prev_round"]
+            if rec is not None and "vs_prev_round" in verdict:
+                link["recorded_vs_prev_round"] = rec
+                link["agrees_with_recorded"] = (
+                    abs(verdict["vs_prev_round"] - rec) <= 0.01)
+        else:
+            link["error"] = "missing value or walls on one end"
+        chain.append(link)
+
+    # ---- the device-keyed chain: anchored at the newest round WITH a
+    # device-busy leg; every later committed round without one breaks it.
+    device_rounds = [r for r in rounds_seen if bench[r]["device_busy_s"]]
+    anchor = device_rounds[-1] if device_rounds else None
+    latest_round = max([*rounds_seen, *evidence, *multichip], default=0)
+    broken = []
+    for rnd in range((anchor or 0) + 1, latest_round + 1):
+        if rnd in bench and bench[rnd]["device_busy_s"]:
+            continue  # unreachable while anchor is the newest, kept for form
+        ev = evidence.get(rnd)
+        if rnd in bench:
+            reason = (bench[rnd].get("device_busy_error")
+                      or "BENCH artifact has no device_busy_s leg")
+            if bench[rnd].get("platform") not in (None, "tpu"):
+                reason += f" (platform={bench[rnd]['platform']})"
+        elif ev:
+            reason = ("no BENCH artifact committed for this round; "
+                      f"round artifacts ({', '.join(ev['artifacts'][:3])}"
+                      f"{', ...' if len(ev['artifacts']) > 3 else ''}) report "
+                      f"platform={'/'.join(ev['platforms']) or '?'}"
+                      + (" with device_busy_error legs — CPU-only session"
+                         if ev["cpu_only"] else ""))
+        else:
+            reason = "no committed artifact of any kind for this round"
+        broken.append({"round": rnd, "reason": reason,
+                       "cpu_only": bool(ev and ev["cpu_only"])
+                       or (rnd in bench
+                           and bench[rnd].get("platform") == "cpu")})
+
+    device_chain = {
+        "anchor_round": anchor,
+        "anchor_artifact": bench[anchor]["artifact"] if anchor else None,
+        "anchor_device_busy_s": bench[anchor]["device_busy_s"] if anchor else None,
+        "broken_rounds": broken,
+        "status": ("unbroken" if not broken else
+                   f"broken at round{'s' if len(broken) > 1 else ''} "
+                   f"{_round_span(b['round'] for b in broken)}"
+                   + (" (CPU-only)" if all(b["cpu_only"] for b in broken)
+                      else "")),
+        "closes_with": (
+            "re-run `python bench.py` (and `python -m "
+            "byzantinerandomizedconsensus_tpu.tools.ab_delivery`) on the "
+            "device of record (TPU session): the resulting BENCH artifact's "
+            "device_busy_s restores vs_prev_round_device against "
+            + (f"{bench[anchor]['artifact']}'s "
+               f"{bench[anchor]['device_busy_s']} s" if anchor
+               else "a fresh anchor")) if broken else None,
+    }
+
+    from byzantinerandomizedconsensus_tpu.obs import record
+
+    return {
+        **record.new_record("ledger"),
+        "description": "regression-chain ledger over every committed "
+                       "BENCH/MULTICHIP/artifact JSON (tools/ledger.py; "
+                       "ROADMAP open item #2)",
+        "files_scanned": len(files),
+        "parse_errors": parse_errors,
+        "bench_rounds": {str(r): bench[r] for r in rounds_seen},
+        "wall_chain": chain,
+        "device_chain": device_chain,
+        "multichip_rounds": {str(r): multichip[r] for r in sorted(multichip)},
+        "artifact_round_evidence": {
+            str(r): evidence[r] for r in sorted(evidence)},
+    }
+
+
+def format_report(doc: dict) -> str:
+    """Human-readable rendering of :func:`build_ledger`'s document."""
+    lines = [f"flight-recorder ledger — {doc['files_scanned']} artifact "
+             f"files, {len(doc['parse_errors'])} parse errors"]
+    for err in doc["parse_errors"]:
+        lines.append(f"  PARSE ERROR {err['artifact']}: {err['error']}")
+    lines.append("wall-keyed chain (instances/s, recomputed per "
+                 "utils/timing.regression_verdict):")
+    for rnd, e in doc["bench_rounds"].items():
+        dev = (f"  device {e['device_busy_s']} s" if e["device_busy_s"]
+               else "  (no device leg)")
+        # A dead driver capture parses but has no value — report it, the
+        # whole point of the ledger is naming such rounds, not dying on them.
+        val = (f"{e['value']:.1f} inst/s" if e["value"] is not None
+               else "no usable value (dead capture)")
+        lines.append(f"  r{rnd}: {val} [{e['platform'] or '?'}]{dev}")
+    for link in doc["wall_chain"]:
+        tag = ""
+        if "agrees_with_recorded" in link:
+            tag = (" == recorded" if link["agrees_with_recorded"]
+                   else f" != recorded {link['recorded_vs_prev_round']}")
+        lines.append(f"  r{link['from_round']} -> r{link['to_round']}: "
+                     f"wall x{link.get('vs_prev_round', '?')}"
+                     f" (signal: {link.get('regression_signal', 'n/a')}){tag}")
+    dc = doc["device_chain"]
+    lines.append(f"device-keyed chain: {dc['status']}")
+    if dc["anchor_round"] is not None:
+        lines.append(f"  anchor: r{dc['anchor_round']} "
+                     f"({dc['anchor_artifact']}, "
+                     f"{dc['anchor_device_busy_s']} s device-busy)")
+    for b in dc["broken_rounds"]:
+        lines.append(f"  r{b['round']}: {b['reason']}")
+    if dc["closes_with"]:
+        lines.append(f"  closes with: {dc['closes_with']}")
+    if doc["multichip_rounds"]:
+        ok = [r for r, e in doc["multichip_rounds"].items() if e["ok"]]
+        lines.append(f"multichip rounds ok: {', '.join('r' + r for r in ok)}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=None,
+                    help="repo root to scan (default: this checkout)")
+    ap.add_argument("--json", default=None, metavar="FILE",
+                    help="also write the machine-readable ledger record")
+    args = ap.parse_args(argv)
+
+    doc = build_ledger(args.root)
+    print(format_report(doc))
+    if args.json:
+        out = pathlib.Path(args.json)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(json.dumps(doc, indent=1) + "\n")
+        print(f"wrote {out}")
+    return 1 if doc["parse_errors"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

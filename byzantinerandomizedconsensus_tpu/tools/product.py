@@ -35,38 +35,34 @@ from byzantinerandomizedconsensus_tpu.utils import metrics
 from byzantinerandomizedconsensus_tpu.utils.rounds import (
     default_artifact, prev_round_artifact)
 from byzantinerandomizedconsensus_tpu.utils.timing import (
-    DEFAULT_REPEATS, device_busy, regression_verdict, spread, timed_best_of)
+    DEFAULT_REPEATS, device_busy, regression_verdict, timed_best_of)
 
 
-def run_config(cfg, backend: str, timed_repeats: int = DEFAULT_REPEATS):
+def run_config(cfg, backend: str, timed_repeats: int = DEFAULT_REPEATS,
+               counters: bool = False):
     """One shipped config end-to-end: warm-up compile, then best-of-N
     (utils/timing.py — the same methodology as bench.py), plus the
-    noise-immune device-busy leg (VERDICT r4 #2). Returns
-    ``(entry, raw_walls)`` — the unrounded walls feed regression_verdict
-    (rounding first distorts the spread for sub-ms configs)."""
+    noise-immune device-busy leg (VERDICT r4 #2). The timing keys come from
+    the shared run-record schema (obs/record.timing_block via
+    metrics.summary): a failed/suspect device capture surfaces as an honest
+    ``device_busy_error``, never vanishes. Returns ``(entry, raw_walls)`` —
+    the unrounded walls feed regression_verdict (rounding first distorts the
+    spread for sub-ms configs).
+
+    ``counters``: add the protocol-counter block (obs/counters.py) from one
+    extra *untimed* run — the timed window stays counter-free, and backends
+    without a counter channel degrade to a ``supported: false`` block.
+    """
     be = get_backend(backend)
     res, walls = timed_best_of(be, cfg, timed_repeats)
-    s = metrics.summary(res)
-    s["round_histogram"] = metrics.round_histogram(res).tolist()
-    best = min(walls)
     dev = device_busy(be, cfg)
-    s.update(
-        backend=backend,
-        wall_s=round(best, 3),
-        walls_s=[round(w, 3) for w in walls],
-        walls_spread=round(spread(walls), 3),
-        instances_per_sec=round(cfg.instances / best, 1),
-    )
-    if "device_busy_suspect" in dev:
-        # A 0.0 that is absence-of-signal (no device pids / op-naming drift,
-        # utils/timing.parse_trace) is an error entry, not a measurement.
-        s["device_busy_error"] = dev["device_busy_suspect"]
-    elif "device_busy_s" in dev:
-        s["device_busy_s"] = dev["device_busy_s"]
-    else:
-        # A failed capture must surface in the artifact (it explains a later
-        # "no device-busy comparison available" verdict), never vanish.
-        s["device_busy_error"] = dev.get("error", "?")
+    s = metrics.summary(res, walls=walls, device=dev)
+    s["round_histogram"] = metrics.round_histogram(res).tolist()
+    s["backend"] = backend
+    if counters:
+        from byzantinerandomizedconsensus_tpu.obs import record
+
+        s["counters"] = record.collect_counters(be, cfg)
     return s, walls
 
 
@@ -81,6 +77,9 @@ def main(argv=None) -> int:
                     default=[*PRESETS, "config5"],
                     choices=[*PRESETS, "config5"],
                     help="subset to run (merged into an existing artifact)")
+    ap.add_argument("--counters", action="store_true",
+                    help="attach the protocol-counter block per config "
+                         "(obs/counters.py; one extra untimed run each)")
     args = ap.parse_args(argv)
 
     if args.backend.partition(":")[0].startswith("jax"):
@@ -95,6 +94,11 @@ def main(argv=None) -> int:
         platform = "host"  # cpu/numpy/native legs never touch a device
     path = pathlib.Path(args.out)
     art = json.loads(path.read_text()) if path.exists() else {}
+    from byzantinerandomizedconsensus_tpu.obs import record
+
+    # The unified record head (obs/record.py): refreshed on every merge so
+    # the env fingerprint describes the newest contributing invocation.
+    art.update(record.new_record("product"))
     art.setdefault(
         "description",
         "All five benchmark configs (BASELINE.json:6-12) run end-to-end AS "
@@ -115,7 +119,8 @@ def main(argv=None) -> int:
             label = name
         print(f"{label}: n={cfg.n} f={cfg.f} x{cfg.instances} "
               f"{cfg.adversary}/{cfg.coin} cap={cfg.round_cap}", flush=True)
-        entry, raw_walls = run_config(cfg, args.backend)
+        entry, raw_walls = run_config(cfg, args.backend,
+                                      counters=args.counters)
         entry["platform"] = platform
         # Per-preset regression guard (VERDICT r3 #5): like-for-like only —
         # skip the comparison when the previous entry ran elsewhere. The
@@ -141,7 +146,7 @@ def main(argv=None) -> int:
     print(json.dumps({
         "out": str(path),
         "platform": platform,
-        "configs": sorted(k for k in art if k != "description"),
+        "configs": sorted(k for k in art if k.startswith("config")),
         # wall-clocks from THIS invocation only: merged entries may come from
         # other platforms/invocations and older formats (ADVICE r3)
         "total_wall_s_this_run": round(

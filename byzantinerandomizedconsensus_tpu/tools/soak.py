@@ -119,7 +119,10 @@ def run_soak(n_configs: int, seed: int = 0, oracle_every: int = 10,
         elif (k + 1) % 25 == 0:
             progress(f"soak[{k + 1}/{n_configs}]: 0 mismatches so far")
 
+    from byzantinerandomizedconsensus_tpu.obs import record
+
     return {
+        **record.new_record("soak"),
         "description": "randomized numpy-vs-native differential with a scalar"
                        "-oracle subsample (tools/soak.py; VERDICT r5 next #3)",
         "generator_version": GENERATOR_VERSION,

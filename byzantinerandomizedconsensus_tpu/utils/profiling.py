@@ -29,7 +29,23 @@ def trace(out_dir=None):
 
 
 def annotate(name: str):
-    """Named sub-span inside a trace (shows up on the TraceMe timeline)."""
-    import jax
+    """Named sub-span — phase labels for both trace surfaces, with the same
+    guarded no-op fallback as :func:`trace` when jax is unavailable (the
+    module's contract; previously ``annotate`` alone imported jax
+    unconditionally and broke the interpret-mode/no-jax promise).
 
-    return jax.profiler.TraceAnnotation(name)
+    Enters two scopes at once because they label different timelines:
+    ``jax.named_scope`` tags the *traced* ops, so spans opened inside a jit'd
+    round body (models/benor.py, models/bracha.py) name the compiled HLO and
+    show up on the Perfetto *device* rows of a ``--profile``/trace-dir
+    capture; ``jax.profiler.TraceAnnotation`` emits a host TraceMe span,
+    which is what labels eager (numpy-backend) phases.
+    """
+    try:
+        import jax
+    except Exception:  # no-op fallback, same contract as trace(None)
+        return contextlib.nullcontext()
+    stack = contextlib.ExitStack()
+    stack.enter_context(jax.named_scope(name))
+    stack.enter_context(jax.profiler.TraceAnnotation(name))
+    return stack

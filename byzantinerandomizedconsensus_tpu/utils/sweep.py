@@ -62,6 +62,22 @@ def run_sweep(
     return out
 
 
+def sweep_record(points: dict, backend: str, delivery: str) -> dict:
+    """Wrap a :func:`run_sweep` result in the unified run-record head
+    (obs/record.py): the sweep artifact the CLI emits carries the same
+    ``record_version``/``kind``/``env`` fingerprint as every other tool's,
+    with the per-n summaries under ``points`` (keys stringified, as any
+    JSON round-trip would)."""
+    from byzantinerandomizedconsensus_tpu.obs import record
+
+    return {
+        **record.new_record("sweep"),
+        "backend": backend,
+        "delivery": delivery,
+        "points": {str(n): s for n, s in points.items()},
+    }
+
+
 def _warn_stale_shards(out_dir: pathlib.Path, delivery: str, round_cap: int,
                        progress) -> None:
     """Surface checkpoint shards that cannot resume under the current delivery

@@ -1,0 +1,159 @@
+"""Protocol counters (obs/counters.py): the flight recorder's on-device leg.
+
+Two load-bearing properties, per the round-8 acceptance bar:
+
+1. **Invariance** — enabling counters leaves the bit-match surface
+   (rounds/decision) bit-identical on the jax and numpy backends, for preset
+   configs (the side channel never feeds back into the round math);
+2. **Cross-check** — the vectorized totals equal the scalar oracle's
+   independent message-level counts at small n, across every delivery law
+   and adversary family.
+"""
+
+import numpy as np
+import pytest
+
+from byzantinerandomizedconsensus_tpu.backends import get_backend
+from byzantinerandomizedconsensus_tpu.config import (
+    SimConfig, preset, sweep_point)
+from byzantinerandomizedconsensus_tpu.obs import counters as obs_counters
+
+
+def _eq(a, b):
+    return (np.array_equal(a.rounds, b.rounds)
+            and np.array_equal(a.decision, b.decision))
+
+
+# Three benchmark presets (instance counts trimmed to CI scale — the config
+# *shapes*, which drive the kernels, are as shipped) plus the config-5 sweep
+# shape: benor/none, benor/crash, bracha/byzantine, bracha/adaptive.
+PRESET_CASES = [
+    ("config1", preset("config1")),
+    ("config2", preset("config2", instances=32)),
+    ("config3", preset("config3", instances=8)),
+    ("config5", sweep_point(64, instances=8)),
+]
+
+
+@pytest.mark.parametrize("name,cfg", PRESET_CASES,
+                         ids=[c[0] for c in PRESET_CASES])
+def test_counters_invariant_and_backend_agree(name, cfg):
+    """Counters on == counters off, bit-for-bit, on numpy AND jax — and the
+    two stacks' totals are identical."""
+    nb, jb = get_backend("numpy"), get_backend("jax")
+    base = nb.run(cfg)
+    res_n, doc_n = nb.run_with_counters(cfg)
+    assert _eq(base, res_n), f"{name}: numpy counters moved the results"
+
+    jbase = jb.run(cfg)
+    assert _eq(base, jbase), f"{name}: jax/numpy bit-mismatch (pre-existing)"
+    res_j, doc_j = jb.run_with_counters(cfg)
+    assert _eq(jbase, res_j), f"{name}: jax counters moved the results"
+
+    assert doc_n["totals"] == doc_j["totals"]
+    assert doc_n["schema"] == obs_counters.COUNTER_SCHEMA_VERSION
+    # Built-in self-check: rounds_active ≡ the result surface's rounds sum.
+    assert doc_n["totals"]["rounds_active"] == int(base.rounds.sum())
+
+
+ORACLE_GRID = [
+    ("bracha", "adaptive", 10, 3),
+    ("bracha", "byzantine", 10, 3),
+    ("bracha", "adaptive_min", 8, 2),
+    ("benor", "byzantine", 7, 1),   # two-faced §4b equivocation under benor
+    ("benor", "crash", 9, 4),
+    ("benor", "none", 7, 2),
+]
+
+
+@pytest.mark.parametrize("delivery", ["keys", "urn", "urn2", "urn3"])
+def test_counters_cross_check_oracle(delivery):
+    """Vectorized totals == the oracle's independent message-level counts
+    (its common subset: delivered/dropped per phase, coin flips, rounds)."""
+    nb, cb = get_backend("numpy"), get_backend("cpu")
+    for proto, adv, n, f in ORACLE_GRID:
+        cfg = SimConfig(protocol=proto, n=n, f=f, instances=6, adversary=adv,
+                        coin="shared", delivery=delivery,
+                        round_cap=32).validate()
+        res_n, doc_n = nb.run_with_counters(cfg)
+        res_c, doc_c = cb.run_with_counters(cfg)
+        assert _eq(res_n, res_c), (proto, adv, delivery)
+        common = {k: v for k, v in doc_n["totals"].items()
+                  if k in doc_c["totals"]}
+        assert common == doc_c["totals"], (proto, adv, delivery)
+        # The oracle subset covers everything but the sampler cost counters.
+        assert set(doc_n["totals"]) - set(doc_c["totals"]) <= {
+            "urn_draws", "chain_trips", "chain_trips_max", "urn3_words"}
+
+
+def test_sampler_cost_counter_laws():
+    """The sampler-owned counters obey their closed-form laws: §4b draws =
+    the drop total; §4c words = one per receiver-step; §4b-v2 chain trips
+    reach K = D on balanced wires and collapse on adaptive strata."""
+    nb = get_backend("numpy")
+
+    def totals(adversary, delivery):
+        cfg = SimConfig(protocol="bracha", n=16, f=5, instances=16,
+                        adversary=adversary, coin="shared", delivery=delivery,
+                        round_cap=64).validate()
+        _, doc = nb.run_with_counters(cfg)
+        return doc["totals"]
+
+    t = totals("none", "urn")
+    dropped = sum(v for k, v in t.items() if k.startswith("dropped@"))
+    assert t["urn_draws"] == dropped
+
+    t = totals("none", "urn3")
+    assert t["urn3_words"] == 3 * 16 * t["rounds_active"]  # steps · n · rounds
+
+    balanced = totals("none", "urn2")       # mixed random ests: wires balance
+    adaptive = totals("adaptive", "urn2")   # value-homogeneous bias strata
+    assert 0 < balanced["chain_trips_max"] <= 5          # K ≤ D ≤ f
+    assert balanced["chain_trips"] > 10 * adaptive["chain_trips"], \
+        "the adaptive shape should sit in the chains' deterministic corner"
+
+
+def test_counters_unsupported_backends_degrade_cleanly():
+    cfg = preset("config1")
+    for backend in ("native", "jax_pallas", "virtual"):
+        with pytest.raises(obs_counters.CountersUnsupported):
+            be = get_backend(backend)
+            # jax_pallas rejects at the kernel gate, native/virtual at the
+            # base seam — neither needs a device or a compiler to refuse.
+            be.run_with_counters(preset("config1", delivery="urn")
+                                 if backend == "jax_pallas" else cfg)
+    from byzantinerandomizedconsensus_tpu.obs import record
+
+    doc = record.collect_counters(get_backend("native"), cfg)
+    assert doc == {"schema": obs_counters.COUNTER_SCHEMA_VERSION,
+                   "supported": False, "reason": doc["reason"]}
+    assert "native" in doc["reason"]
+
+
+def test_accumulator_uint32_carry_and_max_merge():
+    """The (lo, hi) pair arithmetic: per-round uint32 increments carry into
+    the hi word exactly; max counters max-merge instead of summing."""
+    cfg = preset("config1")  # delivery=urn2 → has a max counter
+    names = obs_counters.counter_names(cfg)
+    big = np.uint32(0xFFFFFFFF)
+    acc = obs_counters.zeros(cfg, 2, np)
+    inc = np.full((2, len(names)), big, dtype=np.uint32)
+    active = np.array([True, True])
+    for _ in range(2):
+        acc = obs_counters.accumulate(acc, inc, active, cfg, np)
+    totals = obs_counters.finalize(cfg, acc)
+    for name in names:
+        if name == "chain_trips_max":
+            assert totals[name] == 0xFFFFFFFF
+        else:  # 2 instances × 2 rounds × (2^32 − 1)
+            assert totals[name] == 2 * 2 * 0xFFFFFFFF
+
+
+def test_accumulator_respects_activity_mask():
+    cfg = preset("config1")
+    names = obs_counters.counter_names(cfg)
+    acc = obs_counters.zeros(cfg, 2, np)
+    inc = np.full((2, len(names)), 7, dtype=np.uint32)
+    acc = obs_counters.accumulate(acc, inc, np.array([True, False]), cfg, np)
+    totals = obs_counters.finalize(cfg, acc)
+    assert totals["rounds_active"] == 7  # only the active instance counted

@@ -23,6 +23,46 @@ def test_prev_round_skips_dead_capture(tmp_path):
     assert got[:2] == ("BENCH_r02.json", 2)
 
 
+def test_prev_round_walks_past_multiple_dead_rounds(tmp_path):
+    """The regression guard must keep walking: a dead driver capture AND an
+    unparseable JSON in between still resolve to the newest usable round —
+    bench.py keys its vs_prev_round comparison on exactly this walk."""
+    (tmp_path / "VERDICT.md").write_text("# VERDICT — round 5\n")
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps({"parsed": {"value": 7.0}}))
+    (tmp_path / "BENCH_r03.json").write_text("{truncated garbage")     # unparseable
+    (tmp_path / "BENCH_r04.json").write_text(json.dumps({"rc": 1}))    # dead capture
+    (tmp_path / "BENCH_r05.json").write_text(json.dumps({"parsed": {}}))  # no value
+    got = rounds.prev_round_artifact("BENCH", root=tmp_path,
+                                     usable=lambda d: _value(d) is not None)
+    assert got[:2] == ("BENCH_r02.json", 2)
+    assert _value(got[2]) == 7.0
+    # ...and None when every candidate is dead — the guard is then omitted,
+    # never fed a corpse.
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps({"rc": 1}))
+    assert rounds.prev_round_artifact(
+        "BENCH", root=tmp_path,
+        usable=lambda d: _value(d) is not None) is None
+
+
+def test_bench_prev_round_headline_uses_dead_capture_fallback(tmp_path, monkeypatch):
+    """bench.py's _prev_round_headline end-to-end over the walk: skips the
+    dead newest round and surfaces (artifact, value, device_busy_s) from the
+    older usable one."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench", rounds.repo_root() / "bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    (tmp_path / "VERDICT.md").write_text("# VERDICT — round 4\n")
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps(
+        {"parsed": {"value": 123.0, "detail": {"device_busy_s": 0.25}}}))
+    (tmp_path / "BENCH_r04.json").write_text(json.dumps({"rc": 1}))
+    monkeypatch.setattr(rounds, "repo_root", lambda: tmp_path)
+    assert bench._prev_round_headline() == ("BENCH_r03.json", 123.0, 0.25)
+
+
 def test_prev_round_never_exceeds_verdict_round(tmp_path):
     # BENCH_r04 is the CURRENT round's capture — must not self-compare.
     (tmp_path / "VERDICT.md").write_text("# VERDICT — round 3\n")
