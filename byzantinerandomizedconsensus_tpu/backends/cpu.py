@@ -53,7 +53,8 @@ class CpuBackend(SimulatorBackend):
             rounds[k], decision[k] = self._run_instance(cfg, int(i),
                                                         collect=totals)
         names = [n for n in _counters.counter_names(cfg)
-                 if n.split("@")[0] in ("delivered0", "delivered1", "dropped")
+                 if n.split("@")[0] in ("delivered0", "delivered1", "dropped",
+                                        "fault_silenced", "fault_cut_pairs")
                  or n in ("coin_flips", "rounds_active")]
         totals = {n: totals.get(n, 0) for n in names}
         res = SimResult(config=cfg, inst_ids=ids, rounds=rounds,
@@ -94,6 +95,12 @@ class CpuBackend(SimulatorBackend):
         net = Network(cfg, cfg.seed, instance)
         adv = make_adversary(cfg, cfg.seed, instance)
         correct = [j for j in range(cfg.n) if not adv.faulty[j]]
+        fs = None
+        if cfg.faults != "none":
+            from byzantinerandomizedconsensus_tpu.core.faults import (
+                FaultSchedule)
+
+            fs = FaultSchedule(cfg, cfg.seed, instance)
 
         two_faced = cfg.count_level and cfg.adversary == "byzantine" \
             and cfg.protocol != "bracha"
@@ -110,9 +117,16 @@ class CpuBackend(SimulatorBackend):
 
         for r in range(cfg.round_cap):
             g_prev = None  # global live-valid counts of the previous step (bracha)
+            # Fault-schedule masks for this round (spec §9): silences join the
+            # silent set before §5.1b validation; the partition side plane
+            # applies only at the delivery law — same composition order as
+            # the vectorized round bodies.
+            fsil, fside = fs.round_masks(r) if fs is not None else (None, None)
             for t in range(cfg.steps_per_round):
                 honest = np.array([rep.send_value(t) for rep in replicas], dtype=np.uint8)
                 values, silent, bias = adv.inject(r, t, honest)
+                if fsil is not None:
+                    silent = silent | fsil
                 if cfg.protocol == "bracha":
                     # spec §5.1b: invalid messages are silenced before delivery.
                     if t > 0:
@@ -143,14 +157,16 @@ class CpuBackend(SimulatorBackend):
                     counts = {"urn": net.urn_counts, "urn2": net.urn2_counts,
                               "urn3": net.urn3_counts}[cfg.delivery]
                     c0, c1 = counts(r, t, vbc, silent,
-                                    strata=strata, minority=minority)
+                                    strata=strata, minority=minority,
+                                    fside=fside)
                     if collect is not None:
                         note(f"delivered0@{phases[t]}", c0.sum())
                         note(f"delivered1@{phases[t]}", c1.sum())
                     for rep in replicas:
                         rep.on_counts(t, int(c0[rep.index]), int(c1[rep.index]))
                 else:
-                    vmat, mask = net.deliver(r, t, values, silent, bias)
+                    vmat, mask = net.deliver(r, t, values, silent, bias,
+                                             fside=fside)
                     if collect is not None:
                         note(f"delivered0@{phases[t]}", (mask & (vmat == 0)).sum())
                         note(f"delivered1@{phases[t]}", (mask & (vmat == 1)).sum())
@@ -159,11 +175,30 @@ class CpuBackend(SimulatorBackend):
                 if collect is not None:
                     # Every delivery law drops exactly max(0, L_v − (n−f−1))
                     # live messages per receiver (spec §4) — same scalar
-                    # formula obs/counters.round_increments vectorizes.
-                    live_total = int(np.count_nonzero(~silent))
+                    # formula obs/counters.round_increments vectorizes. Under
+                    # a §9 partition, L_v counts only same-side live senders.
+                    live = ~silent
+                    if fside is None:
+                        live_tot = np.full(cfg.n, np.count_nonzero(live))
+                    else:
+                        live_tot = np.array(
+                            [np.count_nonzero(live & (fside == fside[v]))
+                             for v in range(cfg.n)])
                     note(f"dropped@{phases[t]}",
-                         sum(max(0, live_total - (0 if silent[v] else 1)
-                                 - k_quota) for v in range(cfg.n)))
+                         sum(max(0, int(live_tot[v])
+                                 - (0 if silent[v] else 1) - k_quota)
+                             for v in range(cfg.n)))
+                    if cfg.faults != "none":
+                        # Schema-v2 fault attribution (obs/counters.py):
+                        # schedule-silenced senders, and live cross-cut pairs.
+                        note(f"fault_silenced@{phases[t]}",
+                             0 if fsil is None else int(fsil.sum()))
+                        cut = 0
+                        if fside is not None:
+                            for v in range(cfg.n):
+                                cut += int(np.count_nonzero(
+                                    live & (fside != fside[v])))
+                        note(f"fault_cut_pairs@{phases[t]}", cut)
             if cfg.coin == "shared":
                 shared = int(prf.prf_bit(cfg.seed, instance, r, prf.COIN_STEP, 0, 0,
                                          prf.SHARED_COIN, xp=np,

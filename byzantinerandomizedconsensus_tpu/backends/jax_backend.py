@@ -121,6 +121,13 @@ class JaxBackend(JitChunkedBackend):
         return max(1, min(self.max_chunk, self.chunk_bytes // per_inst))
 
     def _make_fn(self, cfg: SimConfig):
+        if self.kernel != "xla":
+            # The custom-kernel paths compute delivery in-kernel and have no
+            # fault-schedule channel — fail loudly, never fall back silently.
+            from byzantinerandomizedconsensus_tpu.models.faults import (
+                check_faults_supported)
+
+            check_faults_supported(cfg, f"kernel={self.kernel!r}")
         counts_fn = None
         if cfg.count_level:
             # counts_fn=None routes the round bodies to ops/urn.py or

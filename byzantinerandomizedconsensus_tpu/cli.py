@@ -17,7 +17,7 @@ import pathlib
 import sys
 
 from byzantinerandomizedconsensus_tpu import PRESETS, SimConfig, Simulator, preset
-from byzantinerandomizedconsensus_tpu.config import DELIVERY_KINDS
+from byzantinerandomizedconsensus_tpu.config import DELIVERY_KINDS, FAULT_KINDS
 from byzantinerandomizedconsensus_tpu.utils import metrics, sweep
 
 
@@ -42,6 +42,13 @@ def _add_config_args(p: argparse.ArgumentParser, default_backend: str = "cpu") -
                         "count-level trio; presets pin the A/B-measured "
                         "product one | keys (spec §4, O(n²) mask — the "
                         "validation model)")
+    p.add_argument("--faults", choices=list(FAULT_KINDS), default=None,
+                   help="fault schedule (spec §9), orthogonal to --adversary: "
+                        "recover (crash-recovery windows) | partition "
+                        "(PRF-drawn epoch isolating a fault-prone sub-block) "
+                        "| omission (transient per-round bursts) — all "
+                        "confined to the §3.2 fault-prone set; supported on "
+                        "the cpu|numpy|jax stacks")
     p.add_argument("--backend", default=default_backend,
                    help="cpu (oracle) | numpy | native[:threads] | jax | jax_cpu "
                         "| jax_pallas | jax_sharded[:n_model] | virtual[:DxM] "
@@ -74,6 +81,7 @@ def _config_from(args) -> SimConfig:
         ("instances", args.instances), ("adversary", args.adversary),
         ("coin", args.coin), ("seed", args.seed), ("round_cap", args.round_cap),
         ("init", args.init), ("delivery", args.delivery),
+        ("faults", getattr(args, "faults", None)),
     ] if v is not None}
     if args.preset:
         return preset(args.preset, **overrides)
@@ -287,13 +295,20 @@ def main(argv=None) -> int:
                    help="regression-chain ledger over every committed "
                         "BENCH/MULTICHIP/artifact JSON (tools/ledger.py; "
                         "all further options pass through)")
+    sub.add_parser("chaos",
+                   help="chaos soak: randomized spec-§9 fault schedules, "
+                        "subprocess-isolated with timeout/retry/checkpoint "
+                        "(tools/soak.py --chaos; all further options pass "
+                        "through)")
 
     if argv is None:
         argv = sys.argv[1:]
-    if argv and argv[0] in ("accept", "slack", "product", "ledger"):
+    if argv and argv[0] in ("accept", "slack", "product", "ledger", "chaos"):
         from byzantinerandomizedconsensus_tpu.tools import (
-            acceptance, ledger, product, slack)
+            acceptance, ledger, product, slack, soak)
 
+        if argv[0] == "chaos":
+            return soak.main(["--chaos", *argv[1:]])
         tool = {"accept": acceptance, "slack": slack,
                 "product": product, "ledger": ledger}[argv[0]]
         return tool.main(argv[1:])

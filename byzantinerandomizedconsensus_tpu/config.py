@@ -12,6 +12,7 @@ AdversaryKind = Literal["none", "crash", "byzantine", "adaptive", "adaptive_min"
 CoinKind = Literal["local", "shared"]
 InitKind = Literal["random", "all0", "all1", "split"]
 DeliveryKind = Literal["keys", "urn", "urn2", "urn3"]
+FaultKind = Literal["none", "recover", "partition", "omission"]
 
 # The delivery registry: every scheduling model a SimConfig may name, in spec
 # order. COUNT_LEVEL_DELIVERIES are the §4b-family samplers (no O(n²) mask
@@ -22,6 +23,17 @@ DeliveryKind = Literal["keys", "urn", "urn2", "urn3"]
 # implementations (ops/, core/network.py, native/simcore.cpp).
 COUNT_LEVEL_DELIVERIES = ("urn", "urn2", "urn3")
 DELIVERY_KINDS = ("keys",) + COUNT_LEVEL_DELIVERIES
+
+# The fault-schedule registry (spec §9): an axis orthogonal to the §6
+# adversary axis, "faults-as-data" in the same style. Every schedule draws
+# only from the §3.2 fault-prone set (size f), so composition with any
+# adversary keeps total misbehaving replicas ≤ f and the §5 safety arguments
+# apply verbatim. "recover" = crash-recovery windows (silent, then rejoin);
+# "partition" = a PRF-drawn epoch isolating a fault-prone sub-block (messages
+# across the cut suppressed both ways); "omission" = transient per-round
+# send-omission bursts. Implemented in models/faults.py (vectorized) and
+# core/faults.py (scalar oracle); native/Pallas/sharded raise FaultsUnsupported.
+FAULT_KINDS = ("none", "recover", "partition", "omission")
 
 # Single source for the default round cap. checkpoint.shard_name encodes only
 # NON-default caps (legacy shard names imply this value), so every site that
@@ -69,6 +81,11 @@ class SimConfig:
     # delivery-distribution family as §4b/§4b-v2, kept as the SimConfig
     # default for ad-hoc spec-§4 work and cross-model checks.
     delivery: DeliveryKind = "keys"
+    # Fault schedule (spec §9) — orthogonal to ``adversary``. "none" is the
+    # frozen default: every existing config draws and decides bit-identically.
+    # The schedules silence (or cut off) only §3.2 fault-prone replicas, and
+    # reuse ``crash_window`` as their PRF time scale.
+    faults: FaultKind = "none"
 
     @property
     def steps_per_round(self) -> int:
@@ -101,6 +118,17 @@ class SimConfig:
             raise ValueError(
                 f"unknown delivery {self.delivery!r}; "
                 f"use one of {'|'.join(DELIVERY_KINDS)}")
+        if self.faults not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown faults {self.faults!r}; "
+                f"use one of {'|'.join(FAULT_KINDS)}")
+        if self.crash_window < 1:
+            # §3.3 / §9 draw crash rounds as ``prf % crash_window``: a zero
+            # window is a modulo-by-zero that numpy turns into silent garbage
+            # (0 with a RuntimeWarning) instead of an error — reject it here.
+            raise ValueError(
+                f"crash_window={self.crash_window} out of range (>= 1); "
+                "the §3.3/§9 schedules draw rounds mod crash_window")
         if not (0 < self.n <= prf.MAX_N):
             raise ValueError(f"n={self.n} out of range (1..{prf.MAX_N})")
         if not (0 <= self.f < self.n):

@@ -25,20 +25,25 @@ class Network:
         self._rs, self._rd = prf.RED_SHIFTS[self._pack]
         self._klow = prf.KEY_LOW_BITS[self._pack]
 
-    def delivery_mask(self, rnd: int, t: int, silent: np.ndarray, bias: np.ndarray) -> np.ndarray:
+    def delivery_mask(self, rnd: int, t: int, silent: np.ndarray, bias: np.ndarray,
+                      fside=None) -> np.ndarray:
         """(n, n) bool delivered(recv, send). ``silent``: (n,) bool; ``bias``: (n, n)
-        or (1, n) uint32 per-(recv, send) bias bits (spec §4/§6.4)."""
+        or (1, n) uint32 per-(recv, send) bias bits (spec §4/§6.4). ``fside``:
+        optional (n,) uint8 spec-§9 partition side plane — cross-side senders
+        are silenced from this receiver's perspective."""
         n, f = self.cfg.n, self.cfg.f
         mask = np.empty((n, n), dtype=bool)
         send = self._recv
         for v in range(n):
+            row_silent = silent if fside is None \
+                else (silent | (fside != fside[v]))
             sched = prf.prf_u32(self.seed, self.instance, rnd, t,
                                 np.uint32(v), send, prf.SCHED, xp=np,
                                 pack=self._pack)
             bias_row = bias[0] if bias.shape[0] == 1 else bias[v]
             top = np.uint32(30 - self._klow)          # prf field width: 20 | 18
             combined = (
-                (silent.astype(np.uint32) << np.uint32(31))
+                (row_silent.astype(np.uint32) << np.uint32(31))
                 | (bias_row.astype(np.uint32) << np.uint32(30))
                 | (((sched >> np.uint32(32 - int(top)))
                     & np.uint32((1 << int(top)) - 1)) << np.uint32(self._klow))
@@ -46,19 +51,20 @@ class Network:
             )
             combined[v] = v  # own message always delivered (spec §4)
             kth = np.partition(combined, n - f - 1)[n - f - 1]
-            mask[v] = (combined <= kth) & ~silent
+            mask[v] = (combined <= kth) & ~row_silent
             mask[v, v] = True  # own delivery is exempt from silence (spec §4)
         return mask
 
-    def deliver(self, rnd: int, t: int, values, silent: np.ndarray, bias: np.ndarray):
+    def deliver(self, rnd: int, t: int, values, silent: np.ndarray, bias: np.ndarray,
+                fside=None):
         """Returns (vmat (n_recv, n_send) uint8, mask (n_recv, n_send) bool)."""
         n = self.cfg.n
         values = np.asarray(values, dtype=np.uint8)
         vmat = np.broadcast_to(values, (n, n)) if values.ndim == 1 else values
-        return vmat, self.delivery_mask(rnd, t, silent, bias)
+        return vmat, self.delivery_mask(rnd, t, silent, bias, fside=fside)
 
     def urn_counts(self, rnd: int, t: int, vals_by_class, silent: np.ndarray,
-                   strata: str = "none", minority: int = 0):
+                   strata: str = "none", minority: int = 0, fside=None):
         """Per-receiver delivered counts (c0, c1) via the §4b urn process.
 
         ``vals_by_class``: pair of (n,) wire-value arrays, one per receiver class
@@ -80,7 +86,8 @@ class Network:
             vals = vals_by_class[h]
             rem = [0, 0, 0]
             for u in range(n):
-                if u != v and not silent[u]:
+                if u != v and not silent[u] \
+                        and (fside is None or fside[u] == fside[v]):
                     rem[int(vals[u])] += 1
             drops = max(0, sum(rem) - k)
             # biased(w, h) per spec §4b / §6.4b.
@@ -109,7 +116,7 @@ class Network:
         return c0, c1
 
     def urn2_counts(self, rnd: int, t: int, vals_by_class, silent: np.ndarray,
-                    strata: str = "none", minority: int = 0):
+                    strata: str = "none", minority: int = 0, fside=None):
         """Per-receiver delivered counts (c0, c1) via the §4b-v2 inversion.
 
         Same class/stratum semantics as :meth:`urn_counts`; the dropped-count
@@ -127,7 +134,8 @@ class Network:
             vals = vals_by_class[h]
             m = [0, 0, 0]
             for u in range(n):
-                if u != v and not silent[u]:
+                if u != v and not silent[u] \
+                        and (fside is None or fside[u] == fside[v]):
                     m[int(vals[u])] += 1
             L = sum(m)
             D = max(0, L - k)
@@ -182,7 +190,7 @@ class Network:
         return c0, c1
 
     def urn3_counts(self, rnd: int, t: int, vals_by_class, silent: np.ndarray,
-                    strata: str = "none", minority: int = 0):
+                    strata: str = "none", minority: int = 0, fside=None):
         """Per-receiver delivered counts (c0, c1) via the §4c cheap law.
 
         Same class/stratum semantics as :meth:`urn_counts`, same deterministic
@@ -203,7 +211,8 @@ class Network:
             vals = vals_by_class[h]
             m = [0, 0, 0]
             for u in range(n):
-                if u != v and not silent[u]:
+                if u != v and not silent[u] \
+                        and (fside is None or fside[u] == fside[v]):
                     m[int(vals[u])] += 1
             L = sum(m)
             D = max(0, L - k)

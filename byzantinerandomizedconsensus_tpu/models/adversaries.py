@@ -22,21 +22,16 @@ from byzantinerandomizedconsensus_tpu.ops import prf
 
 
 def faulty_mask(cfg, seed, inst_ids, xp=np):
-    """(B, n) bool — the f replicas with smallest combined FAULTY_RANK keys (spec §3.2)."""
-    B = inst_ids.shape[0]
-    if cfg.adversary == "none" or cfg.f == 0:
-        return xp.zeros((B, cfg.n), dtype=bool)
-    replica = xp.arange(cfg.n, dtype=xp.uint32)[None, :]
-    rank = prf.prf_u32(seed, xp.asarray(inst_ids, dtype=xp.uint32)[:, None],
-                       0, 0, replica, 0, prf.FAULTY_RANK, xp=xp,
-                       pack=cfg.pack_version)
-    # Replica-index field width per packing law (10 | 12 bits, spec §2 v2).
-    key = (rank & xp.uint32(prf.KEY_MASK[cfg.pack_version])) | replica
-    if xp is np:
-        kth = np.partition(key, cfg.f - 1, axis=-1)[..., cfg.f - 1]
-    else:
-        kth = xp.sort(key, axis=-1)[..., cfg.f - 1]
-    return key <= kth[..., None]
+    """(B, n) bool — the f replicas with smallest combined FAULTY_RANK keys
+    (spec §3.2). One shared selection law with the §9 fault-prone set
+    (models/faults.fault_prone_mask) — the safety reduction *requires* the
+    two sets to coincide under an active adversary, so there is exactly one
+    implementation, gated here on the benign adversary."""
+    from byzantinerandomizedconsensus_tpu.models.faults import fault_prone_mask
+
+    if cfg.adversary == "none":
+        return xp.zeros((inst_ids.shape[0], cfg.n), dtype=bool)
+    return fault_prone_mask(cfg, seed, inst_ids, xp=xp)
 
 
 def observed_minority(honest_values, faulty, xp=np):
@@ -72,7 +67,13 @@ class AdversaryModel:
             cr = crash_rounds(cfg, seed, inst_ids, xp=xp)
         else:
             cr = xp.zeros(fm.shape, dtype=xp.int32)
-        return {"faulty": fm, "crash_round": cr}
+        # The orthogonal fault-schedule axis (spec §9) rides the same setup
+        # dict so every vectorized backend plumbs it for free; None when
+        # cfg.faults == "none" (models/faults.py — the frozen fast path).
+        from byzantinerandomizedconsensus_tpu.models import faults as _faults
+
+        return {"faulty": fm, "crash_round": cr,
+                "faults": _faults.setup_faults(cfg, seed, inst_ids, xp=xp)}
 
     def inject(self, seed, inst_ids, rnd, t, honest_values, setup, xp=np, recv_ids=None):
         """Apply the adversary to one step's honest outgoing values (spec §6).

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from byzantinerandomizedconsensus_tpu.models import coins
+from byzantinerandomizedconsensus_tpu.models import coins, faults
 from byzantinerandomizedconsensus_tpu.models.delivery import make_counts
 from byzantinerandomizedconsensus_tpu.utils import profiling
 
@@ -36,8 +36,14 @@ def round_body(cfg, seed, inst_ids, rnd, state, adv, setup, xp=np,
     if gather is None:
         gather = lambda v: v
     est, decided = state["est"], state["decided"]
+    # Fault-schedule masks for this round (spec §9): extra sender silences
+    # OR'd in after each inject, and the partition side plane threaded to the
+    # delivery law. Both None on the faults="none" fast path.
+    fsil, fside = faults.round_masks(cfg, seed, inst_ids, rnd,
+                                     setup.get("faults"), xp=xp)
     counts = make_counts(cfg, seed, inst_ids, rnd, setup, xp,
-                         recv_ids=recv_ids, counts_fn=counts_fn, obs=obs)
+                         recv_ids=recv_ids, counts_fn=counts_fn, obs=obs,
+                         fsil=fsil, fside=fside)
 
     # Protocol A (benign) vs Protocol B (lying) thresholds — spec §5.1.
     quorum_rhs = n + f if cfg.lying_adversary else n
@@ -48,6 +54,8 @@ def round_body(cfg, seed, inst_ids, rnd, state, adv, setup, xp=np,
         h0 = gather(est)
         v0, silent0, bias0 = adv.inject(seed, inst_ids, rnd, 0, h0, setup,
                                         xp=xp, recv_ids=recv_ids)
+        if fsil is not None:
+            silent0 = silent0 | fsil
         r0, r1 = counts(0, h0, v0, silent0, bias0)
         prop = xp.where(2 * r1 > quorum_rhs, xp.uint8(1),
                         xp.where(2 * r0 > quorum_rhs, xp.uint8(0), xp.uint8(2)))
@@ -57,6 +65,8 @@ def round_body(cfg, seed, inst_ids, rnd, state, adv, setup, xp=np,
         h1 = gather(prop)
         v1, silent1, bias1 = adv.inject(seed, inst_ids, rnd, 1, h1, setup,
                                         xp=xp, recv_ids=recv_ids)
+        if fsil is not None:
+            silent1 = silent1 | fsil
         p0, p1 = counts(1, h1, v1, silent1, bias1)
         w = (p1 >= p0).astype(xp.uint8)
         c = xp.where(w == 1, p1, p0)

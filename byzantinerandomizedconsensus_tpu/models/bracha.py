@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from byzantinerandomizedconsensus_tpu.models import coins, validation
+from byzantinerandomizedconsensus_tpu.models import coins, faults, validation
 from byzantinerandomizedconsensus_tpu.models.delivery import make_counts
 from byzantinerandomizedconsensus_tpu.utils import profiling
 
@@ -41,14 +41,23 @@ def round_body(cfg, seed, inst_ids, rnd, state, adv, setup, xp=np,
     if gather is None:
         gather = lambda v: v
     est, decided = state["est"], state["decided"]
+    # Fault-schedule masks for this round (spec §9). Composition order: fault
+    # silences join the silent set *before* the §5.1b validation counts (a
+    # fault-silent sender's message does not exist, so it cannot vouch for
+    # validity); the partition cut applies only at the delivery law.
+    fsil, fside = faults.round_masks(cfg, seed, inst_ids, rnd,
+                                     setup.get("faults"), xp=xp)
     counts = make_counts(cfg, seed, inst_ids, rnd, setup, xp,
-                         recv_ids=recv_ids, counts_fn=counts_fn, obs=obs)
+                         recv_ids=recv_ids, counts_fn=counts_fn, obs=obs,
+                         fsil=fsil, fside=fside)
 
     # Step 0 — broadcast est; majority of delivered (ties -> 1).
     with profiling.annotate("brc/bracha/initial"):
         h0 = gather(est)
         v0, s0, b0 = adv.inject(seed, inst_ids, rnd, 0, h0, setup, xp=xp,
                                 recv_ids=recv_ids)
+        if fsil is not None:
+            s0 = s0 | fsil
         g0_0, g0_1 = validation.live_counts(v0, s0, xp=xp)
         c0_0, c0_1 = counts(0, h0, v0, s0, b0)
         m = (c0_1 >= c0_0).astype(xp.uint8)
@@ -59,6 +68,8 @@ def round_body(cfg, seed, inst_ids, rnd, state, adv, setup, xp=np,
         h1 = gather(m)
         v1, s1, b1 = adv.inject(seed, inst_ids, rnd, 1, h1, setup, xp=xp,
                                 recv_ids=recv_ids)
+        if fsil is not None:
+            s1 = s1 | fsil
         s1 = s1 | validation.validate_step1(cfg, v1, g0_0, g0_1, xp=xp)
         g1_0, g1_1 = validation.live_counts(v1, s1, xp=xp)
         c1_0, c1_1 = counts(1, h1, v1, s1, b1)
@@ -70,6 +81,8 @@ def round_body(cfg, seed, inst_ids, rnd, state, adv, setup, xp=np,
         h2 = gather(d)
         v2, s2, b2 = adv.inject(seed, inst_ids, rnd, 2, h2, setup, xp=xp,
                                 recv_ids=recv_ids)
+        if fsil is not None:
+            s2 = s2 | fsil
         s2 = s2 | validation.validate_step2(cfg, v2, g1_0, g1_1, xp=xp)
         c2_0, c2_1 = counts(2, h2, v2, s2, b2)
         w = (c2_1 >= c2_0).astype(xp.uint8)

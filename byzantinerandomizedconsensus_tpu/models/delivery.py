@@ -6,7 +6,10 @@ count-level sampler (spec §4b / §4b-v2 / §4c), or the spec-§4 masks+tally
 path — and, when the opt-in counter side channel is enabled, records each
 step's count outputs into ``obs`` for obs/counters.py. Factored here so the
 two protocols cannot drift in either the dispatch rule or the side-channel
-shape.
+shape. The spec-§9 fault masks thread through here too: ``fside`` reaches
+the delivery law (count-level samplers via their ``fside`` argument, the §4
+mask model via the cross-cut silence plane), and ``fsil`` rides into the
+side channel for the schema-v2 fault-attributed counters.
 """
 
 from __future__ import annotations
@@ -16,18 +19,35 @@ from byzantinerandomizedconsensus_tpu.utils import profiling
 
 
 def make_counts(cfg, seed, inst_ids, rnd, setup, xp, recv_ids=None,
-                counts_fn=None, obs=None):
+                counts_fn=None, obs=None, fsil=None, fside=None):
     """Build the ``counts(t, honest, values, silent, bias) -> (c0, c1)``
     closure a round body calls once per broadcast step.
 
     ``obs``, when a dict, receives per-step entries
-    ``obs[t] = {"c0", "c1", "silent", "stats"}`` — a pure side channel that
-    the step math never reads, so enabling it cannot move the bit-match
-    surface. ``stats`` carries the sampler-owned cost counters (chain trips
-    etc.; see the ``stats`` parameter of the ops/urn*.py samplers). Custom
-    kernels (``counts_fn`` given) have no side channel — backends gate
-    counter collection to the default paths (obs/counters.CountersUnsupported).
+    ``obs[t] = {"c0", "c1", "silent", "stats", "fsil", "fside"}`` — a pure
+    side channel that the step math never reads, so enabling it cannot move
+    the bit-match surface. ``stats`` carries the sampler-owned cost counters
+    (chain trips etc.; see the ``stats`` parameter of the ops/urn*.py
+    samplers); ``fsil``/``fside`` are the round's spec-§9 fault masks (None
+    on the faults="none" path) for the schema-v2 fault-attributed counters.
+    Custom kernels (``counts_fn`` given) have no side channel — backends gate
+    counter collection to the default paths (obs/counters.CountersUnsupported)
+    and fault schedules to the default kernels (models/faults.FaultsUnsupported).
     """
+    if counts_fn is not None and (fsil is not None or fside is not None):
+        from byzantinerandomizedconsensus_tpu.models.faults import (
+            FaultsUnsupported)
+
+        raise FaultsUnsupported(
+            "custom delivery kernels (Pallas / xla_nosort) have no "
+            "fault-schedule channel; faults run on the default kernels")
+    # The partition cut for the §4 mask model: one (B, R, n) cross-side
+    # silence plane per round, shared by all steps.
+    xsil = None
+    if fside is not None and not cfg.count_level:
+        from byzantinerandomizedconsensus_tpu.models.faults import cross_silent
+
+        xsil = cross_silent(fside, recv_ids=recv_ids, xp=xp)
 
     def counts(t, honest, values, silent, bias):
         if counts_fn is not None:
@@ -39,20 +59,22 @@ def make_counts(cfg, seed, inst_ids, rnd, setup, xp, recv_ids=None,
                 if obs is None:
                     return fn(cfg, seed, inst_ids, rnd, t, values, silent,
                               setup["faulty"], honest, recv_ids=recv_ids,
-                              xp=xp)
+                              xp=xp, fside=fside)
                 stats = {}
                 c0, c1 = fn(cfg, seed, inst_ids, rnd, t, values, silent,
                             setup["faulty"], honest, recv_ids=recv_ids, xp=xp,
-                            stats=stats)
-                obs[t] = {"c0": c0, "c1": c1, "silent": silent, "stats": stats}
+                            stats=stats, fside=fside)
+                obs[t] = {"c0": c0, "c1": c1, "silent": silent, "stats": stats,
+                          "fsil": fsil, "fside": fside}
                 return c0, c1
         with profiling.annotate("brc/mask"):
             m = masks.delivery_mask(cfg, seed, inst_ids, rnd, t, silent, bias,
-                                    xp=xp, recv_ids=recv_ids)
+                                    xp=xp, recv_ids=recv_ids, xsilent=xsil)
         with profiling.annotate("brc/tally"):
             c0, c1 = tally.tally01(m, values, xp=xp)
         if obs is not None:
-            obs[t] = {"c0": c0, "c1": c1, "silent": silent, "stats": {}}
+            obs[t] = {"c0": c0, "c1": c1, "silent": silent, "stats": {},
+                      "fsil": fsil, "fside": fside}
         return c0, c1
 
     return counts

@@ -1,0 +1,142 @@
+"""Vectorized fault schedules as data (spec/PROTOCOL.md §9) — the axis
+orthogonal to the §6 adversaries.
+
+A fault schedule is (a) a static per-instance setup — the §3.2 fault-prone
+set plus PRF-drawn window/epoch parameters — and (b) a pure per-round mask
+function mapping the round index to
+
+- ``fsil``: (B, n) bool extra *sender* silences this round (crash-recovery
+  windows, omission bursts), OR'd into the adversary's silent set before
+  §5.1b validation and §4 delivery; and
+- ``fside``: (B, n) uint8 partition side plane (1 = isolated) with cross-side
+  messages suppressed at the delivery law in both directions — 0 everywhere
+  when the instance is not inside its partition epoch.
+
+Everything is a pure function of (seed, instance, round, replica) —
+jit-compatible (``rnd`` may be a traced scalar), and every schedule draws
+only from the §3.2 fault-prone set (the same size-f selection the adversary
+uses), so the composed run never has more than f misbehaving replicas and the
+§5 safety arguments apply verbatim; see spec §9 for the reduction. The scalar
+oracle implements the same laws independently in core/faults.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu.ops import prf
+
+
+class FaultsUnsupported(RuntimeError):
+    """Raised by stacks that have no fault-schedule channel (the native ABI,
+    the Pallas kernels, the shard_map mesh). Callers degrade honestly —
+    mirroring obs/counters.CountersUnsupported — instead of silently running
+    the fault-free law."""
+
+
+def check_faults_supported(cfg, stack: str) -> None:
+    """Shared gate: reject ``cfg.faults != "none"`` on a stack without a
+    fault channel with one uniform message."""
+    if cfg.faults != "none":
+        raise FaultsUnsupported(
+            f"{stack} has no fault-schedule channel; "
+            f"faults={cfg.faults!r} runs on the cpu|numpy|jax stacks")
+
+
+def fault_prone_mask(cfg, seed, inst_ids, xp=np):
+    """(B, n) bool — the §3.2 fault-prone set: the f replicas with smallest
+    combined FAULTY_RANK keys. The same selection law as
+    models/adversaries.faulty_mask, but *not* gated on ``cfg.adversary``:
+    with any active adversary the two sets coincide (same PRF purpose), so
+    fault schedules never widen the misbehaving set beyond f."""
+    B = inst_ids.shape[0]
+    if cfg.f == 0:
+        return xp.zeros((B, cfg.n), dtype=bool)
+    replica = xp.arange(cfg.n, dtype=xp.uint32)[None, :]
+    rank = prf.prf_u32(seed, xp.asarray(inst_ids, dtype=xp.uint32)[:, None],
+                       0, 0, replica, 0, prf.FAULTY_RANK, xp=xp,
+                       pack=cfg.pack_version)
+    key = (rank & xp.uint32(prf.KEY_MASK[cfg.pack_version])) | replica
+    if xp is np:
+        kth = np.partition(key, cfg.f - 1, axis=-1)[..., cfg.f - 1]
+    else:
+        kth = xp.sort(key, axis=-1)[..., cfg.f - 1]
+    return key <= kth[..., None]
+
+
+def setup_faults(cfg, seed, inst_ids, xp=np):
+    """Static per-instance fault-schedule state (spec §9), or None for
+    ``faults="none"`` — the fast path that keeps every existing config's
+    compiled program and draws untouched."""
+    if cfg.faults == "none":
+        return None
+    inst = xp.asarray(inst_ids, dtype=xp.uint32)[:, None]
+    replica = xp.arange(cfg.n, dtype=xp.uint32)[None, :]
+    w = xp.uint32(cfg.crash_window)
+    out = {"fprone": fault_prone_mask(cfg, seed, inst_ids, xp=xp)}
+    if cfg.faults == "recover":
+        down = prf.prf_u32(seed, inst, 0, 0, replica, 0, prf.FAULT_CRASH,
+                           xp=xp, pack=cfg.pack_version) % w
+        length = prf.prf_u32(seed, inst, 0, 0, replica, 0, prf.FAULT_HEAL,
+                             xp=xp, pack=cfg.pack_version) % (w + w)
+        out["down_at"] = down.astype(xp.int32)
+        out["up_at"] = (down + length).astype(xp.int32) + xp.int32(1)
+    elif cfg.faults == "partition":
+        side = prf.prf_u32(seed, inst, 0, 0, replica, 0, prf.FAULT_SIDE,
+                           xp=xp, pack=cfg.pack_version) & xp.uint32(1)
+        # The cut isolates a PRF-drawn *subset of the fault-prone set*: from
+        # any main-side receiver the epoch is indistinguishable from crash
+        # silence of ≤ f replicas, and the isolated side (≤ f replicas) can
+        # never assemble a §5 quorum — the safety reduction of spec §9.
+        out["side"] = (side.astype(xp.uint8)
+                       * out["fprone"].astype(xp.uint8))
+        inst1 = xp.asarray(inst_ids, dtype=xp.uint32)
+        start = prf.prf_u32(seed, inst1, 0, 0, 0, 0, prf.FAULT_EPOCH,
+                            xp=xp, pack=cfg.pack_version) % w
+        length = prf.prf_u32(seed, inst1, 0, 0, 1, 0, prf.FAULT_EPOCH,
+                             xp=xp, pack=cfg.pack_version) % (w + w)
+        out["part_start"] = start.astype(xp.int32)
+        out["part_heal"] = (start + length).astype(xp.int32) + xp.int32(1)
+    return out
+
+
+def round_masks(cfg, seed, inst_ids, rnd, fsetup, xp=np):
+    """Per-round fault masks ``(fsil, fside)`` (module docstring shapes);
+    ``(None, None)`` for ``faults="none"``. ``rnd`` may be traced."""
+    if fsetup is None:
+        return None, None
+    fprone = fsetup["fprone"]
+    r = xp.asarray(rnd, dtype=xp.int32)
+    if cfg.faults == "recover":
+        fsil = fprone & (r >= fsetup["down_at"]) & (r < fsetup["up_at"])
+        return fsil, None
+    if cfg.faults == "partition":
+        active = (r >= fsetup["part_start"]) & (r < fsetup["part_heal"])
+        fside = xp.where(active[:, None], fsetup["side"], xp.uint8(0))
+        return None, fside.astype(xp.uint8)
+    # omission: a per-(instance, round) burst gate (rate 1/4) picks rounds;
+    # inside a burst each fault-prone replica is silenced by its own PRF bit.
+    inst = xp.asarray(inst_ids, dtype=xp.uint32)
+    gate = prf.prf_u32(seed, inst, r, 0, 0, 1, prf.FAULT_OMIT, xp=xp,
+                       pack=cfg.pack_version)
+    burst = (gate & xp.uint32(3)) == 0
+    replica = xp.arange(cfg.n, dtype=xp.uint32)[None, :]
+    bit = prf.prf_u32(seed, inst[:, None], r, 0, replica, 0, prf.FAULT_OMIT,
+                      xp=xp, pack=cfg.pack_version) & xp.uint32(1)
+    fsil = fprone & burst[:, None] & (bit == 1)
+    return fsil, None
+
+
+def cross_silent(fside, recv_ids=None, xp=np):
+    """(B, R, n) bool — the partition cut as a per-(recv, send) silence
+    plane for the spec-§4 mask model: suppressed iff the two sides differ.
+    ``fside`` is the (B, n) per-round side plane; ``recv_ids`` restricts the
+    receiver axis (the replica-sharded path)."""
+    n = fside.shape[-1]
+    if recv_ids is None:
+        recv_ids = xp.arange(n, dtype=xp.uint32)
+    if xp is np:
+        fside_recv = fside[:, np.asarray(recv_ids, dtype=np.int64)]
+    else:
+        fside_recv = fside[:, xp.asarray(recv_ids).astype(xp.int32)]
+    return fside_recv[:, :, None] != fside[:, None, :]

@@ -1,0 +1,93 @@
+"""Safety invariants over the full per-replica state (spec §1; §9 checker).
+
+The result surface (``SimResult.decision``) collapses an instance to the
+lowest-indexed correct replica's value, which *assumes* Agreement — the
+always-on oracle assertion (backends/cpu.py) covers the oracle leg only. The
+fault-schedule axis (spec §9) makes whole-state checking a first-class
+instrument: every chaos-soak config runs through here, and a violation is a
+hard artifact-recorded failure, never a silent statistic.
+
+Checked per instance, over the state the product path actually computed
+(``NumpyBackend.run_with_state``):
+
+- **Agreement** — all correct decided replicas share one decided value;
+- **Validity** — unanimity forces the decision, over the basis the fault
+  model actually guarantees: under a **lying** adversary (byzantine /
+  adaptive / adaptive_min) the basis is the *correct* replicas (faulty
+  inputs are adversarial and carry no weight); under the benign/crash
+  models the basis is **all** replicas — crash-faulty replicas run the
+  honest machine on honest inputs, and Ben-Or Protocol A's validity is
+  exactly the all-processes-unanimous statement [Ben-Or 1983] (a
+  correct-only basis is provably too strong there: with n=5, f=2, three
+  correct replicas at v and two honest-until-crash replicas at w, the
+  delivery quota can hide every v-report behind the two w-reports, no
+  round-1 proposal forms, and the shared coin legally walks everyone to w
+  — found live by the round-9 chaos soak, at faults="none");
+- **Decision consistency** — the collapsed ``SimResult.decision`` equals the
+  first correct replica's decided value (2 when the instance capped out).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu.models import state as state_mod
+
+
+def state_violations(cfg, state, faulty, res=None, inst_ids=None) -> list:
+    """List of violation records over a (B, n) state dict; empty = safe.
+
+    ``faulty`` is the adversary's (B, n) faulty mask (spec §3.2) — replicas
+    silenced by a §9 fault schedule but not adversary-faulty are *correct*
+    and fully bound by Agreement/Validity. ``res``, when given, adds the
+    decision-consistency check against its (B,) arrays.
+    """
+    decided = np.asarray(state["decided"])
+    dval = np.asarray(state["decided_val"])
+    correct = ~np.asarray(faulty)
+    B = decided.shape[0]
+    if inst_ids is None:
+        inst_ids = np.arange(B)
+    est0 = state_mod.init_est(cfg, cfg.seed, np.asarray(inst_ids), xp=np)
+
+    out = []
+    for i in range(B):
+        inst = int(inst_ids[i])
+        cd = correct[i] & decided[i]
+        vals = sorted(set(dval[i][cd].tolist()))
+        if len(vals) > 1:
+            out.append({"instance": inst, "kind": "agreement",
+                        "decided_values": vals})
+        # Validity basis per fault model (module docstring): correct
+        # replicas under a lying adversary, all replicas otherwise.
+        ce = est0[i][correct[i]] if cfg.lying_adversary else est0[i]
+        if len(ce) and (ce == ce[0]).all():
+            v = int(ce[0])
+            if any(int(x) != v for x in dval[i][cd]):
+                out.append({"instance": inst, "kind": "validity",
+                            "unanimous_init": v,
+                            "decided_values": vals})
+        if res is not None:
+            done = bool(cd.sum() == correct[i].sum() and correct[i].any())
+            want = int(dval[i][np.argmax(correct[i])]) if done \
+                and int(res.rounds[i]) < cfg.round_cap else None
+            got = int(res.decision[i])
+            if want is not None and got != want:
+                out.append({"instance": inst, "kind": "decision_consistency",
+                            "expected": want, "got": got})
+    return out
+
+
+def check_config(cfg, backend="numpy", inst_ids=None) -> dict:
+    """Run ``cfg`` on the numpy backend with full state and check the safety
+    invariants; returns ``{"checked_instances", "violations"}``. The backend
+    argument is pinned to one with ``run_with_state`` (numpy)."""
+    from byzantinerandomizedconsensus_tpu.backends import get_backend
+
+    be = get_backend(backend)
+    res, state, faulty = be.run_with_state(cfg, inst_ids)
+    return {
+        "checked_instances": int(len(res.inst_ids)),
+        "violations": state_violations(cfg, state, faulty, res=res,
+                                       inst_ids=res.inst_ids),
+    }

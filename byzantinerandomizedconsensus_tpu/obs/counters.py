@@ -36,7 +36,12 @@ from __future__ import annotations
 
 import numpy as np
 
-COUNTER_SCHEMA_VERSION = 1
+# v2 (spec §9): fault-attributed counters — ``fault_silenced@ph`` /
+# ``fault_cut_pairs@ph`` appear for configs with a fault schedule, and the
+# ``dropped@ph`` law becomes partition-aware (a receiver's live total counts
+# only same-side senders). v1 configs (faults="none") keep the exact v1
+# column set and values.
+COUNTER_SCHEMA_VERSION = 2
 
 # Step-index → phase-name mapping per protocol. Ben-Or's two broadcast steps
 # are the classic report/propose pair (models/benor.py); Bracha's three are
@@ -75,6 +80,14 @@ def counter_names(cfg) -> tuple[str, ...]:
         names += [f"delivered0@{ph}", f"delivered1@{ph}", f"dropped@{ph}"]
     names += ["coin_flips", "rounds_active"]
     names += _SAMPLER_COUNTERS.get(cfg.delivery, ())
+    if cfg.faults != "none":
+        # Schema v2 fault attribution (spec §9): senders the fault schedule
+        # silenced this step (whether or not the adversary also did), and
+        # live (receiver, sender) pairs suppressed by the partition cut.
+        # Present for every fault kind — zeros where not applicable — so the
+        # column order is a static function of the config.
+        for ph in phase_names(cfg):
+            names += [f"fault_silenced@{ph}", f"fault_cut_pairs@{ph}"]
     return tuple(names)
 
 
@@ -123,9 +136,19 @@ def round_increments(cfg, obs: dict, xp=np):
         cols.append(e["c1"].sum(axis=-1).astype(u32))
         # Drop total from the silent set alone (spec §4: every delivery law
         # drops exactly max(0, L_v − (n−f−1)) live messages per receiver).
+        # Under a §9 partition, L_v counts only same-side live senders.
         live = ~xp.asarray(e["silent"], dtype=bool)
-        tot = live.sum(axis=-1, dtype=i32)
-        L = (tot[:, None] - live.astype(i32)).astype(i32)
+        fside = e.get("fside")
+        if fside is None:
+            tot = live.sum(axis=-1, dtype=i32)
+            L = (tot[:, None] - live.astype(i32)).astype(i32)
+        else:
+            side = xp.asarray(fside, dtype=xp.uint8)
+            tot_p = [(live & (side == xp.uint8(p))).sum(axis=-1, dtype=i32)
+                     for p in (0, 1)]
+            tot_v = xp.where(side == xp.uint8(1), tot_p[1][:, None],
+                             tot_p[0][:, None])
+            L = (tot_v - live.astype(i32)).astype(i32)
         cols.append(xp.maximum(L - k, i32(0)).sum(axis=-1).astype(u32))
     coin = cfg.n if cfg.coin == "local" else 1
     cols.append(xp.full((batch,), coin, dtype=xp.uint32))
@@ -142,6 +165,27 @@ def round_increments(cfg, obs: dict, xp=np):
             for t in range(1, steps):
                 acc = (acc + obs[t]["stats"][name].astype(u32)).astype(u32)
             cols.append(acc)
+    if cfg.faults != "none":
+        for t in range(steps):
+            e = obs[t]
+            fsil = e.get("fsil")
+            if fsil is None:
+                cols.append(xp.zeros((batch,), dtype=u32))
+            else:
+                cols.append(xp.asarray(fsil, dtype=bool)
+                            .sum(axis=-1, dtype=i32).astype(u32))
+            fside = e.get("fside")
+            if fside is None:
+                cols.append(xp.zeros((batch,), dtype=u32))
+            else:
+                live = ~xp.asarray(e["silent"], dtype=bool)
+                side = xp.asarray(fside, dtype=xp.uint8)
+                liv_p = [(live & (side == xp.uint8(p))).sum(axis=-1, dtype=i32)
+                         for p in (0, 1)]
+                # Receiver on side s misses every live sender on side 1−s.
+                cross = xp.where(side == xp.uint8(1), liv_p[0][:, None],
+                                 liv_p[1][:, None])
+                cols.append(cross.sum(axis=-1).astype(u32))
     return xp.stack(cols, axis=1)
 
 

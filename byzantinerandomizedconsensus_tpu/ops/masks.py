@@ -19,13 +19,17 @@ import numpy as np
 from byzantinerandomizedconsensus_tpu.ops import prf
 
 
-def combined_keys(cfg, seed, inst_ids, rnd, t, silent, bias, xp=np, recv_ids=None):
+def combined_keys(cfg, seed, inst_ids, rnd, t, silent, bias, xp=np, recv_ids=None,
+                  xsilent=None):
     """Combined scheduling keys, shape (B, R, n) uint32, axes (instance, recv, send).
 
     ``silent``: (B, n) bool per sender; ``bias``: (B, R, n) or (B, 1, n) uint32/bool
     per (recv, send) (0 unless the adaptive adversary is active). ``recv_ids`` is an
     optional (R,) array of *global* receiver indices — a replica-axis shard of the
-    full matrix (parallel/sharded.py); default is all n receivers.
+    full matrix (parallel/sharded.py); default is all n receivers. ``xsilent`` is an
+    optional (B, R, n) bool per-(recv, send) silence plane — the spec-§9 partition
+    cut — OR'd into the broadcast sender silences (its diagonal is False by
+    construction: a replica shares its own side).
     """
     n = cfg.n
     u32 = xp.uint32
@@ -38,6 +42,8 @@ def combined_keys(cfg, seed, inst_ids, rnd, t, silent, bias, xp=np, recv_ids=Non
         rnd, t, recv, send, prf.SCHED, xp=xp, pack=cfg.pack_version,
     )
     silent_b = xp.asarray(silent, dtype=xp.uint32)[:, None, :]
+    if xsilent is not None:
+        silent_b = silent_b | xp.asarray(xsilent, dtype=xp.uint32)
     bias_b = xp.asarray(bias, dtype=xp.uint32)
     # Combined-key field split per packing law (spec §2 v2): the sender index
     # field widens 10 → 12 bits past n=1024, the PRF field narrows 20 → 18.
@@ -55,10 +61,12 @@ def combined_keys(cfg, seed, inst_ids, rnd, t, silent, bias, xp=np, recv_ids=Non
     return combined
 
 
-def mask_from_keys(combined, n_deliver: int, silent, xp=np, recv_ids=None):
+def mask_from_keys(combined, n_deliver: int, silent, xp=np, recv_ids=None,
+                   xsilent=None):
     """Delivery mask (B, R, n) bool from combined keys: the ``n_deliver`` smallest
     per receiver row, excluding silent senders (redundant by the bit-31 argument in
-    spec §4, kept as a guard)."""
+    spec §4, kept as a guard). ``xsilent`` extends the exclusion per (recv, send)
+    (the spec-§9 partition cut)."""
     if xp is np:
         kth = np.partition(combined, n_deliver - 1, axis=-1)[..., n_deliver - 1]
     else:
@@ -69,16 +77,21 @@ def mask_from_keys(combined, n_deliver: int, silent, xp=np, recv_ids=None):
         recv_ids = xp.arange(n, dtype=xp.uint32)
     own = (xp.asarray(recv_ids, dtype=xp.uint32)[:, None]
            == xp.arange(n, dtype=xp.uint32)[None, :])[None]
+    excl = xp.asarray(silent, dtype=bool)[:, None, :]
+    if xsilent is not None:
+        excl = excl | xp.asarray(xsilent, dtype=bool)
     # Own message is delivered unconditionally (spec §4): exempt from silence AND
     # from the quota selection (aligned with the oracle's Network.delivery_mask).
-    return (mask & ~xp.asarray(silent, dtype=bool)[:, None, :]) | own
+    return (mask & ~excl) | own
 
 
-def delivery_mask(cfg, seed, inst_ids, rnd, t, silent, bias, xp=np, recv_ids=None):
-    """(B, R, n) bool — delivered(recv, send) per spec §4."""
+def delivery_mask(cfg, seed, inst_ids, rnd, t, silent, bias, xp=np, recv_ids=None,
+                  xsilent=None):
+    """(B, R, n) bool — delivered(recv, send) per spec §4 (+§9 cut)."""
     combined = combined_keys(cfg, seed, inst_ids, rnd, t, silent, bias, xp=xp,
-                             recv_ids=recv_ids)
-    return mask_from_keys(combined, cfg.n - cfg.f, silent, xp=xp, recv_ids=recv_ids)
+                             recv_ids=recv_ids, xsilent=xsilent)
+    return mask_from_keys(combined, cfg.n - cfg.f, silent, xp=xp,
+                          recv_ids=recv_ids, xsilent=xsilent)
 
 
 def _smallest_k_mask_xla(combined, k: int, low: int = 10):

@@ -82,6 +82,12 @@ SCHED = 6
 URN = 7
 URN2 = 8
 URN3 = 9
+# Fault-schedule draws (spec §9) — the axis orthogonal to §6 adversaries.
+FAULT_CRASH = 10    # recover: outage start round, per (instance, replica)
+FAULT_HEAL = 11     # recover: outage length − 1, per (instance, replica)
+FAULT_SIDE = 12     # partition: isolated-side bit, per (instance, replica)
+FAULT_EPOCH = 13    # partition: epoch start (recv=0) / heal length (recv=1)
+FAULT_OMIT = 14     # omission: burst gate (send=1) / per-replica bit (send=0)
 
 # Urn-delivery LCG (spec §4b): full period mod 2^32 (A ≡ 1 mod 4, C odd).
 URN_LCG_A = 0x915F77F5

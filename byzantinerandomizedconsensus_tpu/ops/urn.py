@@ -44,7 +44,7 @@ def _take_lane(arr, recv, xp):
 
 
 def lane_setup(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
-               recv_ids=None, xp=np):
+               recv_ids=None, xp=np, fside=None):
     """Shared §4b/§4b-v2 per-lane class state.
 
     Returns ``(recv, own_val, m, st, L, D)``: the (R,) receiver lane ids, the
@@ -52,6 +52,11 @@ def lane_setup(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
     over senders ``u != v``, the stratum flags ``st[w]`` (bool, broadcastable
     to (B, R)), and the urn totals ``L``/``D``. Both urn samplers consume
     exactly this state; only the drop-sampling algorithm differs.
+
+    ``fside``, when given, is the (B, n) spec-§9 partition side plane: a
+    receiver's urn then holds only live same-side senders (the class counts
+    split per side and select by the receiver's own side), which shrinks
+    ``L``/``D`` — the cut suppresses messages, it never adds any.
     """
     n, f = cfg.n, cfg.f
     u32, i32 = xp.uint32, xp.int32
@@ -69,23 +74,41 @@ def lane_setup(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
 
     live = ~xp.asarray(silent, dtype=bool)
 
-    # Global per-class counts M[h][w] (B,), then per-lane m_w with the own-sender
-    # term removed (spec §4b: the urn ranges over u != v).
-    def class_counts(vh):
-        return [ (live & (vh == w)).sum(axis=-1, dtype=i32) for w in (0, 1, 2) ]
-
-    M0 = class_counts(v0c)
-    M1 = M0 if v1c is v0c else class_counts(v1c)
-
     v_at0 = _take_lane(v0c, recv, xp)
     v_at1 = v_at0 if v1c is v0c else _take_lane(v1c, recv, xp)
     own_val = xp.where(h_lane, v_at1, v_at0)             # (B, R)
     live_at = _take_lane(live, recv, xp)                 # (B, R)
 
     m = []
-    for w in (0, 1, 2):
-        M_sel = xp.where(h_lane, M1[w][:, None], M0[w][:, None])
-        m.append((M_sel - (live_at & (own_val == w)).astype(i32)).astype(i32))
+    if fside is None:
+        # Global per-class counts M[h][w] (B,), then per-lane m_w with the
+        # own-sender term removed (spec §4b: the urn ranges over u != v).
+        def class_counts(vh):
+            return [ (live & (vh == w)).sum(axis=-1, dtype=i32) for w in (0, 1, 2) ]
+
+        M0 = class_counts(v0c)
+        M1 = M0 if v1c is v0c else class_counts(v1c)
+        for w in (0, 1, 2):
+            M_sel = xp.where(h_lane, M1[w][:, None], M0[w][:, None])
+            m.append((M_sel - (live_at & (own_val == w)).astype(i32)).astype(i32))
+    else:
+        # Partition cut (spec §9): class counts split per side, selected by
+        # the receiver's own side (a receiver hears only same-side senders).
+        # The own-sender term subtracts as before — own side == own side.
+        fside = xp.asarray(fside, dtype=xp.uint8)
+        p_lane = _take_lane(fside, recv, xp)             # (B, R)
+
+        def class_counts_p(vh, p):
+            sel = live & (fside == xp.uint8(p))
+            return [ (sel & (vh == w)).sum(axis=-1, dtype=i32) for w in (0, 1, 2) ]
+
+        M0p = [class_counts_p(v0c, p) for p in (0, 1)]
+        M1p = M0p if v1c is v0c else [class_counts_p(v1c, p) for p in (0, 1)]
+        for w in (0, 1, 2):
+            sel = [xp.where(h_lane, M1p[p][w][:, None], M0p[p][w][:, None])
+                   for p in (0, 1)]
+            M_sel = xp.where(p_lane == xp.uint8(1), sel[1], sel[0])
+            m.append((M_sel - (live_at & (own_val == w)).astype(i32)).astype(i32))
 
     # Stratum flags per value (spec §4b): only the adaptive family biases
     # scheduling. "adaptive": biased(w, h) = (w == 2) | (w != h), per lane
@@ -111,7 +134,7 @@ def lane_setup(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
 
 
 def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
-              recv_ids=None, xp=np, stats=None):
+              recv_ids=None, xp=np, stats=None, fside=None):
     """(c0, c1) delivered-value counts per receiver lane — spec §4b.
 
     Signature matches the round-body ``counts_fn`` hook. ``values`` is the
@@ -130,7 +153,7 @@ def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
     B = silent.shape[0]
     recv, own_val, m, st, L, D = lane_setup(
         cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
-        recv_ids=recv_ids, xp=xp)
+        recv_ids=recv_ids, xp=xp, fside=fside)
     if stats is not None:
         stats["urn_draws"] = D.sum(axis=-1).astype(u32)
     adaptive = cfg.adversary in ("adaptive", "adaptive_min")

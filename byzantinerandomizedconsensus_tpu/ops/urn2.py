@@ -93,7 +93,7 @@ def _trips(mm, Lr, Dr, xp):
 
 
 def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
-              recv_ids=None, xp=np, stats=None):
+              recv_ids=None, xp=np, stats=None, fside=None):
     """(c0, c1) delivered-value counts per receiver lane — spec §4b-v2.
 
     Same hook signature and same class/stratum state (ops/urn.py::lane_setup)
@@ -108,7 +108,7 @@ def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
     i32 = xp.int32
     recv, own_val, m, st, L, D = urn.lane_setup(
         cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
-        recv_ids=recv_ids, xp=xp)
+        recv_ids=recv_ids, xp=xp, fside=fside)
     adaptive = cfg.adversary in ("adaptive", "adaptive_min")
 
     trips_sum = trips_max = None

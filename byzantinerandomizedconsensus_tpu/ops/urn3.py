@@ -50,7 +50,7 @@ def _cheap(u, seg, m, Lr, Dr, xp):
 
 
 def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
-              recv_ids=None, xp=np, stats=None):
+              recv_ids=None, xp=np, stats=None, fside=None):
     """(c0, c1) delivered-value counts per receiver lane — spec §4c.
 
     Same hook signature and same class/stratum state (ops/urn.py::lane_setup)
@@ -64,7 +64,7 @@ def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
     i32 = xp.int32
     recv, own_val, m, st, L, D = urn.lane_setup(
         cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
-        recv_ids=recv_ids, xp=xp)
+        recv_ids=recv_ids, xp=xp, fside=fside)
     if stats is not None:
         stats["urn3_words"] = xp.full((silent.shape[0],), recv.shape[0],
                                       dtype=xp.uint32)

@@ -202,10 +202,75 @@ def run_preset_rows(instances: int = 2000, backend: str = "native",
     return rows
 
 
+# Fault-schedule liveness map (spec §9): each row runs one config at
+# faults="none" (the baseline) and at every fault kind, and reports the
+# rounds-histogram TV distance vs the baseline — the §9 schedules must
+# degrade *liveness only* (safety is the invariant checker's job,
+# models/invariants.py + the chaos soak), and this leg quantifies by how
+# much. Product delivery, small n — the numpy backend is plenty.
+FAULT_GRID: tuple[SimConfig, ...] = (
+    SimConfig(protocol="benor", n=9, f=3, adversary="crash", coin="local",
+              seed=1, round_cap=96, delivery="urn2"),
+    SimConfig(protocol="benor", n=9, f=4, adversary="none", coin="local",
+              seed=2, round_cap=96, delivery="urn2"),
+    SimConfig(protocol="bracha", n=16, f=5, adversary="byzantine",
+              coin="shared", seed=3, round_cap=96, delivery="urn2"),
+    SimConfig(protocol="bracha", n=16, f=5, adversary="adaptive",
+              coin="shared", seed=4, round_cap=96, delivery="urn2"),
+)
+
+FAULT_KINDS_MEASURED = ("recover", "partition", "omission")
+
+
+def fault_row(cfg: SimConfig, instances: int, backend: str) -> dict:
+    """One §9 liveness row: the fault-free baseline vs every fault kind on
+    the same config — per-kind rounds-histogram TV, mean rounds, capped and
+    decided-1 fractions."""
+    cfg = dataclasses.replace(cfg, instances=instances).validate()
+    base = Simulator(cfg, backend).run()
+    row = {
+        "protocol": cfg.protocol, "n": cfg.n, "f": cfg.f,
+        "adversary": cfg.adversary, "coin": cfg.coin, "seed": cfg.seed,
+        "round_cap": cfg.round_cap, "delivery": cfg.delivery,
+        "instances": instances, "backend": backend,
+        "mean_rounds_none": float(base.rounds.mean()),
+        "capped_none": float((base.decision == 2).mean()),
+        "p1_none": float((base.decision == 1).mean()),
+    }
+    for kind in FAULT_KINDS_MEASURED:
+        r = Simulator(dataclasses.replace(cfg, faults=kind), backend).run()
+        row[f"rounds_hist_tv_{kind}"] = rounds_hist_tv(base.rounds, r.rounds)
+        row[f"mean_rounds_{kind}"] = float(r.rounds.mean())
+        row[f"capped_{kind}"] = float((r.decision == 2).mean())
+        row[f"p1_{kind}"] = float((r.decision == 1).mean())
+    return row
+
+
+def run_fault_rows(instances: int = 400, backend: str = "numpy",
+                   progress=print) -> list:
+    rows = []
+    for cfg in FAULT_GRID:
+        rows.append(fault_row(cfg, instances, backend))
+        progress(json.dumps(rows[-1]))
+    return rows
+
+
+def fault_rows_summary(rows: list) -> dict:
+    return {
+        f"fault_max_rounds_hist_tv_{kind}": max(
+            r[f"rounds_hist_tv_{kind}"] for r in rows)
+        for kind in FAULT_KINDS_MEASURED
+    } | {
+        f"fault_max_capped_{kind}": max(r[f"capped_{kind}"] for r in rows)
+        for kind in FAULT_KINDS_MEASURED
+    }
+
+
 def run_divergence(instances: int = 400, backend: str = "numpy",
                    full: bool = False, full_backend: str = "jax",
                    full_instances: int = 2000, presets: bool = False,
                    preset_instances: int = 2000, preset_backend: str = "native",
+                   faults: bool = False, fault_instances: int = 400,
                    progress=print) -> dict:
     rows = []
     for cfg, regime in GRID:
@@ -244,6 +309,11 @@ def run_divergence(instances: int = 400, backend: str = "numpy",
             r["rounds_hist_tv_urn2_urn3"] for r in prows)
         summary["preset_max_abs_mean_rounds_gap_urn2_urn3"] = max(
             abs(r["mean_rounds_urn2"] - r["mean_rounds_urn3"]) for r in prows)
+    if faults:
+        frows = run_fault_rows(instances=fault_instances, backend=backend,
+                               progress=progress)
+        out["fault_rows"] = frows
+        summary.update(fault_rows_summary(frows))
     return out
 
 
@@ -264,6 +334,10 @@ def main(argv=None) -> int:
                          "(per-instance disagreement + rounds-histogram TV)")
     ap.add_argument("--preset-instances", type=int, default=2000)
     ap.add_argument("--preset-backend", default="native")
+    ap.add_argument("--faults", action="store_true",
+                    help="add the spec-§9 fault-schedule liveness rows "
+                         "(rounds-histogram TV vs the fault-free baseline)")
+    ap.add_argument("--fault-instances", type=int, default=400)
     args = ap.parse_args(argv)
 
     if args.full:
@@ -275,7 +349,9 @@ def main(argv=None) -> int:
                             full_instances=args.full_instances,
                             presets=args.presets,
                             preset_instances=args.preset_instances,
-                            preset_backend=args.preset_backend)
+                            preset_backend=args.preset_backend,
+                            faults=args.faults,
+                            fault_instances=args.fault_instances)
     out = pathlib.Path(args.out)
     out.parent.mkdir(parents=True, exist_ok=True)
     out.write_text(json.dumps(result, indent=1, sort_keys=True) + "\n")

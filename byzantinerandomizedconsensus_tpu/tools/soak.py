@@ -10,13 +10,26 @@ bit-for-bit. Every ``--oracle-every``-th config additionally runs a subsample
 of instances through the scalar CPU oracle — the third independent
 implementation — anchoring the pair to the spec, not just to each other.
 
-One command reproduces the claim and stamps the artifact:
+**Chaos mode** (``--chaos``; round 9) extends the surface with the spec-§9
+fault schedules and hardens the instrument itself: every config runs in a
+*subprocess* with a wall timeout, one retry after exponential backoff, and a
+checkpoint written after each config — a hung or segfaulting backend costs
+one config (a skip-with-record), never the run, and an interrupted run
+resumes where it stopped. The child legs are numpy-vs-jax bit-match, the
+scalar-oracle subsample, and the spec-§1 safety invariants over the full
+per-replica state (models/invariants.py) — a violation is a hard
+artifact-recorded failure. The native core has no fault channel
+(``FaultsUnsupported``), so chaos drops the native leg by construction.
+
+One command reproduces each claim and stamps the artifact:
 
     python -m byzantinerandomizedconsensus_tpu.tools.soak --configs 120
+    python -m byzantinerandomizedconsensus_tpu.tools.soak --chaos --configs 200
 
-writes ``artifacts/soak_r{N}.json`` with the seed, the generator version, the
-per-family config tally and the mismatch list (empty = the claim). The
-reduced CI leg is tests/test_soak.py (a handful of configs, every delivery).
+The reduced CI legs live in tests/test_soak.py (a handful of configs, every
+delivery; a seeded chaos smoke with the subprocess leg; injected crash and
+hang drills proving the timeout → backoff → retry → skip-with-record path and
+the checkpoint resume).
 """
 
 from __future__ import annotations
@@ -24,25 +37,38 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
 import pathlib
 import random
+import subprocess
+import sys
+import time
 
 import numpy as np
 
 from byzantinerandomizedconsensus_tpu.backends import get_backend
-from byzantinerandomizedconsensus_tpu.config import DELIVERY_KINDS, SimConfig
+from byzantinerandomizedconsensus_tpu.config import (
+    DELIVERY_KINDS, FAULT_KINDS, SimConfig)
 from byzantinerandomizedconsensus_tpu.utils.rounds import default_artifact
 
 # Bumped whenever the draw sequence below changes shape: an artifact's config
-# population is reproducible only by (generator_version, seed) together.
+# population is reproducible only by (generator_version, seed) together —
+# plus the chaos flag: chaos appends fault-axis draws *after* the legacy
+# sequence, so non-chaos populations are unchanged since v1.
 GENERATOR_VERSION = 1
 
 MAX_SOAK_N = 40
+
+# Chaos-child defaults: one wall-clock budget per subprocess attempt and the
+# base of the exponential backoff before the single retry.
+CHAOS_TIMEOUT_S = 180.0
+CHAOS_BACKOFF_S = 0.5
 
 _PROTOCOLS = ("benor", "bracha")
 _ADVERSARIES = ("none", "crash", "byzantine", "adaptive", "adaptive_min")
 _COINS = ("local", "shared")
 _INITS = ("random", "all0", "all1", "split")
+_CHAOS_WINDOWS = (1, 2, 4, 8, 16)
 
 
 def _f_ceiling(protocol: str, adversary: str, n: int) -> int:
@@ -55,8 +81,14 @@ def _f_ceiling(protocol: str, adversary: str, n: int) -> int:
     return (n - 1) // 2
 
 
-def random_config(rng: random.Random) -> SimConfig:
-    """One uniform-ish draw over the supported semantic surface, n ≤ 40."""
+def random_config(rng: random.Random, chaos: bool = False) -> SimConfig:
+    """One uniform-ish draw over the supported semantic surface, n ≤ 40.
+
+    ``chaos`` appends the spec-§9 fault axis (all four kinds, "none"
+    included as the in-population baseline) and a crash_window draw covering
+    the window edges — appended *after* the legacy draws, so the non-chaos
+    population of a (generator_version, seed) pair never moves.
+    """
     while True:
         protocol = rng.choice(_PROTOCOLS)
         adversary = rng.choice(_ADVERSARIES)
@@ -66,7 +98,7 @@ def random_config(rng: random.Random) -> SimConfig:
             continue  # too small to host a faulty set; redraw
         f = rng.randrange(0, fmax + 1) if adversary == "none" \
             else rng.randrange(1, fmax + 1)
-        return SimConfig(
+        cfg = SimConfig(
             protocol=protocol, n=n, f=f,
             instances=rng.randrange(8, 33),
             adversary=adversary,
@@ -75,44 +107,149 @@ def random_config(rng: random.Random) -> SimConfig:
             seed=rng.randrange(1 << 32),
             round_cap=rng.choice((32, 64, 128)),
             delivery=rng.choice(DELIVERY_KINDS),
-        ).validate()
+        )
+        if chaos:
+            cfg = dataclasses.replace(
+                cfg, faults=rng.choice(FAULT_KINDS),
+                crash_window=rng.choice(_CHAOS_WINDOWS))
+        return cfg.validate()
+
+
+def _leg_summary(res) -> dict:
+    """Compact per-leg reproduction summary for a mismatch record."""
+    return {
+        "mean_rounds": float(res.rounds.mean()) if len(res.rounds) else 0.0,
+        "capped": int((res.decision == 2).sum()),
+        "rounds": res.rounds.tolist(),
+        "decision": res.decision.tolist(),
+    }
+
+
+def mismatch_record(cfg: SimConfig, leg: str, a, b,
+                    names=("a", "b")) -> dict:
+    """A mismatch record that reproduces standalone from the artifact: the
+    config, the first divergent instance index with both legs' values there,
+    and full per-leg (rounds, decision) summaries (instance counts here are
+    ≤ 32 by the generator, so the arrays are artifact-sized)."""
+    ra, rb = np.asarray(a.rounds), np.asarray(b.rounds)
+    da, db = np.asarray(a.decision), np.asarray(b.decision)
+    m = min(len(ra), len(rb))
+    diff = np.flatnonzero((ra[:m] != rb[:m]) | (da[:m] != db[:m]))
+    first = int(diff[0]) if len(diff) else None
+    rec = {
+        "config": dataclasses.asdict(cfg),
+        "leg": leg,
+        "n_differing": int(len(diff)),
+        "first_divergent_instance": first,
+    }
+    if first is not None:
+        rec["at_first_divergence"] = {
+            names[0]: {"rounds": int(ra[first]), "decision": int(da[first])},
+            names[1]: {"rounds": int(rb[first]), "decision": int(db[first])},
+        }
+    rec[names[0]] = _leg_summary(a)
+    rec[names[1]] = _leg_summary(b)
+    return rec
 
 
 def run_soak(n_configs: int, seed: int = 0, oracle_every: int = 10,
-             oracle_instances: int = 3, progress=print) -> dict:
+             oracle_instances: int = 3, progress=print, chaos: bool = False,
+             timeout_s: float = CHAOS_TIMEOUT_S,
+             backoff_s: float = CHAOS_BACKOFF_S,
+             checkpoint=None, inject=None) -> dict:
     """Run the differential; returns the artifact document (never raises on a
     mismatch — a soak must report every divergence it finds, not stop at the
-    first)."""
+    first).
+
+    Chaos mode (``chaos=True``) runs each config in a subprocess (wall
+    timeout ``timeout_s``, one retry after ``backoff_s``·2^attempt, then
+    skip-with-record) and resumes from ``checkpoint`` (a JSON path; written
+    after every config). ``inject`` maps config indices to "crash" | "hang"
+    — the deterministic failure drill the tier-1 tests use.
+    """
     rng = random.Random(seed)
     mismatches = []
+    violations = []
+    skipped = []
     by_delivery: dict[str, int] = {d: 0 for d in DELIVERY_KINDS}
     by_adversary: dict[str, int] = {a: 0 for a in _ADVERSARIES}
+    by_faults: dict[str, int] = {k: 0 for k in FAULT_KINDS}
     oracle_checked = 0
-    numpy_be = get_backend("numpy")
-    native_be = get_backend("native")
-    cpu_be = get_backend("cpu")
+    resumed = 0
+    records: dict[str, dict] = {}
+    ckpt_path = pathlib.Path(checkpoint) if checkpoint else None
+    if chaos and ckpt_path is not None:
+        records = _load_checkpoint(ckpt_path, seed)
+    if not chaos:
+        numpy_be = get_backend("numpy")
+        native_be = get_backend("native")
+        cpu_be = get_backend("cpu")
 
     for k in range(n_configs):
-        cfg = random_config(rng)
+        cfg = random_config(rng, chaos=chaos)
         by_delivery[cfg.delivery] += 1
         by_adversary[cfg.adversary] += 1
+        by_faults[cfg.faults] += 1
+        oracle_n = oracle_instances if k % max(1, oracle_every) == 0 else 0
+
+        if chaos:
+            prev = records.get(str(k))
+            if prev is not None and prev.get("status") != "skipped":
+                resumed += 1
+                rec = prev
+            else:
+                rec = _run_chaos_config(
+                    cfg, oracle_n, timeout_s=timeout_s, backoff_s=backoff_s,
+                    inject=(inject or {}).get(k))
+                rec["index"] = k
+                records[str(k)] = rec
+                if ckpt_path is not None:
+                    _save_checkpoint(ckpt_path, seed, records)
+            # Count only oracle legs that actually ran: the child stamps
+            # ``oracle_instances`` after its compare (so resumed records
+            # carry their own truth); a skip or a pre-oracle mismatch ran
+            # none.
+            if rec.get("oracle_instances"):
+                oracle_checked += 1
+            if rec["status"] == "skipped":
+                skipped.append(rec)
+                progress(f"soak[{k}]: SKIPPED after retry "
+                         f"({rec.get('error', '?')}) {cfg}")
+            elif rec["status"] == "mismatch":
+                mismatches.append(rec["mismatch"])
+                progress(f"soak[{k}]: MISMATCH {rec['mismatch']['leg']} {cfg}")
+            # A mismatch and a safety violation can share one root cause —
+            # record both, never shadow one with the other.
+            if rec.get("violations"):
+                violations.append({"index": k,
+                                   "config": dataclasses.asdict(cfg),
+                                   "violations": rec["violations"]})
+                progress(f"soak[{k}]: SAFETY VIOLATION {cfg}")
+            if (rec["status"] == "ok" and not rec.get("violations")
+                    and (k + 1) % 25 == 0):
+                progress(f"soak[{k + 1}/{n_configs}]: "
+                         f"{len(mismatches)} mismatches, "
+                         f"{len(violations)} violations so far")
+            continue
+
         a = numpy_be.run(cfg)
         b = native_be.run(cfg)
         ok = (np.array_equal(a.rounds, b.rounds)
               and np.array_equal(a.decision, b.decision))
         record = None
         if not ok:
-            record = {"config": dataclasses.asdict(cfg),
-                      "leg": "numpy_vs_native"}
-        elif k % max(1, oracle_every) == 0:
-            ids = np.arange(min(oracle_instances, cfg.instances),
-                            dtype=np.int64)
+            record = mismatch_record(cfg, "numpy_vs_native", a, b,
+                                     names=("numpy", "native"))
+        elif oracle_n:
+            ids = np.arange(min(oracle_n, cfg.instances), dtype=np.int64)
             c = cpu_be.run(cfg, ids)
             oracle_checked += 1
             if not (np.array_equal(a.rounds[: len(ids)], c.rounds)
                     and np.array_equal(a.decision[: len(ids)], c.decision)):
-                record = {"config": dataclasses.asdict(cfg),
-                          "leg": "numpy_vs_oracle"}
+                sub = dataclasses.replace(a)
+                sub.rounds, sub.decision = a.rounds[: len(ids)], a.decision[: len(ids)]
+                record = mismatch_record(cfg, "numpy_vs_oracle", sub, c,
+                                         names=("numpy", "oracle"))
         if record is not None:
             mismatches.append(record)
             progress(f"soak[{k}]: MISMATCH {record['leg']} {cfg}")
@@ -121,12 +258,18 @@ def run_soak(n_configs: int, seed: int = 0, oracle_every: int = 10,
 
     from byzantinerandomizedconsensus_tpu.obs import record
 
-    return {
+    doc = {
         **record.new_record("soak"),
-        "description": "randomized numpy-vs-native differential with a scalar"
-                       "-oracle subsample (tools/soak.py; VERDICT r5 next #3)",
+        "description": ("randomized chaos soak: subprocess-isolated "
+                        "numpy-vs-jax differential under spec-§9 fault "
+                        "schedules, with safety invariants and a scalar-"
+                        "oracle subsample (tools/soak.py --chaos)" if chaos
+                        else "randomized numpy-vs-native differential with a "
+                        "scalar-oracle subsample (tools/soak.py; VERDICT r5 "
+                        "next #3)"),
         "generator_version": GENERATOR_VERSION,
         "seed": seed,
+        "chaos": chaos,
         "configs": n_configs,
         "oracle_subsampled_configs": oracle_checked,
         "oracle_instances_per_check": oracle_instances,
@@ -134,6 +277,131 @@ def run_soak(n_configs: int, seed: int = 0, oracle_every: int = 10,
         "by_adversary": by_adversary,
         "mismatches": mismatches,
     }
+    if chaos:
+        doc.update(
+            by_faults=by_faults,
+            timeout_s=timeout_s,
+            resumed_configs=resumed,
+            skipped=skipped,
+            violations=violations,
+            safety_checked_instances=sum(
+                r.get("checked_instances", 0) for r in records.values()),
+        )
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# chaos mode: subprocess child, timeout/retry, checkpoint
+
+
+def _load_checkpoint(path: pathlib.Path, seed: int) -> dict:
+    """Per-config records of a prior run, or {} when absent/mismatched. A
+    checkpoint binds to (generator_version, seed, chaos) — a different
+    population must never be resumed into."""
+    try:
+        doc = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return {}
+    if (doc.get("generator_version") != GENERATOR_VERSION
+            or doc.get("seed") != seed or not doc.get("chaos")):
+        return {}
+    done = doc.get("done")
+    return dict(done) if isinstance(done, dict) else {}
+
+
+def _save_checkpoint(path: pathlib.Path, seed: int, records: dict) -> None:
+    """Atomic rewrite (tmp + replace): a kill mid-write must leave either
+    the old checkpoint or the new one, never a torn file."""
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    tmp.write_text(json.dumps({
+        "generator_version": GENERATOR_VERSION, "seed": seed, "chaos": True,
+        "done": records}, indent=1) + "\n")
+    os.replace(tmp, path)
+
+
+def _run_chaos_config(cfg: SimConfig, oracle_n: int, timeout_s: float,
+                      backoff_s: float, inject=None) -> dict:
+    """One config in a subprocess: wall timeout, one retry with exponential
+    backoff, then an honest skip-with-record. Returns the per-config record
+    (status ok | mismatch | skipped, plus the child's payload)."""
+    cmd = [sys.executable, "-m", "byzantinerandomizedconsensus_tpu.tools.soak",
+           "--child-config", json.dumps(dataclasses.asdict(cfg)),
+           "--child-oracle", str(oracle_n)]
+    if inject:
+        cmd += ["--inject", inject]
+    errors = []
+    for attempt in range(2):
+        if attempt:
+            time.sleep(backoff_s * (2 ** (attempt - 1)))
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            errors.append(f"attempt {attempt}: timeout after {timeout_s}s")
+            continue
+        if proc.returncode != 0:
+            errors.append(f"attempt {attempt}: exit {proc.returncode} "
+                          f"({(proc.stderr or '').strip()[-200:]})")
+            continue
+        try:
+            payload = json.loads(proc.stdout.strip().splitlines()[-1])
+        except (ValueError, IndexError):
+            errors.append(f"attempt {attempt}: unparseable child output "
+                          f"({proc.stdout[-200:]!r})")
+            continue
+        payload["attempts"] = attempt + 1
+        return payload
+    return {"status": "skipped", "config": dataclasses.asdict(cfg),
+            "attempts": 2, "error": "; ".join(errors)}
+
+
+def run_child(cfg_dict: dict, oracle_n: int, inject=None) -> dict:
+    """The chaos-soak subprocess body: numpy (full state + §1 safety
+    invariants) vs jax bit-match, plus the scalar-oracle subsample. Prints
+    nothing — returns the record; main() emits it as one JSON line."""
+    if inject == "crash":
+        os._exit(139)  # simulate a native SIGSEGV death
+    if inject == "hang":
+        time.sleep(3600)
+    cfg = SimConfig(**cfg_dict).validate()
+    from byzantinerandomizedconsensus_tpu.models import invariants
+    from byzantinerandomizedconsensus_tpu.utils.devices import (
+        ensure_live_backend)
+
+    numpy_be = get_backend("numpy")
+    res, state, faulty = numpy_be.run_with_state(cfg)
+    viol = invariants.state_violations(cfg, state, faulty, res=res,
+                                       inst_ids=res.inst_ids)
+    rec = {
+        "status": "ok",
+        "config": cfg_dict,
+        "checked_instances": int(len(res.inst_ids)),
+        "violations": viol,
+        "mean_rounds": float(res.rounds.mean()),
+        "capped": int((res.decision == 2).sum()),
+    }
+    ensure_live_backend()  # never hang the child on a dead TPU tunnel
+    jres = get_backend("jax").run(cfg)
+    if not (np.array_equal(res.rounds, jres.rounds)
+            and np.array_equal(res.decision, jres.decision)):
+        rec["status"] = "mismatch"
+        rec["mismatch"] = mismatch_record(cfg, "numpy_vs_jax", res, jres,
+                                          names=("numpy", "jax"))
+        return rec
+    if oracle_n > 0:
+        ids = np.arange(min(oracle_n, cfg.instances), dtype=np.int64)
+        ores = get_backend("cpu").run(cfg, ids)
+        rec["oracle_instances"] = int(len(ids))
+        if not (np.array_equal(res.rounds[: len(ids)], ores.rounds)
+                and np.array_equal(res.decision[: len(ids)], ores.decision)):
+            sub = dataclasses.replace(res)
+            sub.rounds = res.rounds[: len(ids)]
+            sub.decision = res.decision[: len(ids)]
+            rec["status"] = "mismatch"
+            rec["mismatch"] = mismatch_record(cfg, "numpy_vs_oracle", sub,
+                                              ores, names=("numpy", "oracle"))
+    return rec
 
 
 def main(argv=None) -> int:
@@ -143,18 +411,62 @@ def main(argv=None) -> int:
     ap.add_argument("--oracle-every", type=int, default=10,
                     help="every k-th config also runs an oracle subsample")
     ap.add_argument("--oracle-instances", type=int, default=3)
-    ap.add_argument("--out", default=default_artifact("soak"))
+    ap.add_argument("--chaos", action="store_true",
+                    help="chaos mode: random spec-§9 fault schedules, each "
+                         "config subprocess-isolated (timeout → backoff → "
+                         "retry → skip-with-record) with checkpoint resume "
+                         "and the §1 safety-invariant checker")
+    ap.add_argument("--timeout", type=float, default=CHAOS_TIMEOUT_S,
+                    help="chaos: wall seconds per subprocess attempt")
+    ap.add_argument("--backoff", type=float, default=CHAOS_BACKOFF_S,
+                    help="chaos: base of the exponential retry backoff")
+    ap.add_argument("--checkpoint", default=None,
+                    help="chaos: checkpoint JSON path (default: OUT.ckpt)")
+    ap.add_argument("--liveness", action="store_true",
+                    help="chaos: embed the spec-§9 liveness-degradation rows "
+                         "(tools/divergence.py fault leg) in the artifact")
+    ap.add_argument("--out", default=None)
+    # Internal chaos-child flags (parent-spawned subprocess protocol).
+    ap.add_argument("--child-config", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--child-oracle", type=int, default=0,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--inject", choices=("crash", "hang"), default=None,
+                    help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
 
+    if args.child_config is not None:
+        rec = run_child(json.loads(args.child_config), args.child_oracle,
+                        inject=args.inject)
+        print(json.dumps(rec))
+        return 0
+
+    out = pathlib.Path(args.out if args.out is not None
+                       else default_artifact("chaos" if args.chaos
+                                             else "soak"))
+    checkpoint = args.checkpoint
+    if args.chaos and checkpoint is None:
+        checkpoint = str(out) + ".ckpt"
     doc = run_soak(args.configs, seed=args.seed,
                    oracle_every=args.oracle_every,
-                   oracle_instances=args.oracle_instances)
-    out = pathlib.Path(args.out)
+                   oracle_instances=args.oracle_instances,
+                   chaos=args.chaos, timeout_s=args.timeout,
+                   backoff_s=args.backoff, checkpoint=checkpoint)
+    if args.chaos and args.liveness:
+        from byzantinerandomizedconsensus_tpu.tools import divergence
+
+        rows = divergence.run_fault_rows(progress=lambda *a: None)
+        doc["liveness"] = {"rows": rows,
+                           "summary": divergence.fault_rows_summary(rows)}
     out.parent.mkdir(parents=True, exist_ok=True)
     out.write_text(json.dumps(doc, indent=1) + "\n")
-    print(json.dumps({"out": str(out),
-                      "mismatches": len(doc["mismatches"])}))
-    return 1 if doc["mismatches"] else 0
+    summary = {"out": str(out), "mismatches": len(doc["mismatches"])}
+    if args.chaos:
+        summary.update(violations=len(doc["violations"]),
+                       skipped=len(doc["skipped"]),
+                       resumed=doc["resumed_configs"])
+    print(json.dumps(summary))
+    return 1 if (doc["mismatches"] or doc.get("violations")
+                 or doc.get("skipped")) else 0
 
 
 if __name__ == "__main__":

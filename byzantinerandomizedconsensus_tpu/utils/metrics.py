@@ -49,6 +49,7 @@ def summary(res: SimResult, walls=None, device=None) -> dict:
         "adversary": res.config.adversary,
         "coin": res.config.coin,
         "delivery": res.config.delivery,
+        "faults": res.config.faults,
         "seed": res.config.seed,
         "instances": n_inst,
         "decided": int(decided.sum()),

@@ -129,3 +129,21 @@ def test_binary_alphabet_adaptive_counts_model_invariant():
     expect1 = np.where(pref[None, :] == 1, c_pref, c_anti)
     np.testing.assert_array_equal(k0, expect0)
     np.testing.assert_array_equal(k1, expect1)
+
+
+def test_fault_liveness_row_shape():
+    """The spec-§9 liveness leg: one config, fault-free baseline vs every
+    fault kind — rows carry the TV distance and outcome stats per kind, and
+    the summary reduces over them."""
+    from byzantinerandomizedconsensus_tpu.tools.divergence import (
+        FAULT_GRID, FAULT_KINDS_MEASURED, fault_row, fault_rows_summary)
+
+    row = fault_row(FAULT_GRID[0], instances=60, backend="numpy")
+    for kind in FAULT_KINDS_MEASURED:
+        assert 0.0 <= row[f"rounds_hist_tv_{kind}"] <= 1.0
+        assert row[f"mean_rounds_{kind}"] >= 1.0
+        assert 0.0 <= row[f"capped_{kind}"] <= 1.0
+    s = fault_rows_summary([row])
+    for kind in FAULT_KINDS_MEASURED:
+        assert s[f"fault_max_rounds_hist_tv_{kind}"] == \
+            row[f"rounds_hist_tv_{kind}"]

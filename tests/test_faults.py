@@ -1,0 +1,218 @@
+"""Fault-schedule axis (spec §9): composition grid, edge cases, gates.
+
+The heart is the three-stack bit-match over the full composition grid —
+all 4 fault kinds × {none, crash, byzantine} × all 4 delivery laws — plus
+the §1 safety invariants over every cell, the recover-rejoin edge (outage
+opening at round 0 and healing at/after round_cap), the crash_window
+validation satellite, and the honest FaultsUnsupported gates.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from byzantinerandomizedconsensus_tpu.backends import get_backend
+from byzantinerandomizedconsensus_tpu.config import (
+    DELIVERY_KINDS, FAULT_KINDS, SimConfig)
+from byzantinerandomizedconsensus_tpu.core.faults import FaultSchedule
+from byzantinerandomizedconsensus_tpu.models import faults as mfaults
+from byzantinerandomizedconsensus_tpu.models import invariants
+from byzantinerandomizedconsensus_tpu.models.adversaries import AdversaryModel
+from byzantinerandomizedconsensus_tpu.models.faults import FaultsUnsupported
+
+# One protocol pairing per adversary: benign/crash run Ben-Or (protocol A),
+# byzantine runs Bracha (the n > 3f benchmark pairing, spec §5.2).
+_ADV_PROTO = (("none", "benor"), ("crash", "benor"), ("byzantine", "bracha"))
+
+
+def _cfg(adv, proto, delivery, fault, **kw):
+    base = dict(protocol=proto, n=7, f=2, instances=4, adversary=adv,
+                coin="local", seed=13, round_cap=32, delivery=delivery,
+                faults=fault)
+    base.update(kw)
+    return SimConfig(**base).validate()
+
+
+@pytest.mark.parametrize("delivery", DELIVERY_KINDS)
+@pytest.mark.parametrize("adv,proto", _ADV_PROTO)
+@pytest.mark.parametrize("fault", FAULT_KINDS)
+def test_fault_grid_oracle_numpy_bitmatch(fault, adv, proto, delivery):
+    """The full 4 × 3 × 4 composition grid, oracle vs numpy, with the §1
+    safety invariants over the full per-replica state for every cell."""
+    cfg = _cfg(adv, proto, delivery, fault)
+    a = get_backend("numpy").run(cfg)
+    b = get_backend("cpu").run(cfg)
+    np.testing.assert_array_equal(a.rounds, b.rounds)
+    np.testing.assert_array_equal(a.decision, b.decision)
+    assert invariants.check_config(cfg)["violations"] == []
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("delivery", DELIVERY_KINDS)
+@pytest.mark.parametrize("adv,proto", _ADV_PROTO)
+@pytest.mark.parametrize("fault", FAULT_KINDS)
+def test_fault_grid_jax_bitmatch_full(fault, adv, proto, delivery):
+    """The same full grid against the jit'd jax stack — 48 distinct compiled
+    programs, so the exhaustive sweep is marked slow (still run by default;
+    the tier-1 budget gets the covering sample below)."""
+    cfg = _cfg(adv, proto, delivery, fault)
+    a = get_backend("numpy").run(cfg)
+    c = get_backend("jax").run(cfg)
+    np.testing.assert_array_equal(a.rounds, c.rounds)
+    np.testing.assert_array_equal(a.decision, c.decision)
+
+
+def test_fault_grid_jax_bitmatch_tier1_sample():
+    """Tier-1 jax leg: every (fault, delivery) pair once, rotating through
+    the adversary pairings — 16 cells covering all three axes' values."""
+    for i, fault in enumerate(FAULT_KINDS):
+        for j, delivery in enumerate(DELIVERY_KINDS):
+            adv, proto = _ADV_PROTO[(i + j) % len(_ADV_PROTO)]
+            cfg = _cfg(adv, proto, delivery, fault)
+            a = get_backend("numpy").run(cfg)
+            c = get_backend("jax").run(cfg)
+            np.testing.assert_array_equal(a.rounds, c.rounds)
+            np.testing.assert_array_equal(a.decision, c.decision)
+
+
+def test_faults_none_is_the_frozen_fast_path():
+    """faults="none" must not even build fault state — the setup carries
+    None, so compiled programs and draws are untouched by construction."""
+    cfg = _cfg("crash", "benor", "urn2", "none")
+    setup = AdversaryModel(cfg).setup(cfg.seed, np.arange(4), xp=np)
+    assert setup["faults"] is None
+    fsil, fside = mfaults.round_masks(cfg, cfg.seed, np.arange(4), 0,
+                                      setup["faults"], xp=np)
+    assert fsil is None and fside is None
+
+
+def test_fault_prone_set_coincides_with_adversary_faulty():
+    """With an active adversary the §9 fault-prone set IS the §3.2 faulty
+    set (same PRF purpose), so composed misbehavior never exceeds f."""
+    cfg = _cfg("crash", "benor", "urn2", "recover", instances=8)
+    ids = np.arange(8)
+    setup = AdversaryModel(cfg).setup(cfg.seed, ids, xp=np)
+    np.testing.assert_array_equal(setup["faults"]["fprone"], setup["faulty"])
+
+
+def test_partition_isolates_only_fault_prone_replicas():
+    cfg = _cfg("none", "benor", "urn2", "partition", instances=16)
+    ids = np.arange(16)
+    fsetup = mfaults.setup_faults(cfg, cfg.seed, ids, xp=np)
+    assert ((fsetup["side"] == 1) <= fsetup["fprone"]).all()
+    # The per-round plane is zero outside the epoch and ⊆ side inside it.
+    for r in range(cfg.round_cap):
+        _, fside = mfaults.round_masks(cfg, cfg.seed, ids, r, fsetup, xp=np)
+        active = ((r >= fsetup["part_start"])
+                  & (r < fsetup["part_heal"]))[:, None]
+        np.testing.assert_array_equal(
+            fside, np.where(active, fsetup["side"], 0).astype(np.uint8))
+
+
+def test_scalar_and_vectorized_masks_agree():
+    """core/faults.py (oracle) and models/faults.py (vectorized) must emit
+    bit-identical per-round masks for every kind."""
+    for fault in ("recover", "partition", "omission"):
+        cfg = _cfg("crash", "benor", "urn2", fault, instances=6,
+                   crash_window=8)
+        ids = np.arange(6)
+        fsetup = mfaults.setup_faults(cfg, cfg.seed, ids, xp=np)
+        for i in range(6):
+            fs = FaultSchedule(cfg, cfg.seed, i)
+            for r in range(cfg.round_cap):
+                vsil, vside = mfaults.round_masks(cfg, cfg.seed, ids, r,
+                                                  fsetup, xp=np)
+                osil, oside = fs.round_masks(r)
+                if vsil is None:
+                    assert osil is None or not osil.any()
+                else:
+                    np.testing.assert_array_equal(vsil[i], osil)
+                if vside is not None:
+                    want = oside if oside is not None \
+                        else np.zeros(cfg.n, dtype=np.uint8)
+                    np.testing.assert_array_equal(vside[i], want)
+
+
+def test_recover_rejoin_edge_crash_at_0_heal_at_round_cap():
+    """The edge schedule: an outage opening at round 0 whose heal lands at or
+    past round_cap — the replica is silent for the entire run and 'rejoins'
+    exactly at the simulation edge. Found by a deterministic seed scan, then
+    run through all three stacks + the safety checker."""
+    cap, w = 8, 16
+    hit = None
+    for seed in range(500):
+        cfg = SimConfig(protocol="benor", n=7, f=2, instances=1,
+                        adversary="none", seed=seed, round_cap=cap,
+                        crash_window=w, delivery="urn2",
+                        faults="recover").validate()
+        fs = FaultSchedule(cfg, seed, 0)
+        m = fs.fprone & (fs.down_at == 0) & (fs.up_at >= cap)
+        if m.any():
+            hit = (cfg, fs, int(np.argmax(m)))
+            break
+    assert hit is not None, "no edge schedule within the scanned seed range"
+    cfg, fs, j = hit
+    fsetup = mfaults.setup_faults(cfg, cfg.seed, np.arange(1), xp=np)
+    for r in range(cap):
+        fsil, _ = mfaults.round_masks(cfg, cfg.seed, np.arange(1), r,
+                                      fsetup, xp=np)
+        assert fsil[0, j], f"edge replica spoke at round {r}"
+        np.testing.assert_array_equal(fsil[0], fs.round_masks(r)[0])
+    a = get_backend("numpy").run(cfg)
+    b = get_backend("cpu").run(cfg)
+    np.testing.assert_array_equal(a.rounds, b.rounds)
+    np.testing.assert_array_equal(a.decision, b.decision)
+    assert invariants.check_config(cfg)["violations"] == []
+
+
+def test_crash_window_validation_message():
+    """Satellite: crash_window < 1 used to reach ``% crash_window`` and yield
+    silent numpy garbage; it must be a config error with a pinned message."""
+    for bad in (0, -3):
+        with pytest.raises(ValueError, match=rf"crash_window={bad} out of "
+                                             r"range \(>= 1\)"):
+            SimConfig(adversary="crash", crash_window=bad).validate()
+    # Window 1 is the smallest valid schedule scale.
+    SimConfig(adversary="crash", crash_window=1).validate()
+
+
+def test_unknown_faults_rejected():
+    with pytest.raises(ValueError, match="unknown faults"):
+        SimConfig(faults="meteor").validate()
+
+
+def test_faults_unsupported_gates():
+    cfg = _cfg("none", "benor", "urn", "recover")
+    with pytest.raises(FaultsUnsupported):
+        get_backend("jax_pallas").run(cfg)
+    import shutil
+    if shutil.which("g++"):
+        with pytest.raises(FaultsUnsupported):
+            get_backend("native").run(cfg)
+
+
+def test_virtual_mesh_supports_faults():
+    """The host-side SPMD mesh shares the round bodies through the same
+    recv_ids seams, so the fault axis rides along — pinned here so a future
+    refactor cannot silently drop it."""
+    cfg = SimConfig(protocol="bracha", n=8, f=2, instances=10,
+                    adversary="crash", seed=4, round_cap=48,
+                    delivery="urn2", faults="partition").validate()
+    a = get_backend("numpy").run(cfg)
+    v = get_backend("virtual:2x2").run(cfg)
+    np.testing.assert_array_equal(a.rounds, v.rounds)
+    np.testing.assert_array_equal(a.decision, v.decision)
+
+
+def test_liveness_degrades_but_safety_holds():
+    """The §9 schedules must cost rounds, not correctness: under recover the
+    mean rounds-to-decision may only move, never the invariants."""
+    base = SimConfig(protocol="benor", n=9, f=4, instances=64,
+                     adversary="none", seed=2, round_cap=96,
+                     delivery="urn2").validate()
+    r0 = get_backend("numpy").run(base)
+    for fault in ("recover", "partition", "omission"):
+        cfg = dataclasses.replace(base, faults=fault)
+        assert invariants.check_config(cfg)["violations"] == []
+    assert (r0.decision != 2).any()

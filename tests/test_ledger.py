@@ -111,3 +111,24 @@ def test_ledger_json_out(tmp_path, capsys):
     doc = json.loads(out.read_text())
     assert doc["kind"] == "ledger" and doc["parse_errors"] == []
     capsys.readouterr()
+
+
+def test_census_includes_chaos_artifact():
+    """The round-9 chaos artifact is part of the committed census: it must
+    be scanned, parse cleanly, and carry zero mismatches/violations."""
+    doc = ledger.build_ledger()
+    assert doc["parse_errors"] == []
+    ev = doc["artifact_round_evidence"]
+    assert "9" in ev and "artifacts/chaos_r9.json" in ev["9"]["artifacts"]
+
+    import pathlib
+
+    from byzantinerandomizedconsensus_tpu.utils.rounds import repo_root
+
+    chaos = json.loads(
+        (pathlib.Path(repo_root()) / "artifacts/chaos_r9.json").read_text())
+    assert chaos["kind"] == "soak" and chaos["chaos"] is True
+    assert chaos["mismatches"] == []
+    assert chaos["violations"] == []
+    assert chaos["configs"] >= 200
+    assert record.validate_record(chaos) == []

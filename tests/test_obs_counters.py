@@ -157,3 +157,54 @@ def test_accumulator_respects_activity_mask():
     acc = obs_counters.accumulate(acc, inc, np.array([True, False]), cfg, np)
     totals = obs_counters.finalize(cfg, acc)
     assert totals["rounds_active"] == 7  # only the active instance counted
+
+
+FAULT_KINDS_ACTIVE = ("recover", "partition", "omission")
+
+
+@pytest.mark.parametrize("fault", FAULT_KINDS_ACTIVE)
+def test_fault_counters_invariant_and_cross_stack(fault):
+    """Schema v2 (spec §9): fault-attributed counters are a pure side output
+    (results bit-identical with counters on), numpy == jax totals, and the
+    message-level subset — including the fault counters and the
+    partition-aware dropped law — equals the oracle's independent count."""
+    nb, jb, cb = get_backend("numpy"), get_backend("jax"), get_backend("cpu")
+    for delivery in ("keys", "urn2"):
+        cfg = SimConfig(protocol="bracha", n=8, f=2, instances=6,
+                        adversary="crash", coin="local", seed=9,
+                        round_cap=48, delivery=delivery,
+                        faults=fault).validate()
+        base = nb.run(cfg)
+        res_n, doc_n = nb.run_with_counters(cfg)
+        assert _eq(base, res_n), "counters moved the results under faults"
+        res_j, doc_j = jb.run_with_counters(cfg)
+        assert _eq(base, res_j)
+        assert doc_n["totals"] == doc_j["totals"]
+        res_c, doc_c = cb.run_with_counters(cfg)
+        assert _eq(base, res_c)
+        common = {k: v for k, v in doc_n["totals"].items()
+                  if k in doc_c["totals"]}
+        assert common == doc_c["totals"], (fault, delivery)
+        # The v2 fault columns exist for every phase...
+        phases = obs_counters.phase_names(cfg)
+        for ph in phases:
+            assert f"fault_silenced@{ph}" in doc_n["totals"]
+            assert f"fault_cut_pairs@{ph}" in doc_n["totals"]
+        # ...and attribute the right mechanism: silences for recover and
+        # omission, cut pairs only for partition.
+        sil = sum(doc_n["totals"][f"fault_silenced@{ph}"] for ph in phases)
+        cut = sum(doc_n["totals"][f"fault_cut_pairs@{ph}"] for ph in phases)
+        if fault == "partition":
+            assert sil == 0
+        else:
+            assert cut == 0
+
+
+def test_fault_counters_absent_without_fault_schedule():
+    """faults="none" keeps the exact v1 column set — schema v2 adds columns
+    only when a schedule is configured."""
+    cfg = SimConfig(protocol="benor", n=7, f=2, instances=4,
+                    adversary="crash", round_cap=32,
+                    delivery="urn2").validate()
+    assert not any(n.startswith("fault_")
+                   for n in obs_counters.counter_names(cfg))

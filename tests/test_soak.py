@@ -1,9 +1,12 @@
 """Reduced CI leg of the randomized differential soak (tools/soak.py).
 
-The committed artifact (artifacts/soak_r7.json) is the full run; this keeps
-the instrument itself honest on every suite run: the generator only emits
-valid configs covering all four delivery models, and a small soak finds zero
-numpy-vs-native mismatches with the oracle subsample on.
+The committed artifacts (artifacts/soak_r7.json, artifacts/chaos_r9.json)
+are the full runs; this keeps the instrument itself honest on every suite
+run: the generator only emits valid configs covering all four delivery
+models, a small soak finds zero numpy-vs-native mismatches with the oracle
+subsample on, a seeded chaos smoke (subprocess leg included) finds zero
+mismatches/violations, and the injected crash/hang drills prove the
+timeout → backoff → retry → skip-with-record path plus checkpoint resume.
 """
 
 import random
@@ -14,8 +17,10 @@ import pytest
 from byzantinerandomizedconsensus_tpu.config import DELIVERY_KINDS
 from byzantinerandomizedconsensus_tpu.tools import soak
 
-pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
-                                reason="no C++ toolchain")
+# Chaos mode has no native leg (FaultsUnsupported by design); only the
+# classic numpy-vs-native legs need the toolchain.
+needs_gxx = pytest.mark.skipif(shutil.which("g++") is None,
+                               reason="no C++ toolchain")
 
 
 def test_generator_emits_valid_configs_all_deliveries():
@@ -25,10 +30,24 @@ def test_generator_emits_valid_configs_all_deliveries():
         cfg = soak.random_config(rng)          # .validate() runs inside
         assert cfg.n <= soak.MAX_SOAK_N
         assert cfg.pack_version == 1           # soak stays on the v1 side
+        assert cfg.faults == "none"            # legacy population unchanged
         seen.add(cfg.delivery)
     assert seen == set(DELIVERY_KINDS)
 
 
+def test_chaos_generator_covers_fault_axis():
+    from byzantinerandomizedconsensus_tpu.config import FAULT_KINDS
+
+    rng = random.Random(7)
+    seen = set()
+    for _ in range(80):
+        cfg = soak.random_config(rng, chaos=True)
+        assert cfg.crash_window >= 1
+        seen.add(cfg.faults)
+    assert seen == set(FAULT_KINDS)
+
+
+@needs_gxx
 def test_small_soak_zero_mismatches():
     doc = soak.run_soak(8, seed=123, oracle_every=4, oracle_instances=2,
                         progress=lambda *a: None)
@@ -37,9 +56,12 @@ def test_small_soak_zero_mismatches():
     assert doc["mismatches"] == []
 
 
+@needs_gxx
 def test_soak_reports_mismatch_instead_of_raising(monkeypatch):
     """A soak that stops at the first divergence (or asserts) is useless as an
-    instrument — it must record and keep going."""
+    instrument — it must record and keep going. The records must reproduce
+    standalone: first divergent instance index + per-leg (rounds, decision)
+    summaries, not just the config dict."""
     import numpy as np
 
     from byzantinerandomizedconsensus_tpu.backends import get_backend
@@ -60,3 +82,75 @@ def test_soak_reports_mismatch_instead_of_raising(monkeypatch):
                         progress=lambda *a: None)
     assert len(doc["mismatches"]) == 3
     assert all(m["leg"] == "numpy_vs_native" for m in doc["mismatches"])
+    for m in doc["mismatches"]:
+        assert m["first_divergent_instance"] == 0
+        assert m["n_differing"] >= 1
+        at = m["at_first_divergence"]
+        assert at["native"]["rounds"] == at["numpy"]["rounds"] + 1
+        for leg in ("numpy", "native"):
+            assert len(m[leg]["rounds"]) == m["config"]["instances"]
+            assert len(m[leg]["decision"]) == m["config"]["instances"]
+
+
+def test_chaos_smoke_subprocess_leg(tmp_path):
+    """The deterministic tier-1 chaos smoke: 8 seeded configs, each run in a
+    real subprocess (numpy-vs-jax + oracle subsample + safety invariants) —
+    zero mismatches, zero violations, zero skips."""
+    doc = soak.run_soak(8, seed=123, oracle_every=4, oracle_instances=2,
+                        chaos=True, timeout_s=600,
+                        checkpoint=str(tmp_path / "ck.json"),
+                        progress=lambda *a: None)
+    assert doc["configs"] == 8
+    assert doc["chaos"] is True
+    assert doc["mismatches"] == []
+    assert doc["violations"] == []
+    assert doc["skipped"] == []
+    assert doc["oracle_subsampled_configs"] == 2
+    assert doc["safety_checked_instances"] > 0
+    assert sum(doc["by_faults"].values()) == 8
+    assert sum(1 for k, v in doc["by_faults"].items()
+               if k != "none" and v) >= 2  # fault kinds actually exercised
+
+
+def test_chaos_survives_crash_and_hang_and_resumes(tmp_path):
+    """The acceptance drill: an injected subprocess crash AND an injected
+    hang each go timeout → backoff → retry → skip-with-record (the run
+    completes); a later run resumes from the checkpoint, retrying exactly
+    the skipped configs, and a third run loads everything from checkpoint."""
+    ck = str(tmp_path / "ck.json")
+    doc = soak.run_soak(2, seed=7, oracle_every=100, chaos=True,
+                        timeout_s=8, backoff_s=0.05, checkpoint=ck,
+                        inject={0: "crash", 1: "hang"},
+                        progress=lambda *a: None)
+    assert len(doc["skipped"]) == 2
+    assert all(s["attempts"] == 2 for s in doc["skipped"])
+    errs = " ".join(s["error"] for s in doc["skipped"])
+    assert "exit 139" in errs and "timeout" in errs
+    assert doc["mismatches"] == [] and doc["violations"] == []
+
+    # Resume: the two skipped configs are retried (now uninjected) and pass.
+    doc2 = soak.run_soak(2, seed=7, oracle_every=100, chaos=True,
+                         timeout_s=600, backoff_s=0.05, checkpoint=ck,
+                         progress=lambda *a: None)
+    assert doc2["resumed_configs"] == 0
+    assert doc2["skipped"] == [] and doc2["mismatches"] == []
+
+    # And a third run restores every record straight from the checkpoint.
+    doc3 = soak.run_soak(2, seed=7, oracle_every=100, chaos=True,
+                         timeout_s=600, checkpoint=ck,
+                         progress=lambda *a: None)
+    assert doc3["resumed_configs"] == 2
+    assert doc3["skipped"] == [] and doc3["mismatches"] == []
+
+
+def test_chaos_checkpoint_rejects_other_population(tmp_path):
+    """A checkpoint binds to (generator_version, seed, chaos): resuming a
+    different seed must start fresh, not splice foreign records in."""
+    import pathlib
+
+    ck = pathlib.Path(tmp_path / "ck.json")
+    soak._save_checkpoint(ck, seed=1, records={"0": {"status": "ok"}})
+    assert soak._load_checkpoint(ck, seed=1) == {"0": {"status": "ok"}}
+    assert soak._load_checkpoint(ck, seed=2) == {}
+    ck.write_text("{ torn")
+    assert soak._load_checkpoint(ck, seed=1) == {}
