@@ -1,0 +1,761 @@
+"""Config-batched execution — shape-bucketed compile cache + vmapped lanes
+(docs/PERF.md round 10).
+
+The single-config hot path compiles one XLA program per ``SimConfig`` because
+the config is baked into the jit closure. That is the right trade for the
+benchmark presets (hours of instances amortize one compile), and exactly the
+wrong one for the *fleet* paths — soak, chaos, divergence, acceptance,
+cost-curve grids — where hundreds of small-n configs each pay a full
+retrace + recompile that dwarfs their simulation time. This module splits a
+config the way a serving stack splits a request:
+
+- the **shape bucket** (:class:`ShapeBucket`): everything that determines the
+  compiled program's *structure* — n padded to the next supported tier,
+  round_cap, delivery law, adversary kind, coin, init, protocol, fault kind,
+  counters on/off, spec §2 packing version. One compiled program per bucket.
+- the **lane parameters**: everything that only enters the *arithmetic* — f,
+  the PRF key, crash_window, and the lane's real n (``n_eff``) — passed as
+  device operands and ``vmap``-ed over a lane axis, so many configs of one
+  bucket ride one dispatch.
+
+Bit-match is the acceptance bar: a lane's (rounds, decision) arrays are
+bit-identical to the per-config path (tests/test_batch.py asserts it across
+the fault × adversary × delivery grid). Two mechanisms make that hold:
+
+- the PRF addresses randomness by *coordinates* (spec §2), so a lane's draws
+  do not depend on which program evaluates them — the lane key is data;
+- lanes whose n is below the bucket tier mark their padding replicas silent
+  (``_PadAdversary``) and faulty-for-termination, force their §3.2 rank keys
+  past every real key, and read every value-of-n law through ``cfg.n_eff``
+  (quorums, drop totals, receiver classes) — so padding replicas neither
+  send, count, nor gate termination, exactly as if they did not exist.
+
+The compiled programs live in a **bounded LRU** (:class:`CompileCache`) keyed
+by (bucket, lane-tier, chunk), with compile/hit/eviction counters surfaced in
+run records (obs/record.py schema v1.1) and reconstructed by ``brc-tpu
+ledger``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from collections import OrderedDict
+from functools import partial
+from typing import Optional, Sequence
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu.config import SimConfig, validate_batch
+from byzantinerandomizedconsensus_tpu.models.adversaries import AdversaryModel
+from byzantinerandomizedconsensus_tpu.ops import prf
+
+# Supported n tiers: a lane's n is padded up to the next tier so that nearby
+# sizes share one compiled program. Powers of two from the smallest legal
+# quorum shape to the spec §2 v2 ceiling.
+N_TIERS = (4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+# Environment knob for the opt-in persistent XLA compilation cache (see
+# :func:`enable_persistent_compilation_cache`): retries, resumes and chaos
+# workers then start warm across *processes*, not just within one.
+COMPILE_CACHE_ENV = "BRC_COMPILATION_CACHE"
+
+
+def n_tier(n: int) -> int:
+    """The bucket shape tier for a config of size n (next tier ≥ n)."""
+    for t in N_TIERS:
+        if n <= t:
+            return t
+    raise ValueError(f"n={n} exceeds the largest supported tier {N_TIERS[-1]}")
+
+
+def lane_tier(lanes: int) -> int:
+    """Lane-axis padding: next power of two ≥ lanes, so repeated batch calls
+    with nearby lane counts reuse one compiled program."""
+    if lanes < 1:
+        raise ValueError("lane_tier needs >= 1 lanes")
+    t = 1
+    while t < lanes:
+        t <<= 1
+    return t
+
+
+@dataclasses.dataclass(frozen=True)
+class ShapeBucket:
+    """The static half of a SimConfig: what the compiled program bakes in.
+
+    ``protocol``, ``coin`` and ``init`` are structural too (step count, coin
+    law and init law select different code paths), so they ride the bucket
+    even though the ISSUE's minimal law doesn't name them — a bucket must
+    never compile a program that branches on a lane value it cannot trace.
+    """
+
+    protocol: str
+    n_pad: int
+    round_cap: int
+    delivery: str
+    adversary: str
+    coin: str
+    init: str
+    faults: str
+    counters: bool
+    pack_version: int
+
+    @classmethod
+    def of(cls, cfg: SimConfig, counters: bool = False) -> "ShapeBucket":
+        return cls(protocol=cfg.protocol, n_pad=n_tier(cfg.n),
+                   round_cap=cfg.round_cap, delivery=cfg.delivery,
+                   adversary=cfg.adversary, coin=cfg.coin, init=cfg.init,
+                   faults=cfg.faults, counters=counters,
+                   pack_version=cfg.pack_version)
+
+    def label(self) -> str:
+        """Compact human key for reports/ledger columns."""
+        tag = f"{self.protocol}/n{self.n_pad}/c{self.round_cap}/" \
+              f"{self.delivery}/{self.adversary}/{self.coin}/{self.init}/" \
+              f"f{self.faults}/p{self.pack_version}"
+        return tag + ("/counters" if self.counters else "")
+
+
+class LaneConfig:
+    """A SimConfig view over (bucket statics, traced lane scalars).
+
+    Quacks like :class:`SimConfig` for the model layer: ``n`` is the padded
+    tier (static — every array shape), while ``f``, ``crash_window`` and
+    ``n_eff`` are traced device scalars. ``seed`` is None by construction —
+    the PRF key is always passed dynamically on the batched path.
+    """
+
+    __slots__ = ("_b", "f", "crash_window", "n_eff")
+
+    def __init__(self, bucket: ShapeBucket, f, crash_window, n_eff):
+        self._b = bucket
+        self.f = f
+        self.crash_window = crash_window
+        self.n_eff = n_eff
+
+    # -- static structure (from the bucket) ---------------------------------
+    @property
+    def protocol(self):
+        return self._b.protocol
+
+    @property
+    def n(self):
+        return self._b.n_pad
+
+    @property
+    def round_cap(self):
+        return self._b.round_cap
+
+    @property
+    def delivery(self):
+        return self._b.delivery
+
+    @property
+    def adversary(self):
+        return self._b.adversary
+
+    @property
+    def coin(self):
+        return self._b.coin
+
+    @property
+    def init(self):
+        return self._b.init
+
+    @property
+    def faults(self):
+        return self._b.faults
+
+    @property
+    def pack_version(self):
+        return self._b.pack_version
+
+    @property
+    def seed(self):
+        return None
+
+    # -- derived predicates (mirroring SimConfig) ---------------------------
+    @property
+    def steps_per_round(self):
+        return 2 if self.protocol == "benor" else 3
+
+    @property
+    def count_level(self):
+        from byzantinerandomizedconsensus_tpu.config import (
+            COUNT_LEVEL_DELIVERIES)
+
+        return self.delivery in COUNT_LEVEL_DELIVERIES
+
+    @property
+    def lying_adversary(self):
+        return self.adversary in ("byzantine", "adaptive", "adaptive_min")
+
+
+class _PadAdversary(AdversaryModel):
+    """Adversary wrapper that makes padding replicas non-existent: they are
+    silent on every step (never counted by any delivery law or validation
+    rule) and faulty for termination/extraction (never gate a decision).
+    ``pad`` is the (n_pad,) bool padding mask (replica index ≥ lane n)."""
+
+    def __init__(self, cfg, pad):
+        super().__init__(cfg)
+        self._pad = pad
+
+    def setup(self, seed, inst_ids, xp=np):
+        s = super().setup(seed, inst_ids, xp=xp)
+        if self._pad is not None:
+            s = dict(s)
+            s["faulty"] = s["faulty"] | self._pad[None, :]
+        return s
+
+    def inject(self, seed, inst_ids, rnd, t, honest_values, setup, xp=np,
+               recv_ids=None):
+        v, sil, b = super().inject(seed, inst_ids, rnd, t, honest_values,
+                                   setup, xp=xp, recv_ids=recv_ids)
+        if self._pad is not None:
+            sil = sil | self._pad[None, :]
+        return v, sil, b
+
+
+class CompileCache:
+    """Bounded LRU of compiled bucket programs, with the observability
+    counters the run record carries (compiles / hits / evictions). One
+    instance per backend serves both the batched path and the counter leg —
+    the fix for the previously unbounded ``_compiled_counters`` dict."""
+
+    def __init__(self, max_entries: int = 32):
+        if max_entries < 1:
+            raise ValueError("CompileCache needs max_entries >= 1")
+        self.max_entries = max_entries
+        self._entries: OrderedDict = OrderedDict()
+        self.compiles = 0
+        self.hits = 0
+        self.evictions = 0
+
+    def get(self, key, build):
+        if key in self._entries:
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return self._entries[key]
+        fn = build()
+        self.compiles += 1
+        self._entries[key] = fn
+        while len(self._entries) > self.max_entries:
+            self._entries.popitem(last=False)
+            self.evictions += 1
+        return fn
+
+    def __len__(self):
+        return len(self._entries)
+
+    def stats(self) -> dict:
+        """The run-record ``compile_cache`` block (obs/record.py v1.1)."""
+        return {
+            "compiles": self.compiles,
+            "hits": self.hits,
+            "evictions": self.evictions,
+            "entries": len(self._entries),
+            "max_entries": self.max_entries,
+        }
+
+
+def _run_lanes(bucket: ShapeBucket, keys, fs, wins, neffs, inst_ids):
+    """The traced bucket program: vmap of the per-config chunk kernel over
+    the lane axis. ``keys`` (L, 2) u32, ``fs``/``neffs`` (L,) i32, ``wins``
+    (L,) u32, ``inst_ids`` (L, B) u32. Returns (rounds (L, B), decision
+    (L, B)[, counter accumulator (L, B, C, 2)])."""
+    import jax
+    import jax.numpy as jnp
+
+    from byzantinerandomizedconsensus_tpu.backends import jax_backend
+
+    def one(key, f, w, ne, ids):
+        cfg = LaneConfig(bucket, f=f, crash_window=w, n_eff=ne)
+        pad = jnp.arange(bucket.n_pad, dtype=jnp.int32) >= ne
+        return jax_backend._run_chunk(cfg, ids, key=key,
+                                      counters=bucket.counters,
+                                      adv=_PadAdversary(cfg, pad))
+
+    return jax.vmap(one)(keys, fs, wins, neffs, inst_ids)
+
+
+def _chunk_instances(bucket: ShapeBucket, lanes: int, max_i: int,
+                     chunk_bytes: int, max_chunk: int) -> int:
+    """Instances per lane per dispatch: the single-config sizing law divided
+    across the lane axis (the O(lanes · B · n²) mask transient must fit the
+    same budget), rounded to a power of two so nearby grids share programs."""
+    from byzantinerandomizedconsensus_tpu.config import COUNT_LEVEL_DELIVERIES
+
+    n = bucket.n_pad
+    if bucket.delivery in COUNT_LEVEL_DELIVERIES:
+        per_lane = max(1, (1 << 20) // max(1, n))
+    else:
+        per_inst = n * n * 4 * 4
+        per_lane = max(1, chunk_bytes // per_inst)
+    b = max(1, min(per_lane // lanes, max_chunk))
+    # Floor the budget to a power of two (never exceed it), but allow one
+    # whole-grid dispatch when the grid itself is small: ceil-pow2(max_i)
+    # overshoots the budget by < 2x at worst, and only at trivial sizes.
+    floor_b = 1
+    while floor_b * 2 <= b:
+        floor_b <<= 1
+    ceil_i = 1
+    while ceil_i < max_i:
+        ceil_i <<= 1
+    return min(floor_b, ceil_i)
+
+
+def run_batch(backend, cfgs: Sequence[SimConfig], inst_ids=None,
+              counters: bool = False):
+    """Run many configs of ONE shape bucket in vmapped lanes on ``backend``
+    (a JaxBackend). Returns a list of per-config SimResults, bit-identical
+    to ``backend.run`` per lane; with ``counters``, returns
+    ``(results, counters_docs)``.
+
+    ``inst_ids`` is an optional per-config list of instance-id arrays.
+    Raises ``ValueError`` on mixed delivery laws / packing versions
+    (config.validate_batch — pinned messages) or on configs that fall into
+    different buckets. The counter leg is pad-exact: per-receiver counter
+    sums mask padding receivers (ops/urn*.py stats, obs/counters.py), so a
+    padded lane's totals equal the per-config run's.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if backend.kernel != "xla":
+        raise ValueError(
+            f"batched lanes require the default 'xla' kernel; "
+            f"kernel={backend.kernel!r} compiles per-config programs")
+    cfgs = validate_batch(cfgs)
+    buckets = {ShapeBucket.of(c, counters=counters) for c in cfgs}
+    if len(buckets) != 1:
+        labels = sorted(b.label() for b in buckets)
+        raise ValueError(
+            f"batch spans {len(buckets)} shape buckets ({', '.join(labels)}); "
+            "run_batch serves one bucket — use run_many to auto-group")
+    bucket = next(iter(buckets))
+
+    lanes = len(cfgs)
+    l_pad = lane_tier(lanes)
+    ids_list = [
+        backend._resolve_inst_ids(c, None if inst_ids is None else inst_ids[i])
+        for i, c in enumerate(cfgs)]
+    max_i = max((len(i) for i in ids_list), default=0)
+    if max_i == 0:
+        empty = [_empty_result(c, i) for c, i in zip(cfgs, ids_list)]
+        if counters:
+            from byzantinerandomizedconsensus_tpu.obs import counters as _c
+
+            return empty, [_c.counters_doc(c, _c.finalize(c, _c.zeros(c, 0)),
+                                           backend=backend.name)
+                           for c in cfgs]
+        return empty
+
+    chunk = _chunk_instances(bucket, l_pad, max_i, backend.chunk_bytes,
+                             backend.max_chunk)
+    cache = compile_cache(backend)
+    fn = cache.get((bucket, l_pad, chunk),
+                   lambda: jax.jit(partial(_run_lanes, bucket)))
+
+    # Lane operands: padding lanes replicate the last config (discarded).
+    def lane_cfg(i):
+        return cfgs[min(i, lanes - 1)]
+
+    keys = np.stack([np.asarray(prf.seed_key(lane_cfg(i).seed),
+                                dtype=np.uint32) for i in range(l_pad)])
+    fs = np.asarray([lane_cfg(i).f for i in range(l_pad)], dtype=np.int32)
+    wins = np.asarray([lane_cfg(i).crash_window for i in range(l_pad)],
+                      dtype=np.uint32)
+    neffs = np.asarray([lane_cfg(i).n for i in range(l_pad)], dtype=np.int32)
+    lane_ops = (jnp.asarray(keys), jnp.asarray(fs), jnp.asarray(wins),
+                jnp.asarray(neffs))
+
+    return _dispatch_and_collect(backend, fn, lane_ops, cfgs, ids_list,
+                                 l_pad, chunk, max_i, counters)
+
+
+def _dispatch_and_collect(backend, fn, lane_ops, cfgs, ids_list, l_pad,
+                          chunk, max_i, counters):
+    """Shared lane-grid executor: async-dispatch every (l_pad, chunk) id
+    grid, one batched device_get, per-lane unpad/trim — the run_batch /
+    run_fused common tail."""
+    import jax
+    import jax.numpy as jnp
+
+    lanes = len(cfgs)
+
+    def lane_ids(i):
+        ids = ids_list[min(i, lanes - 1)]
+        return ids if len(ids) else np.zeros(1, dtype=np.int64)
+
+    pending = []
+    with backend._device_ctx():
+        for lo in range(0, max_i, chunk):
+            grid = np.empty((l_pad, chunk), dtype=np.uint32)
+            for l in range(l_pad):
+                ids = lane_ids(l)
+                seg = ids[lo:lo + chunk]
+                if len(seg) == 0:
+                    seg = ids[-1:]
+                if len(seg) < chunk:
+                    seg = np.concatenate(
+                        [seg, np.full(chunk - len(seg), seg[-1])])
+                grid[l] = seg.astype(np.uint32)
+            pending.append(fn(*lane_ops, jnp.asarray(grid)))
+        fetched = jax.device_get(pending)
+
+    results = []
+    docs = []
+    for l, (cfg, ids) in enumerate(zip(cfgs, ids_list)):
+        parts_r, parts_d, parts_c = [], [], []
+        for c, ch in enumerate(fetched):
+            lo = c * chunk
+            take = max(0, min(len(ids) - lo, chunk))
+            if take == 0:
+                continue
+            parts_r.append(np.asarray(ch[0][l])[:take])
+            parts_d.append(np.asarray(ch[1][l])[:take])
+            if counters:
+                parts_c.append(np.asarray(ch[2][l])[:take])
+        if parts_r:
+            rounds = np.concatenate(parts_r).astype(np.int32, copy=False)
+            decision = np.concatenate(parts_d).astype(np.uint8, copy=False)
+        else:
+            rounds = np.empty(0, dtype=np.int32)
+            decision = np.empty(0, dtype=np.uint8)
+        from byzantinerandomizedconsensus_tpu.backends.base import SimResult
+
+        results.append(SimResult(config=cfg, inst_ids=ids, rounds=rounds,
+                                 decision=decision))
+        if counters:
+            from byzantinerandomizedconsensus_tpu.obs import counters as _c
+
+            rows = (np.concatenate(parts_c) if parts_c
+                    else _c.zeros(cfg, 0, np))
+            docs.append(_c.counters_doc(cfg, _c.finalize(cfg, rows),
+                                        backend=backend.name))
+    if counters:
+        return results, docs
+    return results
+
+
+def _empty_result(cfg, ids):
+    from byzantinerandomizedconsensus_tpu.backends.base import SimResult
+
+    return SimResult(config=cfg, inst_ids=ids,
+                     rounds=np.empty(0, dtype=np.int32),
+                     decision=np.empty(0, dtype=np.uint8))
+
+
+def run_many(backend, cfgs: Sequence[SimConfig], inst_ids=None,
+             counters: bool = False, progress=None):
+    """Group arbitrary configs by shape bucket and run each group batched.
+
+    Returns ``(results, report)`` with ``results`` in input order and
+    ``report`` the observability block: per-bucket occupancy plus the
+    backend's compile-cache stats (the run-record ``batch`` payload).
+    ``inst_ids`` is an optional per-config list of instance-id arrays.
+    With ``counters``, returns ``(results, docs, report)``.
+    """
+    cfgs = [c.validate() for c in cfgs]
+    groups: OrderedDict = OrderedDict()
+    for i, c in enumerate(cfgs):
+        groups.setdefault(ShapeBucket.of(c, counters=counters),
+                          []).append(i)
+    results = [None] * len(cfgs)
+    docs = [None] * len(cfgs)
+    occupancy = []
+    for bucket, idxs in groups.items():
+        if progress is not None:
+            progress(f"batch bucket {bucket.label()}: {len(idxs)} config(s)")
+        out = run_batch(backend, [cfgs[i] for i in idxs],
+                        inst_ids=(None if inst_ids is None
+                                  else [inst_ids[i] for i in idxs]),
+                        counters=counters)
+        group_res, group_docs = out if counters else (out, None)
+        for j, i in enumerate(idxs):
+            results[i] = group_res[j]
+            if counters:
+                docs[i] = group_docs[j]
+        occupancy.append({"bucket": bucket.label(), "configs": len(idxs),
+                          "lane_tier": lane_tier(len(idxs))})
+    report = {
+        "buckets": len(groups),
+        "configs": len(cfgs),
+        "occupancy": occupancy,
+        "compile_cache": compile_cache(backend).stats(),
+    }
+    if counters:
+        return results, docs, report
+    return results, report
+
+
+def run_grid(backend, cfgs: Sequence[SimConfig], inst_ids=None,
+             progress=None):
+    """Fleet-path convenience: batched ``run_many`` when ``backend`` (an
+    object or a registered name) supports it, an honest per-config loop
+    otherwise. Returns ``(results, report_or_None)`` — tools wire their
+    grids through this one seam so ``--batched`` never changes results,
+    only how many programs get compiled."""
+    if isinstance(backend, str):
+        from byzantinerandomizedconsensus_tpu.backends.base import get_backend
+
+        backend = get_backend(backend)
+    if hasattr(backend, "run_many"):
+        return run_many(backend, cfgs, inst_ids=inst_ids, progress=progress)
+    results = [backend.run(c, None if inst_ids is None else inst_ids[i])
+               for i, c in enumerate(cfgs)]
+    return results, None
+
+
+def compile_cache(backend) -> CompileCache:
+    """The backend's bucket-keyed compiled-program LRU (created on first
+    use). Shared by run_batch and the counter leg."""
+    cache = getattr(backend, "_bucket_cache", None)
+    if cache is None:
+        cache = CompileCache()
+        backend._bucket_cache = cache
+    return cache
+
+
+# ---------------------------------------------------------------------------
+# fused lanes — the sparse-grid specialization (docs/PERF.md round 10)
+#
+# The strict bucket law above amortizes compiles only when a grid *shares*
+# buckets (seed sweeps, f sweeps, tier-sharing sweep points). A randomized
+# grid like the chaos population spans protocol × adversary × delivery ×
+# faults × cap × coin × init × tier and buckets at occupancy ≈ 1 — nothing
+# amortizes (measured: 275 buckets for 280 configs). The fused mode folds
+# every foldable axis into lane data: adversary kind, fault kind, coin, init
+# and round_cap become traced lane codes selecting between jointly-computed
+# variants, and small n pads to one coarse tier — leaving ONE superset
+# program per (protocol, delivery, tier, §2 pack version). Bit-match still
+# holds per lane: each variant's math is the static law's (the samplers'
+# documented st ≡ False collapse covers the adaptive structure; unused PRF
+# draws are coordinate-addressed and never feed selected values).
+
+#: Lane-code registries (the traced half of the fused split).
+ADV_CODES = {"none": 0, "crash": 1, "byzantine": 2, "adaptive": 3,
+             "adaptive_min": 4}
+FAULT_CODES = {"none": 0, "recover": 1, "partition": 2, "omission": 3}
+COIN_CODES = {"local": 0, "shared": 1}
+INIT_CODES = {"random": 0, "all0": 1, "all1": 2, "split": 3}
+
+#: The coarse small-n tier for fused grids: every n below it pads up, so a
+#: whole small-n fleet shares one program per (protocol, delivery). 40 =
+#: the soak/chaos generator's n ceiling (tools/soak.MAX_SOAK_N) — the
+#: dominant fused workload pads with zero waste at its own edge; shapes
+#: need no power-of-two alignment on the XLA side.
+FUSED_SMALL_TIER = 40
+
+
+def fused_tier(n: int) -> int:
+    return FUSED_SMALL_TIER if n <= FUSED_SMALL_TIER else n_tier(n)
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedBucket:
+    """The static residue of a config under fused lanes: only what selects
+    genuinely different *code* (step count, sampler family, key packing) or
+    array shapes stays baked."""
+
+    protocol: str
+    n_pad: int
+    delivery: str
+    pack_version: int
+
+    @classmethod
+    def of(cls, cfg: SimConfig) -> "FusedBucket":
+        return cls(protocol=cfg.protocol, n_pad=fused_tier(cfg.n),
+                   delivery=cfg.delivery, pack_version=cfg.pack_version)
+
+    def label(self) -> str:
+        return (f"fused/{self.protocol}/n{self.n_pad}/{self.delivery}/"
+                f"p{self.pack_version}")
+
+    #: duck-typing for _chunk_instances
+    counters = False
+
+
+class FusedLaneConfig(LaneConfig):
+    """LaneConfig whose adversary / faults / coin / init are the "superset"
+    sentinel laws (models compute every variant and select by the traced
+    lane codes) and whose round_cap is traced lane data too."""
+
+    __slots__ = ("round_cap_t", "adv_code", "faults_code", "coin_code",
+                 "init_code")
+
+    def __init__(self, bucket, f, crash_window, n_eff, round_cap,
+                 adv_code, faults_code, coin_code, init_code):
+        super().__init__(bucket, f=f, crash_window=crash_window, n_eff=n_eff)
+        self.round_cap_t = round_cap
+        self.adv_code = adv_code
+        self.faults_code = faults_code
+        self.coin_code = coin_code
+        self.init_code = init_code
+
+    @property
+    def round_cap(self):
+        return self.round_cap_t
+
+    @property
+    def adversary(self):
+        return "superset"
+
+    @property
+    def faults(self):
+        return "superset"
+
+    @property
+    def coin(self):
+        return "superset"
+
+    @property
+    def init(self):
+        return "superset"
+
+    @property
+    def lying_adversary(self):
+        # byzantine(2) / adaptive(3) / adaptive_min(4) — a traced bool;
+        # models/benor.py takes the arithmetic threshold form for it.
+        return self.adv_code >= 2
+
+
+def _run_fused_lanes(bucket: FusedBucket, keys, fs, wins, neffs, caps,
+                     advs, faults_, coins_, inits, inst_ids):
+    """The fused bucket program: vmap over lanes with every foldable config
+    axis as lane data."""
+    import jax
+    import jax.numpy as jnp
+
+    from byzantinerandomizedconsensus_tpu.backends import jax_backend
+
+    def one(key, f, w, ne, cap, adv, flt, coin, init, ids):
+        cfg = FusedLaneConfig(bucket, f=f, crash_window=w, n_eff=ne,
+                              round_cap=cap, adv_code=adv, faults_code=flt,
+                              coin_code=coin, init_code=init)
+        pad = jnp.arange(bucket.n_pad, dtype=jnp.int32) >= ne
+        return jax_backend._run_chunk(cfg, ids, key=key, counters=False,
+                                      adv=_PadAdversary(cfg, pad))
+
+    return jax.vmap(one)(keys, fs, wins, neffs, caps, advs, faults_,
+                         coins_, inits, inst_ids)
+
+
+def run_fused(backend, cfgs: Sequence[SimConfig], inst_ids=None,
+              progress=None):
+    """Run arbitrary configs through fused superset lanes — grouped only by
+    (protocol, delivery, tier, pack version). Bit-identical per lane to the
+    per-config path; no counter leg (the counter schema is a static function
+    of the fault kind, which is lane data here).
+
+    Returns ``(results, report)`` like :func:`run_many`.
+    """
+    if backend.kernel != "xla":
+        raise ValueError(
+            f"fused lanes require the default 'xla' kernel; "
+            f"kernel={backend.kernel!r} compiles per-config programs")
+    import jax
+    import jax.numpy as jnp
+
+    cfgs = [c.validate() for c in cfgs]
+    groups: OrderedDict = OrderedDict()
+    for i, c in enumerate(cfgs):
+        groups.setdefault(FusedBucket.of(c), []).append(i)
+    results = [None] * len(cfgs)
+    occupancy = []
+    cache = compile_cache(backend)
+    for bucket, idxs in groups.items():
+        if progress is not None:
+            progress(f"fused bucket {bucket.label()}: {len(idxs)} config(s)")
+        group = [cfgs[i] for i in idxs]
+        ids_list = [
+            backend._resolve_inst_ids(
+                c, None if inst_ids is None else inst_ids[idxs[j]])
+            for j, c in enumerate(group)]
+        max_i = max((len(i) for i in ids_list), default=0)
+        if max_i == 0:
+            for j, i in enumerate(idxs):
+                results[i] = _empty_result(group[j], ids_list[j])
+            continue
+        lanes = len(group)
+        l_pad = lane_tier(lanes)
+        chunk = _chunk_instances(bucket, l_pad, max_i, backend.chunk_bytes,
+                                 backend.max_chunk)
+        fn = cache.get(("fused", bucket, l_pad, chunk),
+                       lambda: jax.jit(partial(_run_fused_lanes, bucket)))
+
+        def lc(i):
+            return group[min(i, lanes - 1)]
+
+        lane_ops = (
+            jnp.asarray(np.stack([np.asarray(prf.seed_key(lc(i).seed),
+                                             dtype=np.uint32)
+                                  for i in range(l_pad)])),
+            jnp.asarray(np.asarray([lc(i).f for i in range(l_pad)],
+                                   dtype=np.int32)),
+            jnp.asarray(np.asarray([lc(i).crash_window for i in range(l_pad)],
+                                   dtype=np.uint32)),
+            jnp.asarray(np.asarray([lc(i).n for i in range(l_pad)],
+                                   dtype=np.int32)),
+            jnp.asarray(np.asarray([lc(i).round_cap for i in range(l_pad)],
+                                   dtype=np.int32)),
+            jnp.asarray(np.asarray([ADV_CODES[lc(i).adversary]
+                                    for i in range(l_pad)], dtype=np.int32)),
+            jnp.asarray(np.asarray([FAULT_CODES[lc(i).faults]
+                                    for i in range(l_pad)], dtype=np.int32)),
+            jnp.asarray(np.asarray([COIN_CODES[lc(i).coin]
+                                    for i in range(l_pad)], dtype=np.int32)),
+            jnp.asarray(np.asarray([INIT_CODES[lc(i).init]
+                                    for i in range(l_pad)], dtype=np.int32)),
+        )
+        group_res = _dispatch_and_collect(
+            backend, fn, lane_ops, group, ids_list, l_pad, chunk, max_i,
+            counters=False)
+        for j, i in enumerate(idxs):
+            results[i] = group_res[j]
+        occupancy.append({"bucket": bucket.label(), "configs": len(idxs),
+                          "lane_tier": l_pad})
+    report = {
+        "mode": "fused",
+        "buckets": len(groups),
+        "configs": len(cfgs),
+        "occupancy": occupancy,
+        "compile_cache": cache.stats(),
+    }
+    return results, report
+
+
+# ---------------------------------------------------------------------------
+# persistent (cross-process) XLA compilation cache — opt-in
+
+
+def enable_persistent_compilation_cache(path: str) -> bool:
+    """Point jax's persistent compilation cache at ``path`` (opt-in): chaos
+    workers, retries and checkpoint resumes then reuse compiled programs
+    across *processes*. Returns False (with no side effect) when this jax
+    build lacks the knob — never a hard failure, the cache is an
+    accelerant, not a correctness seam."""
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", str(path))
+        # Cache every program, however fast the compile: the fleet paths this
+        # serves are dominated by many small programs.
+        try:
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        except Exception:
+            pass
+        return True
+    except Exception:
+        return False
+
+
+def maybe_enable_cache_from_env() -> Optional[str]:
+    """Honor ``BRC_COMPILATION_CACHE=<dir>`` when set (the soak/chaos parent
+    exports it to its workers). Returns the directory when enabled."""
+    path = os.environ.get(COMPILE_CACHE_ENV)
+    if path and enable_persistent_compilation_cache(path):
+        return path
+    return None

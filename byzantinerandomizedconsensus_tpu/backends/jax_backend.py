@@ -29,8 +29,8 @@ from byzantinerandomizedconsensus_tpu.models import benor, bracha, state as stat
 from byzantinerandomizedconsensus_tpu.models.adversaries import AdversaryModel
 
 
-def _run_chunk(cfg: SimConfig, inst_ids: jnp.ndarray, key=None, counts_fn=None,
-               counters: bool = False):
+def _run_chunk(cfg, inst_ids: jnp.ndarray, key=None, counts_fn=None,
+               counters: bool = False, adv=None):
     """Simulate one padded chunk; returns (rounds (B,), decision (B,)) — plus
     the (B, C, 2) uint32 per-instance counter accumulator when ``counters``.
 
@@ -46,12 +46,18 @@ def _run_chunk(cfg: SimConfig, inst_ids: jnp.ndarray, key=None, counts_fn=None,
     which fold under the same ``done_at < 0`` activity mask that gates state
     updates. Nothing flows from the accumulator back into the round math, so
     the (rounds, decision) surface is bit-identical either way.
+
+    ``adv`` overrides the adversary model (default: ``AdversaryModel(cfg)``)
+    — the batched lane runner (backends/batch.py) passes its padding-aware
+    wrapper here, and ``cfg`` may then be a ``LaneConfig`` view carrying
+    traced lane scalars (f, crash_window, n_eff) over static bucket shapes.
     """
     from byzantinerandomizedconsensus_tpu.obs import counters as _c
 
     seed = cfg.seed if key is None else key
     round_body = benor.round_body if cfg.protocol == "benor" else bracha.round_body
-    adv = AdversaryModel(cfg)
+    if adv is None:
+        adv = AdversaryModel(cfg)
     setup = adv.setup(seed, inst_ids, xp=jnp)
     faulty = setup["faulty"]
     st = state_mod.init_state(cfg, seed, inst_ids, xp=jnp)
@@ -162,18 +168,41 @@ class JaxBackend(JitChunkedBackend):
             return super()._device_ctx()
         return jax.default_device(jax.devices(self.device)[0])
 
-    def _fn_counters(self, cfg: SimConfig):
-        """Compiled chunk function with the counter side-output leg — cached
-        separately so counted runs never evict (or retrace) the product
-        program of the same config."""
-        cfg_key = self._cache_key(cfg)
-        cache = self.__dict__.setdefault("_compiled_counters", {})
-        if cfg_key not in cache:
-            # counts_fn=None: the default XLA masks+tally / count-level
-            # registry paths — the only ones with the obs side channel.
-            cache[cfg_key] = jax.jit(
-                partial(_run_chunk, cfg_key, counts_fn=None, counters=True))
-        return cache[cfg_key]
+    def run_batch(self, cfgs, inst_ids=None, counters: bool = False):
+        """Run many configs of one shape bucket in vmapped lanes — one
+        compiled program per bucket instead of one per config, bit-identical
+        per lane to :meth:`run` (backends/batch.py; docs/PERF.md round 10)."""
+        from byzantinerandomizedconsensus_tpu.backends import batch
+
+        return batch.run_batch(self, cfgs, inst_ids=inst_ids,
+                               counters=counters)
+
+    def run_many(self, cfgs, inst_ids=None, counters: bool = False,
+                 progress=None):
+        """Auto-group arbitrary configs by shape bucket and run each group
+        batched; returns ``(results, report)`` (+ counters docs when asked).
+        The fleet-path entry point (soak, divergence, acceptance grids)."""
+        from byzantinerandomizedconsensus_tpu.backends import batch
+
+        return batch.run_many(self, cfgs, inst_ids=inst_ids,
+                              counters=counters, progress=progress)
+
+    def run_fused(self, cfgs, inst_ids=None, progress=None):
+        """Fused superset lanes for sparse grids (backends/batch.py): only
+        (protocol, delivery, tier, pack version) stay baked; adversary kind,
+        fault kind, coin, init and round_cap ride as traced lane codes.
+        Bit-identical per lane; the chaos-grid amortization lever."""
+        from byzantinerandomizedconsensus_tpu.backends import batch
+
+        return batch.run_fused(self, cfgs, inst_ids=inst_ids,
+                               progress=progress)
+
+    def compile_cache_stats(self) -> dict:
+        """The bucket-program LRU counters for run records (obs/record.py
+        schema v1.1) — compiles / hits / evictions / occupancy."""
+        from byzantinerandomizedconsensus_tpu.backends import batch
+
+        return batch.compile_cache(self).stats()
 
     def run_with_counters(self, cfg: SimConfig,
                           inst_ids: Optional[np.ndarray] = None):
@@ -184,6 +213,15 @@ class JaxBackend(JitChunkedBackend):
         channel, and ``xla_nosort`` is a keys-only A/B kernel — both raise
         :class:`CountersUnsupported` rather than silently measuring a
         different code path.
+
+        The counted program is the single-lane batched bucket program: it is
+        keyed by shape bucket (not by config) in the bounded
+        :class:`~byzantinerandomizedconsensus_tpu.backends.batch.CompileCache`
+        LRU, so a grid of counted configs sharing a bucket compiles once —
+        the round-10 fix for the previously unbounded per-config
+        ``_compiled_counters`` dict. Counter collection stays a pure side
+        output: results are bit-identical to :meth:`run`'s
+        (tests/test_obs_counters.py, tests/test_batch.py).
         """
         from byzantinerandomizedconsensus_tpu.obs import counters as _counters
 
@@ -194,16 +232,6 @@ class JaxBackend(JitChunkedBackend):
         cfg = cfg.validate()
         self._check_config(cfg)
         ids = self._resolve_inst_ids(cfg, inst_ids)
-        chunk = self._clamp_chunk(cfg, min(self._chunk_size(cfg), max(1, len(ids))))
-        fn = self._fn_counters(cfg)
-        with self._device_ctx():
-            # The product path's dispatch/fetch/unpad invariant, with one
-            # extra output column (the per-instance counter accumulator).
-            rounds_out, decision_out, rows = self._run_chunked_multi(
-                fn, ids, chunk, self._extra_args(cfg), n_extra=1)
-        if rows is None:  # empty inst_ids
-            rows = _counters.zeros(cfg, 0, np)
-        res = SimResult(config=cfg, inst_ids=ids, rounds=rounds_out,
-                        decision=decision_out)
-        totals = _counters.finalize(cfg, rows)
-        return res, _counters.counters_doc(cfg, totals, backend=self.name)
+        results, docs = self.run_batch(
+            [cfg], inst_ids=[ids], counters=True)
+        return results[0], docs[0]

@@ -220,6 +220,7 @@ def cmd_sweep(args) -> int:
         instances=args.instances, seed=args.seed,
         shard_instances=args.shard_instances, coin=args.coin,
         delivery=delivery, round_cap=args.round_cap,
+        batched=args.batched,
         progress=lambda msg: print(msg, file=sys.stderr),
     )
     # One artifact format across all tools (obs/record.py): the per-n
@@ -276,6 +277,11 @@ def main(argv=None) -> int:
     p_sw.add_argument("--round-cap", type=int, default=None)
     p_sw.add_argument("--coin", choices=["local", "shared"], default="shared")
     p_sw.add_argument("--delivery", choices=list(DELIVERY_KINDS), default=None)
+    p_sw.add_argument("--batched", action="store_true",
+                      help="config-batched shards (backends/batch.py): sweep "
+                           "points sharing a shape tier ride one compiled "
+                           "program and one dispatch per shard — "
+                           "bit-identical results, fewer compiles")
     p_sw.add_argument("--plot", default=None, metavar="FILE",
                       help="render the round-distribution figure (png/svg)")
     p_sw.set_defaults(fn=cmd_sweep)

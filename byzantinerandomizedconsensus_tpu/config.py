@@ -92,6 +92,18 @@ class SimConfig:
         return 2 if self.protocol == "benor" else 3
 
     @property
+    def n_eff(self) -> int:
+        """The value of n in protocol *arithmetic* (quorum thresholds, drop
+        totals, receiver classes, coin budgets). For a plain SimConfig this is
+        just ``n``; the batched lane runner (backends/batch.py) substitutes a
+        config view whose ``n`` is the padded shape tier and whose ``n_eff``
+        is the lane's real n (a traced scalar) — the model layer reads ``n``
+        wherever a static array *shape* is needed and ``n_eff`` wherever the
+        protocol's value of n enters the math, so one compiled program serves
+        every n in a tier bit-exactly."""
+        return self.n
+
+    @property
     def count_level(self) -> bool:
         """True for the count-domain delivery models (§4b "urn", §4b-v2
         "urn2", §4c "urn3"): no O(n²) mask object exists, adversary structure
@@ -162,6 +174,37 @@ class SimConfig:
         elif 2 * self.f >= self.n:
             raise ValueError(f"benor requires n > 2f (got n={self.n}, f={self.f})")
         return self
+
+
+def validate_batch(cfgs) -> list["SimConfig"]:
+    """Validate a batched lane request (backends/batch.py::run_batch).
+
+    Every config must validate individually, and the batch must be servable
+    by ONE compiled bucket program: a bucket bakes exactly one delivery law
+    and one spec §2 packing law into its XLA program, so a request mixing
+    either is a caller error — rejected here with a pinned message rather
+    than silently split (``run_many`` is the auto-grouping entry point).
+    Returns the validated configs.
+    """
+    cfgs = [c.validate() for c in cfgs]
+    if not cfgs:
+        raise ValueError("empty batch: at least one config is required")
+    d0 = cfgs[0].delivery
+    for c in cfgs[1:]:
+        if c.delivery != d0:
+            raise ValueError(
+                f"batch mixes delivery laws {d0!r} and {c.delivery!r}: one "
+                "lane bucket runs one delivery law (split the batch per "
+                "delivery, or use run_many to auto-group)")
+    p0 = cfgs[0].pack_version
+    for c in cfgs[1:]:
+        if c.pack_version != p0:
+            raise ValueError(
+                f"batch mixes spec §2 packing versions v{p0} and "
+                f"v{c.pack_version}: one lane bucket draws under one packing "
+                "law (split the batch at the n = 1024 packing edge, or use "
+                "run_many to auto-group)")
+    return cfgs
 
 
 def _f_opt(n: int) -> int:

@@ -26,12 +26,17 @@ def faulty_mask(cfg, seed, inst_ids, xp=np):
     (spec §3.2). One shared selection law with the §9 fault-prone set
     (models/faults.fault_prone_mask) — the safety reduction *requires* the
     two sets to coincide under an active adversary, so there is exactly one
-    implementation, gated here on the benign adversary."""
+    implementation, gated here on the benign adversary. Under the fused-lane
+    "superset" adversary the gate is the lane's traced ``adv_code`` (0 =
+    none) instead of a Python branch."""
     from byzantinerandomizedconsensus_tpu.models.faults import fault_prone_mask
 
     if cfg.adversary == "none":
         return xp.zeros((inst_ids.shape[0], cfg.n), dtype=bool)
-    return fault_prone_mask(cfg, seed, inst_ids, xp=xp)
+    mask = fault_prone_mask(cfg, seed, inst_ids, xp=xp)
+    if cfg.adversary == "superset":
+        mask = mask & (xp.asarray(cfg.adv_code) != 0)
+    return mask
 
 
 def observed_minority(honest_values, faulty, xp=np):
@@ -51,7 +56,9 @@ def crash_rounds(cfg, seed, inst_ids, xp=np):
     c = prf.prf_u32(seed, xp.asarray(inst_ids, dtype=xp.uint32)[:, None],
                     0, 0, replica, 0, prf.CRASH_ROUND, xp=xp,
                     pack=cfg.pack_version)
-    return (c % xp.uint32(cfg.crash_window)).astype(xp.int32)
+    # asarray (not the dtype constructor): crash_window may be a traced lane
+    # scalar under the batched runner; values are identical either way.
+    return (c % xp.asarray(cfg.crash_window, dtype=xp.uint32)).astype(xp.int32)
 
 
 class AdversaryModel:
@@ -63,7 +70,7 @@ class AdversaryModel:
     def setup(self, seed, inst_ids, xp=np):
         cfg = self.cfg
         fm = faulty_mask(cfg, seed, inst_ids, xp=xp)
-        if cfg.adversary == "crash":
+        if cfg.adversary in ("crash", "superset"):
             cr = crash_rounds(cfg, seed, inst_ids, xp=xp)
         else:
             cr = xp.zeros(fm.shape, dtype=xp.int32)
@@ -130,6 +137,87 @@ class AdversaryModel:
                               xp.broadcast_to(honest_values[:, None, :], (B, R, n)).astype(xp.uint8))
             return values, zero_silent, no_bias
 
+        if cfg.adversary == "superset":
+            # Fused lanes (backends/batch.py run_fused): every adversary's
+            # outputs are computed on the shared setup and the traced lane
+            # ``adv_code`` selects (0 none, 1 crash, 2 byzantine, 3 adaptive,
+            # 4 adaptive_min). ``faulty`` is already code-gated (all-False on
+            # none-lanes), so each variant's output is bit-identical to its
+            # static-law value wherever it is selected.
+            code = xp.asarray(cfg.adv_code)
+            r32 = xp.asarray(rnd, dtype=xp.int32)
+            crash_sil = faulty & (r32 >= setup["crash_round"])
+            minority = observed_minority(honest_values, faulty, xp=xp)
+            adapt_values = xp.where(faulty, minority[:, None],
+                                    honest_values).astype(xp.uint8)
+            if cfg.protocol == "bracha" or cfg.count_level:
+                # Values stay (B, n). Byzantine: the RBC count-level outcome
+                # for bracha; for count-level Ben-Or the urns recompute the
+                # two-faced class values themselves (lane_setup selects).
+                if cfg.protocol == "bracha":
+                    b = prf.prf_u32(seed, inst, rnd, t, 0, send,
+                                    prf.BYZ_VALUE, xp=xp,
+                                    pack=cfg.pack_version) & xp.uint32(3)
+                    byz_sil = faulty & (b == 0)
+                    v = xp.where(b == 1, xp.uint8(0),
+                                 xp.where(b == 2, xp.uint8(1),
+                                          honest_values.astype(xp.uint8)))
+                    byz_values = xp.where(faulty, v,
+                                          honest_values).astype(xp.uint8)
+                else:
+                    byz_sil = zero_silent
+                    byz_values = honest_values
+                values = xp.where(code == 2, byz_values,
+                                  xp.where(code >= 3, adapt_values,
+                                           honest_values)).astype(xp.uint8)
+                silent = xp.where(code == 1, crash_sil,
+                                  xp.where(code == 2, byz_sil, zero_silent))
+                if cfg.count_level:
+                    return values, silent, no_bias
+                # bracha + keys: only the adaptive family biases scheduling.
+                vv = values[:, None, :]
+                pref = (recv_ids.astype(xp.int32)
+                        >= (cfg.n_eff + 1) // 2)[None, :, None].astype(xp.uint8)
+                bias_ad = ((vv == 2) | (vv != pref)).astype(xp.uint32)
+                bias_min = ((vv == 2)
+                            | (vv != minority[:, None, None])).astype(xp.uint32)
+                bias = xp.where(code == 3,
+                                bias_ad,
+                                xp.where(code == 4, bias_min,
+                                         xp.zeros((B, 1, n),
+                                                  dtype=xp.uint32)))
+                return values, silent, bias
+            # Ben-Or + keys: the Byzantine lane needs the per-receiver
+            # equivocation matrix, so values are (B, R, n) for every lane
+            # (non-Byzantine lanes broadcast — same per-sender value at every
+            # receiver, hence identical tallies).
+            R = recv_ids.shape[0]
+            recv3 = recv_ids[None, :, None]
+            send3 = xp.arange(n, dtype=xp.uint32)[None, None, :]
+            inst3 = xp.asarray(inst_ids, dtype=xp.uint32)[:, None, None]
+            e = prf.prf_u32(seed, inst3, rnd, t, recv3, send3, prf.BYZ_VALUE,
+                            xp=xp, pack=cfg.pack_version)
+            vmat = (e % xp.uint32(3)).astype(xp.uint8)
+            byz3 = xp.where(faulty[:, None, :], vmat,
+                            xp.broadcast_to(honest_values[:, None, :],
+                                            (B, R, n)).astype(xp.uint8))
+            flat = xp.where(code >= 3, adapt_values,
+                            honest_values).astype(xp.uint8)
+            values = xp.where(code == 2, byz3,
+                              xp.broadcast_to(flat[:, None, :],
+                                              (B, R, n)).astype(xp.uint8))
+            silent = xp.where(code == 1, crash_sil, zero_silent)
+            vv = values
+            pref = (recv_ids.astype(xp.int32)
+                    >= (cfg.n_eff + 1) // 2)[None, :, None].astype(xp.uint8)
+            bias_ad = ((vv == 2) | (vv != pref)).astype(xp.uint32)
+            bias_min = ((vv == 2)
+                        | (vv != minority[:, None, None])).astype(xp.uint32)
+            bias = xp.where(code == 3, bias_ad,
+                            xp.where(code == 4, bias_min,
+                                     xp.zeros((B, 1, n), dtype=xp.uint32)))
+            return values, silent, bias
+
         if cfg.adversary in ("adaptive", "adaptive_min"):
             # spec §6.4/§6.4b — observe honest votes, push the minority value,
             # bias delivery (by receiver class, or globally minority-first).
@@ -146,7 +234,9 @@ class AdversaryModel:
                 return values, zero_silent, bias
             # §6.4: receiver v prefers value 0 iff v < n/2; senders whose wire value
             # matches the receiver's preference get bias 0 (delivered first).
-            pref = (recv_ids.astype(xp.int32) >= (n + 1) // 2)[None, :, None].astype(xp.uint8)
+            # n_eff, not the (possibly padded) array width: the receiver-class
+            # split is a protocol value of n (traced under batching).
+            pref = (recv_ids.astype(xp.int32) >= (cfg.n_eff + 1) // 2)[None, :, None].astype(xp.uint8)
             bias = ((vv == 2) | (vv != pref)).astype(xp.uint32)
             return values, zero_silent, bias
 

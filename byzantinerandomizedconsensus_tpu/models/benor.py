@@ -32,7 +32,9 @@ def round_body(cfg, seed, inst_ids, rnd, state, adv, setup, xp=np,
     (models/delivery.py; obs/counters.py) — a pure side channel the round
     math never reads, so the bit-match surface is identical either way.
     """
-    n, f = cfg.n, cfg.f
+    # n enters the round body only as a protocol *value* (quorum thresholds),
+    # never as a shape — read n_eff so the batched lane runner can trace it.
+    n, f = cfg.n_eff, cfg.f
     if gather is None:
         gather = lambda v: v
     est, decided = state["est"], state["decided"]
@@ -46,8 +48,18 @@ def round_body(cfg, seed, inst_ids, rnd, state, adv, setup, xp=np,
                          fsil=fsil, fside=fside)
 
     # Protocol A (benign) vs Protocol B (lying) thresholds — spec §5.1.
-    quorum_rhs = n + f if cfg.lying_adversary else n
-    adopt_min = f + 1 if cfg.lying_adversary else 1
+    # ``lying_adversary`` is a traced per-lane bool under the fused batched
+    # runner (adversary kind as lane data): the arithmetic forms n + f·lying
+    # / 1 + f·lying equal the Python branches exactly for both values.
+    lying = cfg.lying_adversary
+    lying_static = isinstance(lying, (bool, np.bool_))
+    if lying_static:
+        quorum_rhs = n + f if lying else n
+        adopt_min = f + 1 if lying else 1
+    else:
+        lyi = xp.asarray(lying, dtype=xp.int32)
+        quorum_rhs = n + f * lyi
+        adopt_min = 1 + f * lyi
 
     # Step 0 — report: broadcast est.
     with profiling.annotate("brc/benor/report"):
@@ -74,7 +86,10 @@ def round_body(cfg, seed, inst_ids, rnd, state, adv, setup, xp=np,
     with profiling.annotate("brc/coin"):
         coin = coins.coin_bits(cfg, seed, inst_ids, rnd, xp=xp, recv_ids=recv_ids)
     new_est = xp.where(c >= adopt_min, w, coin).astype(xp.uint8)
-    decide_now = (2 * c > n + f) if cfg.lying_adversary else (c >= f + 1)
+    if lying_static:
+        decide_now = (2 * c > n + f) if lying else (c >= f + 1)
+    else:
+        decide_now = xp.where(lying, 2 * c > n + f, c >= f + 1)
 
     # Updates apply to every not-yet-decided replica (spec §6.3 eligibility rule).
     upd = ~decided

@@ -37,7 +37,9 @@ def round_body(cfg, seed, inst_ids, rnd, state, adv, setup, xp=np,
     recorded per-step ``silent`` includes the spec §5.1b validation
     silences, matching what the delivery law actually saw.
     """
-    n, f = cfg.n, cfg.f
+    # n enters the round body only as a protocol *value* (quorum thresholds),
+    # never as a shape — read n_eff so the batched lane runner can trace it.
+    n, f = cfg.n_eff, cfg.f
     if gather is None:
         gather = lambda v: v
     est, decided = state["est"], state["decided"]

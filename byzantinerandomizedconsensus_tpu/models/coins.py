@@ -14,15 +14,30 @@ from byzantinerandomizedconsensus_tpu.ops import prf
 
 
 def coin_bits(cfg, seed, inst_ids, rnd, xp=np, recv_ids=None):
-    """Coin bits, shape (B, R) uint8 — R = len(recv_ids) (a replica shard) or n."""
+    """Coin bits, shape (B, R) uint8 — R = len(recv_ids) (a replica shard) or n.
+
+    ``cfg.coin == "superset"`` is the fused-lane law (backends/batch.py
+    run_fused): both coin laws are drawn and the lane's ``coin_code`` (a
+    traced scalar; 0 = local, 1 = shared) selects — the selected plane is
+    bit-identical to the corresponding static law by PRF coordinates.
+    """
     inst = xp.asarray(inst_ids, dtype=xp.uint32)[:, None]
     if recv_ids is None:
         recv_ids = xp.arange(cfg.n, dtype=xp.uint32)
     replica = xp.asarray(recv_ids, dtype=xp.uint32)[None, :]
+    if cfg.coin == "local":
+        bit = prf.prf_bit(seed, inst, rnd, prf.COIN_STEP, replica, 0,
+                          prf.LOCAL_COIN, xp=xp, pack=cfg.pack_version)
+        return bit.astype(xp.uint8)
+    shared = xp.broadcast_to(
+        prf.prf_bit(seed, inst, rnd, prf.COIN_STEP, 0, 0, prf.SHARED_COIN,
+                    xp=xp, pack=cfg.pack_version).astype(xp.uint8),
+        (inst.shape[0], replica.shape[1]))
     if cfg.coin == "shared":
-        bit = prf.prf_bit(seed, inst, rnd, prf.COIN_STEP, 0, 0, prf.SHARED_COIN,
-                          xp=xp, pack=cfg.pack_version)
-        return xp.broadcast_to(bit.astype(xp.uint8), (inst.shape[0], replica.shape[1]))
-    bit = prf.prf_bit(seed, inst, rnd, prf.COIN_STEP, replica, 0, prf.LOCAL_COIN,
-                      xp=xp, pack=cfg.pack_version)
-    return bit.astype(xp.uint8)
+        return shared
+    if cfg.coin != "superset":
+        raise ValueError(f"unknown coin {cfg.coin!r}")
+    local = prf.prf_bit(seed, inst, rnd, prf.COIN_STEP, replica, 0,
+                        prf.LOCAL_COIN, xp=xp,
+                        pack=cfg.pack_version).astype(xp.uint8)
+    return xp.where(xp.asarray(cfg.coin_code) == 1, shared, local)

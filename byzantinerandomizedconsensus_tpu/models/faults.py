@@ -50,38 +50,71 @@ def fault_prone_mask(cfg, seed, inst_ids, xp=np):
     with any active adversary the two sets coincide (same PRF purpose), so
     fault schedules never widen the misbehaving set beyond f."""
     B = inst_ids.shape[0]
-    if cfg.f == 0:
+    f = cfg.f
+    f_static = isinstance(f, (int, np.integer))
+    if f_static and f == 0:
         return xp.zeros((B, cfg.n), dtype=bool)
     replica = xp.arange(cfg.n, dtype=xp.uint32)[None, :]
     rank = prf.prf_u32(seed, xp.asarray(inst_ids, dtype=xp.uint32)[:, None],
                        0, 0, replica, 0, prf.FAULTY_RANK, xp=xp,
                        pack=cfg.pack_version)
     key = (rank & xp.uint32(prf.KEY_MASK[cfg.pack_version])) | replica
-    if xp is np:
-        kth = np.partition(key, cfg.f - 1, axis=-1)[..., cfg.f - 1]
+    n_eff = cfg.n_eff
+    padded = not (isinstance(n_eff, (int, np.integer)) and n_eff == cfg.n)
+    if padded:
+        # Batched lane with n < the padded tier: padding replicas must never
+        # displace a real one from the f-smallest selection. Forcing their
+        # keys to the uint32 max pushes them past every real key in the sort
+        # (there are n > f real keys, so the f-th smallest stays real), and
+        # the explicit replica < n_eff guard below removes them from the mask
+        # even on an all-ones-key tie.
+        key = xp.where(replica < xp.asarray(n_eff, dtype=xp.uint32),
+                       key, xp.uint32(0xFFFFFFFF))
+    if f_static:
+        if xp is np:
+            kth = np.partition(key, f - 1, axis=-1)[..., f - 1]
+        else:
+            kth = xp.sort(key, axis=-1)[..., f - 1]
+        mask = key <= kth[..., None]
     else:
-        kth = xp.sort(key, axis=-1)[..., cfg.f - 1]
-    return key <= kth[..., None]
+        # Traced lane f (backends/batch.py): dynamic index into the sorted
+        # keys, clamped so f = 0 stays in range, then masked out entirely.
+        idx = xp.maximum(xp.asarray(f, dtype=xp.int32), 1) - 1
+        kth = xp.take_along_axis(
+            xp.sort(key, axis=-1),
+            xp.broadcast_to(idx.astype(xp.int32), (B,))[:, None], axis=-1)
+        mask = (key <= kth) & (xp.asarray(f, dtype=xp.int32) > 0)
+    if padded:
+        mask = mask & (replica < xp.asarray(n_eff, dtype=xp.uint32))
+    return mask
 
 
 def setup_faults(cfg, seed, inst_ids, xp=np):
     """Static per-instance fault-schedule state (spec §9), or None for
     ``faults="none"`` — the fast path that keeps every existing config's
-    compiled program and draws untouched."""
+    compiled program and draws untouched.
+
+    ``cfg.faults == "superset"`` is the fused-lane law (backends/batch.py
+    run_fused): the recover AND partition setups are both drawn (distinct
+    PRF purposes — unused draws never feed the selected masks) and
+    :func:`round_masks` selects per lane by the traced ``faults_code``.
+    """
     if cfg.faults == "none":
         return None
     inst = xp.asarray(inst_ids, dtype=xp.uint32)[:, None]
     replica = xp.arange(cfg.n, dtype=xp.uint32)[None, :]
-    w = xp.uint32(cfg.crash_window)
+    # asarray, not the dtype constructor: crash_window may be a traced lane
+    # scalar under the batched runner (backends/batch.py).
+    w = xp.asarray(cfg.crash_window, dtype=xp.uint32)
     out = {"fprone": fault_prone_mask(cfg, seed, inst_ids, xp=xp)}
-    if cfg.faults == "recover":
+    if cfg.faults in ("recover", "superset"):
         down = prf.prf_u32(seed, inst, 0, 0, replica, 0, prf.FAULT_CRASH,
                            xp=xp, pack=cfg.pack_version) % w
         length = prf.prf_u32(seed, inst, 0, 0, replica, 0, prf.FAULT_HEAL,
                              xp=xp, pack=cfg.pack_version) % (w + w)
         out["down_at"] = down.astype(xp.int32)
         out["up_at"] = (down + length).astype(xp.int32) + xp.int32(1)
-    elif cfg.faults == "partition":
+    if cfg.faults in ("partition", "superset"):
         side = prf.prf_u32(seed, inst, 0, 0, replica, 0, prf.FAULT_SIDE,
                            xp=xp, pack=cfg.pack_version) & xp.uint32(1)
         # The cut isolates a PRF-drawn *subset of the fault-prone set*: from
@@ -102,7 +135,14 @@ def setup_faults(cfg, seed, inst_ids, xp=np):
 
 def round_masks(cfg, seed, inst_ids, rnd, fsetup, xp=np):
     """Per-round fault masks ``(fsil, fside)`` (module docstring shapes);
-    ``(None, None)`` for ``faults="none"``. ``rnd`` may be traced."""
+    ``(None, None)`` for ``faults="none"``. ``rnd`` may be traced.
+
+    ``cfg.faults == "superset"`` (fused lanes, backends/batch.py): all three
+    laws' masks are evaluated and the traced ``faults_code`` selects — a
+    lane with code 0 gets an all-False ``fsil`` / all-zero ``fside``, which
+    composes as a no-op at every consumer (silence OR, side-split class
+    counts, cross-cut plane), so it is bit-identical to the ``None`` fast
+    path."""
     if fsetup is None:
         return None, None
     fprone = fsetup["fprone"]
@@ -123,8 +163,20 @@ def round_masks(cfg, seed, inst_ids, rnd, fsetup, xp=np):
     replica = xp.arange(cfg.n, dtype=xp.uint32)[None, :]
     bit = prf.prf_u32(seed, inst[:, None], r, 0, replica, 0, prf.FAULT_OMIT,
                       xp=xp, pack=cfg.pack_version) & xp.uint32(1)
-    fsil = fprone & burst[:, None] & (bit == 1)
-    return fsil, None
+    fsil_om = fprone & burst[:, None] & (bit == 1)
+    if cfg.faults == "omission":
+        return fsil_om, None
+    if cfg.faults != "superset":
+        raise ValueError(f"unknown faults {cfg.faults!r}")
+    code = xp.asarray(cfg.faults_code)
+    fsil_rec = fprone & (r >= fsetup["down_at"]) & (r < fsetup["up_at"])
+    active = (r >= fsetup["part_start"]) & (r < fsetup["part_heal"])
+    fside_part = xp.where(active[:, None], fsetup["side"], xp.uint8(0))
+    false = xp.zeros_like(fprone)
+    fsil = xp.where(code == 1, fsil_rec,
+                    xp.where(code == 3, fsil_om, false))
+    fside = xp.where(code == 2, fside_part.astype(xp.uint8), xp.uint8(0))
+    return fsil, fside
 
 
 def cross_silent(fside, recv_ids=None, xp=np):

@@ -8,7 +8,13 @@ from byzantinerandomizedconsensus_tpu.ops import prf
 
 
 def init_est(cfg, seed, inst_ids, xp=np, recv_ids=None):
-    """(B, R) uint8 initial estimates (spec §3.1); R = len(recv_ids) or n."""
+    """(B, R) uint8 initial estimates (spec §3.1); R = len(recv_ids) or n.
+
+    ``cfg.init == "superset"`` is the fused-lane law (backends/batch.py
+    run_fused): all four init laws are evaluated and the lane's
+    ``init_code`` (traced; 0 = random, 1 = all0, 2 = all1, 3 = split)
+    selects — bit-identical per lane to the static law.
+    """
     B = inst_ids.shape[0]
     if recv_ids is None:
         recv_ids = xp.arange(cfg.n, dtype=xp.uint32)
@@ -21,8 +27,17 @@ def init_est(cfg, seed, inst_ids, xp=np, recv_ids=None):
     if cfg.init == "split":
         return xp.broadcast_to((replica & xp.uint32(1)).astype(xp.uint8), (B, R))
     inst = xp.asarray(inst_ids, dtype=xp.uint32)[:, None]
-    return prf.prf_bit(seed, inst, 0, 0, replica, 0, prf.INIT_EST, xp=xp,
+    rand = prf.prf_bit(seed, inst, 0, 0, replica, 0, prf.INIT_EST, xp=xp,
                        pack=cfg.pack_version).astype(xp.uint8)
+    if cfg.init == "random":
+        return rand
+    if cfg.init != "superset":
+        raise ValueError(f"unknown init {cfg.init!r}")
+    code = xp.asarray(cfg.init_code)
+    split = xp.broadcast_to((replica & xp.uint32(1)).astype(xp.uint8), (B, R))
+    return xp.where(code == 0, rand,
+                    xp.where(code == 1, xp.uint8(0),
+                             xp.where(code == 2, xp.uint8(1), split)))
 
 
 def init_state(cfg, seed, inst_ids, xp=np, recv_ids=None):

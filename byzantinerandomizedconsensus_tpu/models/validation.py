@@ -21,7 +21,7 @@ def live_counts(values, silent, xp=np):
 
 def validate_step1(cfg, values, g0_0, g0_1, xp=np):
     """(B, n) bool — invalid step-1 (x) messages, from step-0 global counts."""
-    q = cfg.n - cfg.f
+    q = cfg.n_eff - cfg.f             # value-of-n law: traced under batching
     ok1 = g0_1 >= (q + 1) // 2        # x=1: can be a ties->1 majority of a q-subset
     ok0 = g0_0 >= q // 2 + 1          # x=0: must be a strict majority
     return ~xp.where(values == 1, ok1[:, None],
@@ -30,7 +30,7 @@ def validate_step1(cfg, values, g0_0, g0_1, xp=np):
 
 def validate_step2(cfg, values, g1_0, g1_1, xp=np):
     """(B, n) bool — invalid step-2 (z) messages, from valid step-1 global counts."""
-    n, f = cfg.n, cfg.f
+    n, f = cfg.n_eff, cfg.f           # value-of-n law: traced under batching
     q = n - f
     okv1 = g1_1 >= n // 2 + 1
     okv0 = g1_0 >= n // 2 + 1

@@ -127,13 +127,31 @@ def round_increments(cfg, obs: dict, xp=np):
     if sorted(obs) != list(range(steps)):
         raise ValueError(f"obs is missing step entries: have {sorted(obs)}")
     batch = obs[0]["c0"].shape[0]
-    k = i32(cfg.n - cfg.f - 1)
+    # n-value law (traced under batched lanes): asarray, not the dtype
+    # constructor, so a traced n_eff/f pair is accepted.
+    k = xp.asarray(cfg.n_eff - cfg.f - 1, dtype=i32)
+    # Pad-exact receiver axis (backends/batch.py): sums over receivers mask
+    # padding lanes (index ≥ n_eff), so a padded lane's totals equal the
+    # per-config run's. None (no masking compiled in) for plain configs.
+    R = obs[0]["c0"].shape[-1]
+    ne = cfg.n_eff
+    rmask = None
+    if not (isinstance(ne, (int, np.integer)) and ne == R):
+        rmask = (xp.arange(R, dtype=i32)
+                 < xp.asarray(ne, dtype=i32))[None, :]
+
+    def rsum(x):
+        """Sum over the receiver axis, padding receivers masked out."""
+        x = xp.asarray(x, dtype=i32)
+        if rmask is not None:
+            x = xp.where(rmask, x, i32(0))
+        return x.sum(axis=-1, dtype=i32)
 
     cols = []
     for t in range(steps):
         e = obs[t]
-        cols.append(e["c0"].sum(axis=-1).astype(u32))
-        cols.append(e["c1"].sum(axis=-1).astype(u32))
+        cols.append(rsum(e["c0"]).astype(u32))
+        cols.append(rsum(e["c1"]).astype(u32))
         # Drop total from the silent set alone (spec §4: every delivery law
         # drops exactly max(0, L_v − (n−f−1)) live messages per receiver).
         # Under a §9 partition, L_v counts only same-side live senders.
@@ -149,8 +167,8 @@ def round_increments(cfg, obs: dict, xp=np):
             tot_v = xp.where(side == xp.uint8(1), tot_p[1][:, None],
                              tot_p[0][:, None])
             L = (tot_v - live.astype(i32)).astype(i32)
-        cols.append(xp.maximum(L - k, i32(0)).sum(axis=-1).astype(u32))
-    coin = cfg.n if cfg.coin == "local" else 1
+        cols.append(rsum(xp.maximum(L - k, i32(0))).astype(u32))
+    coin = cfg.n_eff if cfg.coin == "local" else 1
     cols.append(xp.full((batch,), coin, dtype=xp.uint32))
     cols.append(xp.full((batch,), 1, dtype=xp.uint32))
     for name in _SAMPLER_COUNTERS.get(cfg.delivery, ()):
@@ -185,7 +203,7 @@ def round_increments(cfg, obs: dict, xp=np):
                 # Receiver on side s misses every live sender on side 1−s.
                 cross = xp.where(side == xp.uint8(1), liv_p[0][:, None],
                                  liv_p[1][:, None])
-                cols.append(cross.sum(axis=-1).astype(u32))
+                cols.append(rsum(cross).astype(u32))
     return xp.stack(cols, axis=1)
 
 

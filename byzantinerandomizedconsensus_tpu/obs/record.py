@@ -19,6 +19,14 @@ artifact needs while leaving each tool its payload keys:
 - config provenance via :func:`config_block` (dataclasses.asdict + the
   derived pack_version).
 
+Schema v1.1 (round 10) adds the **compile-cache** observability fields: a
+``compile_cache`` block (:func:`compile_cache_block` — compiles / hits /
+evictions / occupancy of the shape-bucketed program LRU, backends/batch.py)
+and per-tool ``batch`` payloads carrying bucket occupancy. v1.1 records keep
+``record_version: 1`` (every committed v1 artifact stays valid) and declare
+the revision in ``record_revision``; :func:`validate_record` accepts both and
+checks the block shapes when present.
+
 tools/ledger.py consumes both this format and the legacy r1–r7 shapes;
 :func:`validate_record` is the schema check the tier-1 tests pin.
 """
@@ -30,6 +38,8 @@ import dataclasses
 import numpy as np
 
 RECORD_VERSION = 1
+# Minor schema revision (v1.1): compile-cache / batch observability fields.
+RECORD_REVISION = 1
 
 
 def env_fingerprint() -> dict:
@@ -83,7 +93,8 @@ def env_fingerprint() -> dict:
 def new_record(kind: str, description: str | None = None,
                config=None) -> dict:
     """The shared head every artifact document merges its payload into."""
-    out = {"record_version": RECORD_VERSION, "kind": kind}
+    out = {"record_version": RECORD_VERSION,
+           "record_revision": RECORD_REVISION, "kind": kind}
     if description is not None:
         out["description"] = description
     out["env"] = env_fingerprint()
@@ -135,6 +146,23 @@ def collect_counters(be, cfg, inst_ids=None) -> dict:
         return _c.unsupported_doc(e)
 
 
+def compile_cache_block(backend) -> dict | None:
+    """The schema-v1.1 ``compile_cache`` block from a backend name or object:
+    the shape-bucketed program LRU's counters (backends/batch.py), or None
+    when the backend has no bucket cache (numpy, native, the oracle). Never
+    raises — observability must not break record assembly."""
+    try:
+        if isinstance(backend, str):
+            from byzantinerandomizedconsensus_tpu.backends.base import (
+                get_backend)
+
+            backend = get_backend(backend)
+        fn = getattr(backend, "compile_cache_stats", None)
+        return None if fn is None else fn()
+    except Exception:
+        return None
+
+
 def validate_record(doc: dict) -> list:
     """Schema check: returns a list of problems (empty = valid v1 record)."""
     problems = []
@@ -159,4 +187,12 @@ def validate_record(doc: dict) -> list:
         elif counters["supported"] and not isinstance(
                 counters.get("totals"), dict):
             problems.append("supported counters block missing 'totals'")
+    cc = doc.get("compile_cache")
+    if cc is not None:
+        if not isinstance(cc, dict):
+            problems.append("compile_cache block is not a dict")
+        else:
+            for key in ("compiles", "hits", "evictions"):
+                if key not in cc:
+                    problems.append(f"compile_cache block missing {key!r}")
     return problems

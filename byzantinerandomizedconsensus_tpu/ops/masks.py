@@ -70,6 +70,8 @@ def mask_from_keys(combined, n_deliver: int, silent, xp=np, recv_ids=None,
     if xp is np:
         kth = np.partition(combined, n_deliver - 1, axis=-1)[..., n_deliver - 1]
     else:
+        # n_deliver may be a traced lane scalar (backends/batch.py): dynamic
+        # indexing into the sorted keys lowers to a gather under jit/vmap.
         kth = xp.sort(combined, axis=-1)[..., n_deliver - 1]
     mask = combined <= kth[..., None]
     n = combined.shape[-1]
@@ -90,7 +92,11 @@ def delivery_mask(cfg, seed, inst_ids, rnd, t, silent, bias, xp=np, recv_ids=Non
     """(B, R, n) bool — delivered(recv, send) per spec §4 (+§9 cut)."""
     combined = combined_keys(cfg, seed, inst_ids, rnd, t, silent, bias, xp=xp,
                              recv_ids=recv_ids, xsilent=xsilent)
-    return mask_from_keys(combined, cfg.n - cfg.f, silent, xp=xp,
+    # n − f is an n-*value* law (n_eff): under batched padding the quota uses
+    # the lane's real n while the key tensor spans the padded tier (padding
+    # senders carry the silent bit, so they sort past every live key and the
+    # explicit silence exclusion removes them from the mask regardless).
+    return mask_from_keys(combined, cfg.n_eff - cfg.f, silent, xp=xp,
                           recv_ids=recv_ids, xsilent=xsilent)
 
 

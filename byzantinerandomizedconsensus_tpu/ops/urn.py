@@ -36,6 +36,19 @@ def byz_class_values(cfg, seed, inst_ids, rnd, t, honest, faulty, xp=np):
     return out[0], out[1]
 
 
+def recv_value_mask(cfg, recv, xp):
+    """(R,) bool mask of *real* receiver lanes under the lane's ``n_eff``,
+    or None when the config is unpadded (static n_eff == n). Used to keep
+    the sampler-owned cost counters pad-exact on the batched path
+    (backends/batch.py): padding receivers run the draw math (their streams
+    are independent, so real lanes are untouched) but must not contribute to
+    any counter sum."""
+    ne = cfg.n_eff
+    if isinstance(ne, (int, np.integer)) and ne == cfg.n:
+        return None
+    return recv.astype(xp.int32) < xp.asarray(ne, dtype=xp.int32)
+
+
 def _take_lane(arr, recv, xp):
     """arr (B, n) gathered at the (R,) receiver lanes -> (B, R)."""
     if xp is np:
@@ -59,16 +72,29 @@ def lane_setup(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
     ``L``/``D`` — the cut suppresses messages, it never adds any.
     """
     n, f = cfg.n, cfg.f
+    n_eff = cfg.n_eff  # protocol value of n (traced under batched lanes)
     u32, i32 = xp.uint32, xp.int32
     if recv_ids is None:
         recv = xp.arange(n, dtype=xp.uint32)
     else:
         recv = xp.asarray(recv_ids, dtype=xp.uint32)
-    h_lane = (recv >= u32((n + 1) // 2))[None, :]  # (1, R) receiver class
+    # (1, R) receiver class — an n-value law, so n_eff (int32 compare: the
+    # traced form cannot ride the uint32 constructor).
+    h_lane = (recv.astype(i32) >= xp.asarray((n_eff + 1) // 2, dtype=i32))[None, :]
 
     two_faced = cfg.adversary == "byzantine" and cfg.protocol != "bracha"
     if two_faced:
         v0c, v1c = byz_class_values(cfg, seed, inst_ids, rnd, t, honest, faulty, xp=xp)
+    elif cfg.adversary == "superset" and cfg.protocol != "bracha":
+        # Fused lanes: the Byzantine lane's two-faced class values, selected
+        # by the traced adv_code (other lanes keep the common wire value).
+        # faulty is code-gated, so the non-selected draws never leak in.
+        b0, b1 = byz_class_values(cfg, seed, inst_ids, rnd, t, honest,
+                                  faulty, xp=xp)
+        base = values if values.ndim == 2 else honest
+        is_byz = xp.asarray(cfg.adv_code) == 2
+        v0c = xp.where(is_byz, b0, base).astype(base.dtype)
+        v1c = xp.where(is_byz, b1, base).astype(base.dtype)
     else:
         v0c = v1c = values if values.ndim == 2 else honest
 
@@ -125,11 +151,30 @@ def lane_setup(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
         st = [minority != 0, minority != 1,
               xp.broadcast_to(xp.asarray(True), minority.shape)]
         st = [xp.asarray(s, dtype=bool) for s in st]
+    elif cfg.adversary == "superset":
+        # Fused lanes: both adaptive-family stratum laws, selected by the
+        # traced adv_code; every other code gets st ≡ False, under which the
+        # general samplers are bit-identical to their single-stratum forms
+        # (the documented st ≡ False collapse in this module / §4b-v2 / §4c).
+        from byzantinerandomizedconsensus_tpu.models.adversaries import observed_minority
+
+        code = xp.asarray(cfg.adv_code)
+        st_ad = [h_lane != (w == 1) if w < 2
+                 else xp.broadcast_to(True, h_lane.shape) for w in (0, 1, 2)]
+        minority = observed_minority(honest, faulty, xp=xp)[:, None]
+        st_min = [minority != 0, minority != 1,
+                  xp.broadcast_to(xp.asarray(True), minority.shape)]
+        false = xp.zeros((1, 1), dtype=bool)
+        st = [xp.where(code == 3, xp.asarray(a, dtype=bool),
+                       xp.where(code == 4, xp.asarray(m, dtype=bool), false))
+              for a, m in zip(st_ad, st_min)]
     else:
         st = [xp.zeros((1, 1), dtype=bool)] * 3
 
     L = m[0] + m[1] + m[2]
-    D = xp.maximum(L - i32(n - f - 1), i32(0))            # (B, R) drops
+    # Drop total per spec §4b: k = n − f − 1 is an n-value law (n_eff).
+    k = xp.asarray(n_eff - f - 1, dtype=i32)
+    D = xp.maximum(L - k, i32(0)).astype(i32)             # (B, R) drops
     return recv, own_val, m, st, L, D
 
 
@@ -155,8 +200,14 @@ def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
         cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
         recv_ids=recv_ids, xp=xp, fside=fside)
     if stats is not None:
-        stats["urn_draws"] = D.sum(axis=-1).astype(u32)
-    adaptive = cfg.adversary in ("adaptive", "adaptive_min")
+        rm = recv_value_mask(cfg, recv, xp)
+        Ds = D if rm is None else xp.where(rm[None, :], D, i32(0))
+        stats["urn_draws"] = Ds.sum(axis=-1).astype(u32)
+    # "superset" (fused lanes) takes the general adaptive structure: its
+    # selected st planes are identically False on non-adaptive lanes,
+    # under which the general draws collapse bit-exactly (see the
+    # st ≡ False notes on the samplers).
+    adaptive = cfg.adversary in ("adaptive", "adaptive_min", "superset")
 
     inst = xp.asarray(inst_ids, dtype=xp.uint32)[:, None]
     s0 = prf.prf_u32(seed, inst, rnd, t, recv[None, :], 0, prf.URN, xp=xp,
@@ -219,7 +270,15 @@ def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
     else:
         carry = (s0, (m[0].astype(u32) | (m[1].astype(u32) << u32(16))))
         fn = step_single
-    if f > 0:
+    if not isinstance(f, (int, np.integer)):
+        # Traced lane f (backends/batch.py): a dynamic fori_loop bound (no
+        # unroll — XLA lowers it to a while_loop). Draws beyond a lane's own
+        # D are masked by ``active`` exactly as static-f tail draws are, so
+        # the outputs are bit-identical to the static-f program.
+        import jax
+
+        carry = jax.lax.fori_loop(0, xp.asarray(f, i32), fn, carry)
+    elif f > 0:
         if xp is np:
             for j in range(f):
                 carry = fn(j, carry)

@@ -109,15 +109,22 @@ def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
     recv, own_val, m, st, L, D = urn.lane_setup(
         cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
         recv_ids=recv_ids, xp=xp, fside=fside)
-    adaptive = cfg.adversary in ("adaptive", "adaptive_min")
+    # "superset" (fused lanes) takes the general adaptive structure: its
+    # selected st planes are identically False on non-adaptive lanes,
+    # under which the general draws collapse bit-exactly (see the
+    # st ≡ False notes on the samplers).
+    adaptive = cfg.adversary in ("adaptive", "adaptive_min", "superset")
 
     trips_sum = trips_max = None
+    rm = urn.recv_value_mask(cfg, recv, xp) if stats is not None else None
 
     def note_trips(mm, Lr, Dr):
         nonlocal trips_sum, trips_max
         if stats is None:
             return
         K = _trips(mm, Lr, Dr, xp)
+        if rm is not None:  # pad-exact counters on batched padded lanes
+            K = xp.where(rm[None, :], K, xp.int32(0))
         s, mx = K.sum(axis=-1).astype(xp.uint32), K.max(axis=-1).astype(xp.uint32)
         trips_sum = s if trips_sum is None else (trips_sum + s).astype(xp.uint32)
         trips_max = mx if trips_max is None else xp.maximum(trips_max, mx)

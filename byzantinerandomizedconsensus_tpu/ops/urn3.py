@@ -66,9 +66,18 @@ def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
         cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
         recv_ids=recv_ids, xp=xp, fside=fside)
     if stats is not None:
-        stats["urn3_words"] = xp.full((silent.shape[0],), recv.shape[0],
+        rm = urn.recv_value_mask(cfg, recv, xp)
+        # One word per *real* receiver lane per step: pad-exact under the
+        # batched runner's receiver padding (n_eff may be traced there).
+        words = (recv.shape[0] if rm is None
+                 else xp.asarray(cfg.n_eff, dtype=xp.uint32))
+        stats["urn3_words"] = xp.full((silent.shape[0],), words,
                                       dtype=xp.uint32)
-    adaptive = cfg.adversary in ("adaptive", "adaptive_min")
+    # "superset" (fused lanes) takes the general adaptive structure: its
+    # selected st planes are identically False on non-adaptive lanes,
+    # under which the general draws collapse bit-exactly (see the
+    # st ≡ False notes on the samplers).
+    adaptive = cfg.adversary in ("adaptive", "adaptive_min", "superset")
 
     inst = xp.asarray(inst_ids, dtype=xp.uint32)[:, None]
     # One PRF word per (instance, round, step, receiver); (B, 1) x (1, R)

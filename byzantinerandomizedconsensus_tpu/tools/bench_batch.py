@@ -1,0 +1,241 @@
+"""Config-batched execution A/B — the round-10 measurement instrument.
+
+Measures the wall cost of the seeded chaos grid (tools/soak.py's random
+config population) under three execution disciplines:
+
+1. ``per_config_subprocess`` — the shipped r9 chaos path: one subprocess per
+   config, each paying a cold interpreter + a cold per-config jit. This is
+   the baseline the batched runner exists to amortize.
+2. ``per_config_subprocess_jobs`` — the same path under ``--jobs N``
+   parallel workers (the soak's round-10 concurrency lever).
+3. ``batched`` — the same configs through the FUSED superset lanes
+   (backends/batch.py run_fused: one program per (protocol, delivery,
+   tier); adversary/faults/coin/init/cap ride as traced lane codes) in ONE
+   process, with the instrument's differential preserved: every config is
+   still run on the independent numpy stack, checked for the spec-§1 safety
+   invariants, and bit-compared against its fused-lane result. A mismatch
+   is recorded, never swallowed — the A/B must not buy speed by dropping
+   the check. (The strict bucket law groups this random population at
+   occupancy ≈ 1 and cannot amortize it — that law's win is dense grids,
+   isolated by the dense_bucket leg.)
+
+Plus a ``dense_bucket`` micro-leg: K configs differing only in lane data
+(f, seed, crash_window) — the pure compile-amortization number (K per-config
+programs vs 1 bucket program).
+
+Emits a run-record (kind="bench_batch", schema v1.1 with the compile-cache
+block) — committed as ``artifacts/batch_r10.json``:
+
+    python -m byzantinerandomizedconsensus_tpu.tools.bench_batch \
+        --configs 280 --jobs 4 --out artifacts/batch_r10.json
+
+The tier-1 smoke (tests/test_batch.py) runs ``--smoke`` — the in-process
+legs only, 4-config bucket, seconds not minutes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import random
+import time
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu.backends import get_backend
+from byzantinerandomizedconsensus_tpu.config import SimConfig
+from byzantinerandomizedconsensus_tpu.tools import soak
+from byzantinerandomizedconsensus_tpu.utils.rounds import default_artifact
+
+
+def chaos_grid(n_configs: int, seed: int) -> list:
+    """The seeded chaos population — the same draw law as ``soak --chaos``
+    (generator_version + seed reproduce it), so the A/B measures the grid
+    the chaos artifact actually runs."""
+    rng = random.Random(seed)
+    return [soak.random_config(rng, chaos=True) for _ in range(n_configs)]
+
+
+def leg_subprocess(cfgs, timeout_s: float, jobs: int = 1,
+                   progress=print) -> dict:
+    """The r9 chaos discipline: one subprocess per config (cold interpreter,
+    cold jit), optionally ``jobs``-wide. Returns wall + per-status counts."""
+    import concurrent.futures as fut
+
+    t0 = time.perf_counter()
+    statuses = {"ok": 0, "mismatch": 0, "skipped": 0}
+
+    def one(cfg):
+        return soak._run_chaos_config(cfg, 0, timeout_s=timeout_s,
+                                      backoff_s=0.2)
+
+    if jobs <= 1:
+        recs = [one(c) for c in cfgs]
+    else:
+        with fut.ThreadPoolExecutor(max_workers=jobs) as pool:
+            recs = list(pool.map(one, cfgs))
+    for rec in recs:
+        statuses[rec.get("status", "skipped")] = \
+            statuses.get(rec.get("status", "skipped"), 0) + 1
+    wall = time.perf_counter() - t0
+    progress(f"subprocess leg (jobs={jobs}): {wall:.1f} s, {statuses}")
+    return {"wall_s": round(wall, 2), "jobs": jobs, "configs": len(cfgs),
+            "statuses": statuses}
+
+
+def leg_batched(cfgs, progress=print, fused: bool = True) -> dict:
+    """The round-10 discipline: one process, configs grouped into vmapped
+    lanes — with the chaos instrument's full differential kept (numpy leg +
+    §1 safety invariants + bit-compare per config).
+
+    ``fused`` (default) uses the superset lanes (backends/batch.py
+    run_fused): a random chaos population spans so many static axes that the
+    strict bucket law groups it at occupancy ≈ 1 (measured: 275 buckets for
+    280 configs — the strict law is the *dense*-grid lever, see the
+    dense_bucket leg); fusing adversary/faults/coin/init/cap into lane codes
+    leaves one program per (protocol, delivery, tier) and is what amortizes
+    here."""
+    from byzantinerandomizedconsensus_tpu.models import invariants
+
+    jb = get_backend("jax")
+    numpy_be = get_backend("numpy")
+    t0 = time.perf_counter()
+    if fused:
+        results, report = jb.run_fused(cfgs)
+    else:
+        results, report = jb.run_many(cfgs)
+    mismatches = 0
+    violations = 0
+    for cfg, res in zip(cfgs, results):
+        nres, state, faulty = numpy_be.run_with_state(cfg)
+        viol = invariants.state_violations(cfg, state, faulty, res=nres,
+                                           inst_ids=nres.inst_ids)
+        violations += len(viol)
+        if not (np.array_equal(nres.rounds, res.rounds)
+                and np.array_equal(nres.decision, res.decision)):
+            mismatches += 1
+            progress(f"batched leg: MISMATCH {cfg}")
+    wall = time.perf_counter() - t0
+    progress(f"batched leg ({report.get('mode', 'bucketed')}): {wall:.1f} s, "
+             f"{report['buckets']} buckets / {report['configs']} configs, "
+             f"{mismatches} mismatches, {violations} violations")
+    return {"wall_s": round(wall, 2), "configs": len(cfgs),
+            "mode": report.get("mode", "bucketed"),
+            "mismatches": mismatches, "violations": violations,
+            "buckets": report["buckets"],
+            "occupancy": report["occupancy"],
+            "compile_cache": report["compile_cache"]}
+
+
+def leg_dense_bucket(lanes: int = 8, progress=print) -> dict:
+    """Pure compile-amortization: ``lanes`` configs differing only in lane
+    data (f, seed, crash_window) — per-config jit pays ``lanes`` compiles,
+    the bucket program pays one."""
+    base = dict(protocol="bracha", n=16, instances=64, adversary="byzantine",
+                coin="shared", round_cap=64, delivery="urn2",
+                faults="recover")
+    cfgs = [SimConfig(**base, f=1 + (i % 5), seed=1000 + 17 * i,
+                      crash_window=2 + (i % 4)).validate()
+            for i in range(lanes)]
+    jb = get_backend("jax")
+    # Per-config leg: fresh programs (the backend's per-config cache starts
+    # empty for these configs by construction of the distinct seeds... only
+    # seed is dynamic there, so distinct (f, crash_window) pairs compile).
+    t0 = time.perf_counter()
+    per_cfg = [jb.run(c) for c in cfgs]
+    wall_per = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    batched = jb.run_batch(cfgs)
+    wall_batch = time.perf_counter() - t0
+    bit_identical = all(
+        np.array_equal(a.rounds, b.rounds)
+        and np.array_equal(a.decision, b.decision)
+        for a, b in zip(per_cfg, batched))
+    progress(f"dense bucket ({lanes} lanes): per-config {wall_per:.2f} s, "
+             f"batched {wall_batch:.2f} s, bit_identical={bit_identical}")
+    return {"lanes": lanes, "wall_per_config_s": round(wall_per, 3),
+            "wall_batched_s": round(wall_batch, 3),
+            "speedup": round(wall_per / wall_batch, 2) if wall_batch > 0
+            else None,
+            "bit_identical": bit_identical}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--configs", type=int, default=280,
+                    help="chaos-grid size (matches artifacts/chaos_r9.json)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--jobs", type=int, default=4,
+                    help="worker width for the subprocess-with-jobs leg")
+    ap.add_argument("--timeout", type=float, default=soak.CHAOS_TIMEOUT_S)
+    ap.add_argument("--dense-lanes", type=int, default=8)
+    ap.add_argument("--skip-subprocess", action="store_true",
+                    help="skip both subprocess legs (minutes each on the "
+                         "full grid)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tier-1 smoke: 4-config bucket + small batched "
+                         "grid, in-process legs only")
+    ap.add_argument("--out", default=default_artifact("batch"))
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        args.configs = min(args.configs, 6)
+        args.dense_lanes = 4
+        args.skip_subprocess = True
+
+    progress = lambda msg: print(msg, flush=True)  # noqa: E731
+    cfgs = chaos_grid(args.configs, args.seed)
+
+    legs: dict = {"dense_bucket": leg_dense_bucket(args.dense_lanes,
+                                                   progress=progress)}
+    legs["batched"] = leg_batched(cfgs, progress=progress)
+    if not args.skip_subprocess:
+        legs["per_config_subprocess"] = leg_subprocess(
+            cfgs, args.timeout, jobs=1, progress=progress)
+        if args.jobs > 1:
+            legs["per_config_subprocess_jobs"] = leg_subprocess(
+                cfgs, args.timeout, jobs=args.jobs, progress=progress)
+
+    summary = {}
+    if "per_config_subprocess" in legs:
+        base = legs["per_config_subprocess"]["wall_s"]
+        summary["speedup_batched_vs_per_config"] = round(
+            base / legs["batched"]["wall_s"], 2) \
+            if legs["batched"]["wall_s"] > 0 else None
+        if "per_config_subprocess_jobs" in legs:
+            summary["speedup_jobs_vs_per_config"] = round(
+                base / legs["per_config_subprocess_jobs"]["wall_s"], 2) \
+                if legs["per_config_subprocess_jobs"]["wall_s"] > 0 else None
+    summary["dense_bucket_speedup"] = legs["dense_bucket"]["speedup"]
+
+    from byzantinerandomizedconsensus_tpu.obs import record
+
+    doc = {
+        **record.new_record("bench_batch"),
+        "description": "config-batched execution A/B on the seeded chaos "
+                       "grid: per-config subprocess (the r9 path) vs "
+                       "--jobs workers vs shape-bucketed vmapped lanes, "
+                       "plus the dense single-bucket compile-amortization "
+                       "micro-leg (tools/bench_batch.py; round 10)",
+        "generator_version": soak.GENERATOR_VERSION,
+        "seed": args.seed,
+        "configs": args.configs,
+        "device_chain_note": (
+            "wall-only A/B; CPU XLA is a valid capture for compile-"
+            "amortization ratios, but the r5 device chain rule still "
+            "applies to any kernel-time claim (docs/PERF.md)"),
+        "legs": legs,
+        "summary": summary,
+        "compile_cache": record.compile_cache_block("jax"),
+    }
+    out = pathlib.Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(doc, indent=1) + "\n")
+    print(json.dumps({"out": str(out), **summary}))
+    bad = legs["batched"]["mismatches"] + legs["batched"]["violations"]
+    return 0 if bad == 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -202,6 +202,12 @@ def main(argv=None) -> int:
         "sharded_bitmatch_virtual_2x2_n2048": bitmatch,
         "sharded_bitmatch_jax_shard_map": jax_leg,
     }
+    # Round 10: the counter legs route through the shape-bucketed compile
+    # cache (backends/batch.py) — surface its stats so the artifact shows
+    # what the LRU did for this grid (obs/record.py schema v1.1).
+    cc = record.compile_cache_block(args.backend)
+    if cc is not None:
+        doc["compile_cache"] = cc
     out = pathlib.Path(args.out)
     out.parent.mkdir(parents=True, exist_ok=True)
     out.write_text(json.dumps(doc, indent=1) + "\n")

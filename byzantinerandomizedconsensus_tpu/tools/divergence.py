@@ -129,17 +129,26 @@ def rounds_hist_tv(ra, rb) -> float:
     return float(0.5 * np.abs(pa - pb).sum())
 
 
-def compare_row(cfg: SimConfig, instances: int, backend: str) -> dict:
+def _delivery_results(cfg: SimConfig, backend: str, results=None) -> dict:
+    """{delivery: SimResult} for one row — from a precomputed batched slice
+    (``results``: the 4 per-delivery results in DELIVERIES order) or by
+    running per-config."""
+    if results is not None:
+        return dict(zip(DELIVERIES, results))
+    return {d: Simulator(dataclasses.replace(cfg, delivery=d), backend).run()
+            for d in DELIVERIES}
+
+
+def compare_row(cfg: SimConfig, instances: int, backend: str,
+                results=None) -> dict:
     """Run ``cfg`` at all three deliveries; return the pairwise per-instance
     comparison. ``frac_rounds_differ``/``frac_decision_differ`` stay the
     keys↔urn pair (the original map's fields); the §4b-v2 sampler adds the
     keys↔urn2 and urn↔urn2 pairs (round 5 — the "divergence regimes apply
-    verbatim" claim of spec §4b-v2, measured)."""
+    verbatim" claim of spec §4b-v2, measured). ``results`` injects the
+    batched-lane results (round 10) — same configs, same order, same bits."""
     cfg = dataclasses.replace(cfg, instances=instances).validate()
-    res = {}
-    for delivery in DELIVERIES:
-        c = dataclasses.replace(cfg, delivery=delivery)
-        res[delivery] = Simulator(c, backend).run()
+    res = _delivery_results(cfg, backend, results=results)
 
     row = {
         "protocol": cfg.protocol, "n": cfg.n, "f": cfg.f,
@@ -222,12 +231,18 @@ FAULT_GRID: tuple[SimConfig, ...] = (
 FAULT_KINDS_MEASURED = ("recover", "partition", "omission")
 
 
-def fault_row(cfg: SimConfig, instances: int, backend: str) -> dict:
+def fault_row(cfg: SimConfig, instances: int, backend: str,
+              results=None) -> dict:
     """One §9 liveness row: the fault-free baseline vs every fault kind on
     the same config — per-kind rounds-histogram TV, mean rounds, capped and
-    decided-1 fractions."""
+    decided-1 fractions. ``results`` injects the batched-lane results
+    (baseline then FAULT_KINDS_MEASURED order)."""
     cfg = dataclasses.replace(cfg, instances=instances).validate()
-    base = Simulator(cfg, backend).run()
+    if results is None:
+        results = [Simulator(cfg, backend).run()] + [
+            Simulator(dataclasses.replace(cfg, faults=kind), backend).run()
+            for kind in FAULT_KINDS_MEASURED]
+    base = results[0]
     row = {
         "protocol": cfg.protocol, "n": cfg.n, "f": cfg.f,
         "adversary": cfg.adversary, "coin": cfg.coin, "seed": cfg.seed,
@@ -237,8 +252,7 @@ def fault_row(cfg: SimConfig, instances: int, backend: str) -> dict:
         "capped_none": float((base.decision == 2).mean()),
         "p1_none": float((base.decision == 1).mean()),
     }
-    for kind in FAULT_KINDS_MEASURED:
-        r = Simulator(dataclasses.replace(cfg, faults=kind), backend).run()
+    for kind, r in zip(FAULT_KINDS_MEASURED, results[1:]):
         row[f"rounds_hist_tv_{kind}"] = rounds_hist_tv(base.rounds, r.rounds)
         row[f"mean_rounds_{kind}"] = float(r.rounds.mean())
         row[f"capped_{kind}"] = float((r.decision == 2).mean())
@@ -247,8 +261,23 @@ def fault_row(cfg: SimConfig, instances: int, backend: str) -> dict:
 
 
 def run_fault_rows(instances: int = 400, backend: str = "numpy",
-                   progress=print) -> list:
+                   batched: bool = False, progress=print) -> list:
     rows = []
+    per_row = 1 + len(FAULT_KINDS_MEASURED)
+    if batched:
+        from byzantinerandomizedconsensus_tpu.backends import batch as _batch
+
+        cfgs = [
+            dataclasses.replace(cfg, instances=instances,
+                                faults=kind).validate()
+            for cfg in FAULT_GRID
+            for kind in ("none",) + FAULT_KINDS_MEASURED]
+        flat, _ = _batch.run_grid(backend, cfgs)
+        for i, cfg in enumerate(FAULT_GRID):
+            rows.append(fault_row(cfg, instances, backend,
+                                  results=flat[i * per_row:(i + 1) * per_row]))
+            progress(json.dumps(rows[-1]))
+        return rows
     for cfg in FAULT_GRID:
         rows.append(fault_row(cfg, instances, backend))
         progress(json.dumps(rows[-1]))
@@ -271,13 +300,38 @@ def run_divergence(instances: int = 400, backend: str = "numpy",
                    full_instances: int = 2000, presets: bool = False,
                    preset_instances: int = 2000, preset_backend: str = "native",
                    faults: bool = False, fault_instances: int = 400,
-                   progress=print) -> dict:
+                   batched: bool = False, progress=print) -> dict:
     rows = []
-    for cfg, regime in GRID:
-        row = compare_row(cfg, instances, backend)
-        row.update(regime=regime, backend=backend)
-        progress(json.dumps(row))
-        rows.append(row)
+    batch_report = None
+    if batched:
+        # Round 10: the whole grid × all four delivery laws through the
+        # shape-bucketed lane runner — one compiled program per bucket
+        # instead of one per (row, delivery). Same configs, same bits
+        # (compare_row consumes the results positionally).
+        from byzantinerandomizedconsensus_tpu.backends import batch as _batch
+
+        grid_cfgs = [
+            dataclasses.replace(cfg, instances=instances,
+                                delivery=d).validate()
+            for cfg, _ in GRID for d in DELIVERIES]
+        flat, batch_report = _batch.run_grid(backend, grid_cfgs)
+        for i, (cfg, regime) in enumerate(GRID):
+            row = compare_row(cfg, instances, backend,
+                              results=flat[i * len(DELIVERIES):
+                                           (i + 1) * len(DELIVERIES)])
+            # batch_report is None when run_grid fell back to the honest
+            # per-config loop (backend has no run_many) — don't claim
+            # batched provenance the run didn't have.
+            row.update(regime=regime, backend=backend,
+                       batched=batch_report is not None)
+            progress(json.dumps(row))
+            rows.append(row)
+    else:
+        for cfg, regime in GRID:
+            row = compare_row(cfg, instances, backend)
+            row.update(regime=regime, backend=backend)
+            progress(json.dumps(row))
+            rows.append(row)
     if full:
         for cfg, regime in FULL_GRID:
             row = compare_row(cfg, full_instances, full_backend)
@@ -301,6 +355,8 @@ def run_divergence(instances: int = 400, backend: str = "numpy",
     summary["max_abs_mean_rounds_gap"] = \
         summary["max_abs_mean_rounds_gap_keys_urn"]
     out = {"rows": rows, "summary": summary}
+    if batch_report is not None:
+        out["batch"] = batch_report
     if presets:
         prows = run_preset_rows(instances=preset_instances,
                                 backend=preset_backend, progress=progress)
@@ -311,7 +367,7 @@ def run_divergence(instances: int = 400, backend: str = "numpy",
             abs(r["mean_rounds_urn2"] - r["mean_rounds_urn3"]) for r in prows)
     if faults:
         frows = run_fault_rows(instances=fault_instances, backend=backend,
-                               progress=progress)
+                               batched=batched, progress=progress)
         out["fault_rows"] = frows
         summary.update(fault_rows_summary(frows))
     return out
@@ -338,9 +394,15 @@ def main(argv=None) -> int:
                     help="add the spec-§9 fault-schedule liveness rows "
                          "(rounds-histogram TV vs the fault-free baseline)")
     ap.add_argument("--fault-instances", type=int, default=400)
+    ap.add_argument("--batched", action="store_true",
+                    help="run the grid through the shape-bucketed lane "
+                         "runner (backends/batch.py) when the backend "
+                         "supports it — bit-identical rows, one compiled "
+                         "program per bucket; the artifact carries the "
+                         "compile-cache stats")
     args = ap.parse_args(argv)
 
-    if args.full:
+    if args.full or (args.batched and args.backend.startswith("jax")):
         from byzantinerandomizedconsensus_tpu.utils.devices import ensure_live_backend
 
         ensure_live_backend()
@@ -351,7 +413,8 @@ def main(argv=None) -> int:
                             preset_instances=args.preset_instances,
                             preset_backend=args.preset_backend,
                             faults=args.faults,
-                            fault_instances=args.fault_instances)
+                            fault_instances=args.fault_instances,
+                            batched=args.batched)
     out = pathlib.Path(args.out)
     out.parent.mkdir(parents=True, exist_ok=True)
     out.write_text(json.dumps(result, indent=1, sort_keys=True) + "\n")

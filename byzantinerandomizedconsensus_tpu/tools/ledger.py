@@ -110,6 +110,31 @@ def _artifact_round_evidence(artifacts: dict) -> dict:
     return rounds
 
 
+def _compile_cache_of(doc):
+    """The schema-v1.1 compile-cache stats of an artifact, top-level or
+    nested under its ``batch`` payload; None when the artifact predates the
+    revision."""
+    p = _parsed(doc)
+    if not isinstance(p, dict):
+        return None, None
+    cc = p.get("compile_cache")
+    buckets = None
+    batch = p.get("batch")
+    if isinstance(batch, dict):
+        buckets = batch.get("buckets")
+        if cc is None and isinstance(batch.get("compile_cache"), dict):
+            cc = batch["compile_cache"]
+    legs = p.get("legs")
+    batched = (legs.get("batched") if isinstance(legs, dict)
+               else p.get("batched"))
+    if isinstance(batched, dict):  # bench_batch payload
+        if isinstance(batched.get("compile_cache"), dict):
+            cc = batched["compile_cache"]
+        if buckets is None:
+            buckets = batched.get("buckets")
+    return (cc if isinstance(cc, dict) else None), buckets
+
+
 def build_ledger(root=None) -> dict:
     """Assemble the full ledger document from the committed artifacts."""
     root = pathlib.Path(root or repo_root())
@@ -210,6 +235,21 @@ def build_ledger(root=None) -> dict:
                else "a fresh anchor")) if broken else None,
     }
 
+    # ---- compile-cache columns (schema v1.1, round 10): every committed
+    # artifact that carries the shape-bucketed program LRU's counters.
+    compile_cache_rows = []
+    for name, doc in sorted(docs.items()):
+        cc, buckets = _compile_cache_of(doc)
+        if cc is None:
+            continue
+        compile_cache_rows.append({
+            "artifact": name,
+            "compiles": cc.get("compiles"),
+            "hits": cc.get("hits"),
+            "evictions": cc.get("evictions"),
+            "buckets": buckets,
+        })
+
     from byzantinerandomizedconsensus_tpu.obs import record
 
     return {
@@ -219,6 +259,7 @@ def build_ledger(root=None) -> dict:
                        "ROADMAP open item #2)",
         "files_scanned": len(files),
         "parse_errors": parse_errors,
+        "compile_cache_rows": compile_cache_rows,
         "bench_rounds": {str(r): bench[r] for r in rounds_seen},
         "wall_chain": chain,
         "device_chain": device_chain,
@@ -265,6 +306,17 @@ def format_report(doc: dict) -> str:
     if doc["multichip_rounds"]:
         ok = [r for r, e in doc["multichip_rounds"].items() if e["ok"]]
         lines.append(f"multichip rounds ok: {', '.join('r' + r for r in ok)}")
+    # Present only once any committed artifact carries the v1.1 block — old
+    # ledgers render identically on old artifact sets.
+    if doc.get("compile_cache_rows"):
+        lines.append("compile-cache columns (schema v1.1 — "
+                     "artifact: compiles/hits/evictions/buckets):")
+        for row in doc["compile_cache_rows"]:
+            lines.append(
+                f"  {row['artifact']}: {row['compiles']} compiled, "
+                f"{row['hits']} hits, {row['evictions']} evicted"
+                + (f", {row['buckets']} buckets"
+                   if row["buckets"] is not None else ""))
     return "\n".join(lines)
 
 

@@ -156,7 +156,7 @@ def run_soak(n_configs: int, seed: int = 0, oracle_every: int = 10,
              oracle_instances: int = 3, progress=print, chaos: bool = False,
              timeout_s: float = CHAOS_TIMEOUT_S,
              backoff_s: float = CHAOS_BACKOFF_S,
-             checkpoint=None, inject=None) -> dict:
+             checkpoint=None, inject=None, jobs: int = 1) -> dict:
     """Run the differential; returns the artifact document (never raises on a
     mismatch — a soak must report every divergence it finds, not stop at the
     first).
@@ -165,7 +165,13 @@ def run_soak(n_configs: int, seed: int = 0, oracle_every: int = 10,
     timeout ``timeout_s``, one retry after ``backoff_s``·2^attempt, then
     skip-with-record) and resumes from ``checkpoint`` (a JSON path; written
     after every config). ``inject`` maps config indices to "crash" | "hang"
-    — the deterministic failure drill the tier-1 tests use.
+    — the deterministic failure drill the tier-1 tests use. ``jobs`` runs up
+    to that many chaos subprocesses concurrently (round 10): the config
+    population is pre-drawn (identical to the sequential draw order, so the
+    (generator_version, seed) binding is unchanged), each worker keeps its
+    own timeout → backoff → retry ladder, and the checkpoint is merged and
+    written only on the coordinating thread as completions arrive — a kill
+    mid-run still resumes every finished config.
     """
     rng = random.Random(seed)
     mismatches = []
@@ -185,26 +191,44 @@ def run_soak(n_configs: int, seed: int = 0, oracle_every: int = 10,
         native_be = get_backend("native")
         cpu_be = get_backend("cpu")
 
-    for k in range(n_configs):
-        cfg = random_config(rng, chaos=chaos)
-        by_delivery[cfg.delivery] += 1
-        by_adversary[cfg.adversary] += 1
-        by_faults[cfg.faults] += 1
-        oracle_n = oracle_instances if k % max(1, oracle_every) == 0 else 0
+    if chaos:
+        # Pre-draw the whole population (the same rng call sequence as the
+        # sequential loop, so artifacts reproduce by (generator_version,
+        # seed) regardless of --jobs).
+        cfgs = [random_config(rng, chaos=True) for _ in range(n_configs)]
+        for cfg in cfgs:
+            by_delivery[cfg.delivery] += 1
+            by_adversary[cfg.adversary] += 1
+            by_faults[cfg.faults] += 1
 
-        if chaos:
+        def _oracle_n(k):
+            return oracle_instances if k % max(1, oracle_every) == 0 else 0
+
+        pending = []
+        for k in range(n_configs):
             prev = records.get(str(k))
             if prev is not None and prev.get("status") != "skipped":
                 resumed += 1
-                rec = prev
             else:
-                rec = _run_chaos_config(
-                    cfg, oracle_n, timeout_s=timeout_s, backoff_s=backoff_s,
-                    inject=(inject or {}).get(k))
-                rec["index"] = k
+                pending.append(k)
+
+        def _work(k):
+            rec = _run_chaos_config(
+                cfgs[k], _oracle_n(k), timeout_s=timeout_s,
+                backoff_s=backoff_s, inject=(inject or {}).get(k))
+            rec["index"] = k
+            return k, rec
+
+        done_count = 0
+
+        def _merge(k, rec):
+            nonlocal done_count, oracle_checked
+            cfg = cfgs[k]
+            if rec is not None:  # freshly run (resumed records pre-merged)
                 records[str(k)] = rec
                 if ckpt_path is not None:
                     _save_checkpoint(ckpt_path, seed, records)
+            rec = records[str(k)]
             # Count only oracle legs that actually ran: the child stamps
             # ``oracle_instances`` after its compare (so resumed records
             # carry their own truth); a skip or a pre-oracle mismatch ran
@@ -225,36 +249,56 @@ def run_soak(n_configs: int, seed: int = 0, oracle_every: int = 10,
                                    "config": dataclasses.asdict(cfg),
                                    "violations": rec["violations"]})
                 progress(f"soak[{k}]: SAFETY VIOLATION {cfg}")
+            done_count += 1
             if (rec["status"] == "ok" and not rec.get("violations")
-                    and (k + 1) % 25 == 0):
-                progress(f"soak[{k + 1}/{n_configs}]: "
+                    and done_count % 25 == 0):
+                progress(f"soak[{done_count}/{n_configs}]: "
                          f"{len(mismatches)} mismatches, "
                          f"{len(violations)} violations so far")
-            continue
 
-        a = numpy_be.run(cfg)
-        b = native_be.run(cfg)
-        ok = (np.array_equal(a.rounds, b.rounds)
-              and np.array_equal(a.decision, b.decision))
-        record = None
-        if not ok:
-            record = mismatch_record(cfg, "numpy_vs_native", a, b,
-                                     names=("numpy", "native"))
-        elif oracle_n:
-            ids = np.arange(min(oracle_n, cfg.instances), dtype=np.int64)
-            c = cpu_be.run(cfg, ids)
-            oracle_checked += 1
-            if not (np.array_equal(a.rounds[: len(ids)], c.rounds)
-                    and np.array_equal(a.decision[: len(ids)], c.decision)):
-                sub = dataclasses.replace(a)
-                sub.rounds, sub.decision = a.rounds[: len(ids)], a.decision[: len(ids)]
-                record = mismatch_record(cfg, "numpy_vs_oracle", sub, c,
-                                         names=("numpy", "oracle"))
-        if record is not None:
-            mismatches.append(record)
-            progress(f"soak[{k}]: MISMATCH {record['leg']} {cfg}")
-        elif (k + 1) % 25 == 0:
-            progress(f"soak[{k + 1}/{n_configs}]: 0 mismatches so far")
+        if jobs <= 1:
+            for k in range(n_configs):
+                _merge(k, None if k not in pending else _work(k)[1])
+        else:
+            import concurrent.futures as _fut
+
+            with _fut.ThreadPoolExecutor(max_workers=jobs) as pool:
+                futs = {pool.submit(_work, k): k for k in pending}
+                for k in sorted(set(range(n_configs)) - set(pending)):
+                    _merge(k, None)
+                for f in _fut.as_completed(futs):
+                    _merge(*f.result())
+    else:
+        for k in range(n_configs):
+            cfg = random_config(rng, chaos=chaos)
+            by_delivery[cfg.delivery] += 1
+            by_adversary[cfg.adversary] += 1
+            by_faults[cfg.faults] += 1
+            oracle_n = oracle_instances if k % max(1, oracle_every) == 0 else 0
+
+            a = numpy_be.run(cfg)
+            b = native_be.run(cfg)
+            ok = (np.array_equal(a.rounds, b.rounds)
+                  and np.array_equal(a.decision, b.decision))
+            record = None
+            if not ok:
+                record = mismatch_record(cfg, "numpy_vs_native", a, b,
+                                         names=("numpy", "native"))
+            elif oracle_n:
+                ids = np.arange(min(oracle_n, cfg.instances), dtype=np.int64)
+                c = cpu_be.run(cfg, ids)
+                oracle_checked += 1
+                if not (np.array_equal(a.rounds[: len(ids)], c.rounds)
+                        and np.array_equal(a.decision[: len(ids)], c.decision)):
+                    sub = dataclasses.replace(a)
+                    sub.rounds, sub.decision = a.rounds[: len(ids)], a.decision[: len(ids)]
+                    record = mismatch_record(cfg, "numpy_vs_oracle", sub, c,
+                                             names=("numpy", "oracle"))
+            if record is not None:
+                mismatches.append(record)
+                progress(f"soak[{k}]: MISMATCH {record['leg']} {cfg}")
+            elif (k + 1) % 25 == 0:
+                progress(f"soak[{k + 1}/{n_configs}]: 0 mismatches so far")
 
     from byzantinerandomizedconsensus_tpu.obs import record
 
@@ -364,6 +408,12 @@ def run_child(cfg_dict: dict, oracle_n: int, inject=None) -> dict:
         os._exit(139)  # simulate a native SIGSEGV death
     if inject == "hang":
         time.sleep(3600)
+    # Opt-in persistent XLA compilation cache (BRC_COMPILATION_CACHE, set by
+    # the parent's --compile-cache): retries and resumes start warm instead
+    # of re-paying the cold jit this subprocess isolation otherwise costs.
+    from byzantinerandomizedconsensus_tpu.backends import batch as _batch
+
+    _batch.maybe_enable_cache_from_env()
     cfg = SimConfig(**cfg_dict).validate()
     from byzantinerandomizedconsensus_tpu.models import invariants
     from byzantinerandomizedconsensus_tpu.utils.devices import (
@@ -422,6 +472,15 @@ def main(argv=None) -> int:
                     help="chaos: base of the exponential retry backoff")
     ap.add_argument("--checkpoint", default=None,
                     help="chaos: checkpoint JSON path (default: OUT.ckpt)")
+    ap.add_argument("--jobs", type=int, default=1,
+                    help="chaos: run up to N config subprocesses in parallel "
+                         "(checkpoint merge stays single-threaded; per-"
+                         "worker timeout/backoff/retry preserved)")
+    ap.add_argument("--compile-cache", default=None, metavar="DIR",
+                    help="chaos: opt-in persistent XLA compilation cache "
+                         "shared by every worker subprocess (exported as "
+                         "BRC_COMPILATION_CACHE) — retries and resumes "
+                         "start warm")
     ap.add_argument("--liveness", action="store_true",
                     help="chaos: embed the spec-§9 liveness-degradation rows "
                          "(tools/divergence.py fault leg) in the artifact")
@@ -446,11 +505,21 @@ def main(argv=None) -> int:
     checkpoint = args.checkpoint
     if args.chaos and checkpoint is None:
         checkpoint = str(out) + ".ckpt"
+    if args.compile_cache:
+        # Workers inherit the environment; the env var (not an extra child
+        # flag) keeps the child protocol stable across resumes.
+        pathlib.Path(args.compile_cache).mkdir(parents=True, exist_ok=True)
+        os.environ["BRC_COMPILATION_CACHE"] = args.compile_cache
     doc = run_soak(args.configs, seed=args.seed,
                    oracle_every=args.oracle_every,
                    oracle_instances=args.oracle_instances,
                    chaos=args.chaos, timeout_s=args.timeout,
-                   backoff_s=args.backoff, checkpoint=checkpoint)
+                   backoff_s=args.backoff, checkpoint=checkpoint,
+                   jobs=max(1, args.jobs))
+    if args.chaos:
+        doc["jobs"] = max(1, args.jobs)
+        if args.compile_cache:
+            doc["compile_cache_dir"] = args.compile_cache
     if args.chaos and args.liveness:
         from byzantinerandomizedconsensus_tpu.tools import divergence
 

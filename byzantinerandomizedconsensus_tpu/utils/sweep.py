@@ -27,35 +27,88 @@ def run_sweep(
     coin: str = "shared",
     delivery: str = PRODUCT_DELIVERY,
     round_cap: int | None = None,
+    batched: bool = False,
     progress=print,
 ) -> dict:
-    """Run (or resume) the sweep; returns {n: summary-with-round-histogram}."""
+    """Run (or resume) the sweep; returns {n: summary-with-round-histogram}.
+
+    ``batched`` routes each shard row through the shape-bucketed lane runner
+    (backends/batch.py) when the backend supports it: sweep points whose n
+    pads to one tier (e.g. 384 with 512; 640/768/896 with 1024) share one
+    compiled program and one dispatch per shard, bit-identically. Checkpoint
+    shards stay per-(n, shard) and resume exactly as before; a batched
+    shard's recorded wall is the dispatch wall split evenly across the lanes
+    it served (per-lane walls do not exist in one fused dispatch).
+    """
+    import dataclasses
+
     be = get_backend(backend)
     eff_cap = DEFAULT_ROUND_CAP if round_cap is None else round_cap
     _warn_stale_shards(out_dir, delivery, eff_cap, progress)
-    out = {}
-    for n in ns:
+
+    def point_cfg(n):
         cfg = sweep_point(n, seed=seed, instances=instances)
         if coin != cfg.coin or delivery != cfg.delivery or \
                 (round_cap is not None and round_cap != cfg.round_cap):
-            import dataclasses
-
             cfg = dataclasses.replace(
                 cfg, coin=coin, delivery=delivery,
                 round_cap=cfg.round_cap if round_cap is None else round_cap,
             ).validate()
-        shards = []
+        return cfg
+
+    ns = list(ns)
+    cfgs = {n: point_cfg(n) for n in ns}
+    shards_by_n: dict = {n: {} for n in ns}
+
+    if batched and hasattr(be, "run_many"):
+        from byzantinerandomizedconsensus_tpu.backends import batch as _batch
+
         for lo in range(0, instances, shard_instances):
             hi = min(lo + shard_instances, instances)
-            if checkpoint.have_shard(out_dir, cfg, lo, hi):
-                shards.append(checkpoint.load_shard(out_dir / checkpoint.shard_name(cfg, lo, hi)))
+            missing = []
+            for n in ns:
+                cfg = cfgs[n]
+                if checkpoint.have_shard(out_dir, cfg, lo, hi):
+                    shards_by_n[n][lo] = checkpoint.load_shard(
+                        out_dir / checkpoint.shard_name(cfg, lo, hi))
+                else:
+                    missing.append(n)
+            if not missing:
                 continue
-            res = be.timed_run(cfg, np.arange(lo, hi, dtype=np.int64))
-            checkpoint.save_shard(out_dir, cfg, res)
-            shards.append(res)
-            progress(f"sweep n={n}: instances [{lo},{hi}) "
-                     f"{res.instances_per_sec:.0f} inst/s")
-        merged = _merge(cfg, shards)
+            ids = np.arange(lo, hi, dtype=np.int64)
+            import time as _time
+
+            t0 = _time.perf_counter()
+            results, _report = _batch.run_many(
+                be, [cfgs[n] for n in missing],
+                inst_ids=[ids] * len(missing))
+            wall = _time.perf_counter() - t0
+            for n, res in zip(missing, results):
+                res.wall_s = wall / len(missing)
+                checkpoint.save_shard(out_dir, cfgs[n], res)
+                shards_by_n[n][lo] = res
+            progress(f"sweep shard [{lo},{hi}) batched over n={missing}: "
+                     f"{(hi - lo) * len(missing) / max(wall, 1e-9):.0f} "
+                     "inst/s aggregate")
+    else:
+        for n in ns:
+            cfg = cfgs[n]
+            for lo in range(0, instances, shard_instances):
+                hi = min(lo + shard_instances, instances)
+                if checkpoint.have_shard(out_dir, cfg, lo, hi):
+                    shards_by_n[n][lo] = checkpoint.load_shard(
+                        out_dir / checkpoint.shard_name(cfg, lo, hi))
+                    continue
+                res = be.timed_run(cfg, np.arange(lo, hi, dtype=np.int64))
+                checkpoint.save_shard(out_dir, cfg, res)
+                shards_by_n[n][lo] = res
+                progress(f"sweep n={n}: instances [{lo},{hi}) "
+                         f"{res.instances_per_sec:.0f} inst/s")
+
+    out = {}
+    for n in ns:
+        shards = [shards_by_n[n][lo] for lo in sorted(shards_by_n[n])]
+        merged = _merge(cfgs[n], shards)
         s = metrics.summary(merged)
         s["round_histogram"] = metrics.round_histogram(merged).tolist()
         out[n] = s

@@ -1,0 +1,283 @@
+"""Config-batched execution (backends/batch.py; docs/PERF.md round 10).
+
+The acceptance bar is bit-match: every lane of a batched dispatch must equal
+the per-config path bit-for-bit — across the fault × adversary × delivery
+grid, with mixed-n padding lanes in one bucket, and with the counter side
+output enabled. Plus the bucket law, the pinned validate_batch rejections,
+the bounded compile-cache LRU (the round-10 fix for the unbounded
+``_compiled_counters`` dict), and the bench_batch tier-1 smoke.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from byzantinerandomizedconsensus_tpu.backends import get_backend
+from byzantinerandomizedconsensus_tpu.backends.batch import (
+    CompileCache, ShapeBucket, lane_tier, n_tier)
+from byzantinerandomizedconsensus_tpu.config import (
+    DELIVERY_KINDS, FAULT_KINDS, SimConfig, validate_batch)
+
+# One protocol pairing per adversary (mirrors tests/test_faults.py).
+_ADV_PROTO = (("none", "benor"), ("crash", "benor"), ("byzantine", "bracha"),
+              ("adaptive", "bracha"), ("adaptive_min", "bracha"))
+
+
+def _cfg(adv, proto, delivery, fault, n=7, f=2, seed=13, **kw):
+    base = dict(protocol=proto, n=n, f=f, instances=4, adversary=adv,
+                coin="local", seed=seed, round_cap=32, delivery=delivery,
+                faults=fault)
+    base.update(kw)
+    return SimConfig(**base).validate()
+
+
+def _lanes(adv, proto, delivery, fault):
+    """Three lanes of one bucket: varying f, seed and (mixed-n padding) n."""
+    return [
+        _cfg(adv, proto, delivery, fault),
+        _cfg(adv, proto, delivery, fault, f=1, seed=99, instances=6),
+        _cfg(adv, proto, delivery, fault, n=6, f=1, seed=7, instances=3),
+    ]
+
+
+def _assert_lanes_match_numpy(cfgs, results):
+    for cfg, res in zip(cfgs, results):
+        ref = get_backend("numpy").run(cfg)
+        np.testing.assert_array_equal(ref.rounds, res.rounds)
+        np.testing.assert_array_equal(ref.decision, res.decision)
+
+
+# ---------------------------------------------------------------------------
+# bucket law + validate_batch (no jax involved)
+
+
+def test_bucket_and_lane_tiers():
+    assert n_tier(4) == 4 and n_tier(5) == 8 and n_tier(8) == 8
+    assert n_tier(40) == 64 and n_tier(1024) == 1024 and n_tier(1025) == 2048
+    assert lane_tier(1) == 1 and lane_tier(3) == 4 and lane_tier(8) == 8
+    a = ShapeBucket.of(_cfg("none", "benor", "urn2", "none", n=5, seed=1))
+    b = ShapeBucket.of(_cfg("none", "benor", "urn2", "none", n=7, f=1,
+                            seed=2))
+    assert a == b and a.n_pad == 8  # mixed n, one tier -> one bucket
+    # packing version follows the tier members, and tiers never straddle the
+    # n=1024 packing edge by construction of N_TIERS.
+    assert ShapeBucket.of(SimConfig(protocol="bracha", n=2048, f=3,
+                                    delivery="urn2").validate()
+                          ).pack_version == 2
+
+
+def test_validate_batch_rejects_mixed_delivery():
+    cfgs = [_cfg("none", "benor", "urn2", "none"),
+            _cfg("none", "benor", "urn3", "none")]
+    with pytest.raises(ValueError,
+                       match="one lane bucket runs one delivery law"):
+        validate_batch(cfgs)
+
+
+def test_validate_batch_rejects_mixed_pack_versions():
+    cfgs = [SimConfig(protocol="bracha", n=512, f=2, delivery="urn2").validate(),
+            SimConfig(protocol="bracha", n=2048, f=2, delivery="urn2").validate()]
+    with pytest.raises(ValueError, match=r"packing versions v1 and v2"):
+        validate_batch(cfgs)
+
+
+def test_run_batch_rejects_multiple_buckets():
+    jb = get_backend("jax")
+    cfgs = [_cfg("none", "benor", "urn2", "none"),
+            _cfg("crash", "benor", "urn2", "none")]
+    with pytest.raises(ValueError, match="use run_many"):
+        jb.run_batch(cfgs)
+
+
+def test_compile_cache_lru_bounded_eviction():
+    cache = CompileCache(max_entries=2)
+    built = []
+    for key in ("a", "b", "a", "c", "c"):
+        cache.get(key, lambda k=key: built.append(k) or k)
+    # a, b compiled; a hit; c compiled evicting b (LRU); c hit.
+    assert built == ["a", "b", "c"]
+    s = cache.stats()
+    assert s["compiles"] == 3 and s["hits"] == 2 and s["evictions"] == 1
+    assert s["entries"] == 2 and s["max_entries"] == 2
+
+
+# ---------------------------------------------------------------------------
+# bit-match: batched lanes vs the per-config path
+
+
+def test_batch_bitmatch_tier1_sample():
+    """Covering sample over (fault, delivery) with rotating adversaries —
+    every fault kind and every delivery law once, 3 lanes each (one a
+    mixed-n padding lane), vs numpy (which existing tier-1 legs pin
+    bit-identical to per-config jax). The full 16-cell grid runs as the
+    slow-marked variant below."""
+    jb = get_backend("jax")
+    cells = [(FAULT_KINDS[i], DELIVERY_KINDS[j])
+             for i, j in ((0, 0), (1, 1), (2, 3), (3, 2))]
+    for i, (fault, delivery) in enumerate(cells):
+        adv, proto = _ADV_PROTO[i % len(_ADV_PROTO)]
+        cfgs = _lanes(adv, proto, delivery, fault)
+        _assert_lanes_match_numpy(cfgs, jb.run_batch(cfgs))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("delivery", DELIVERY_KINDS)
+@pytest.mark.parametrize("fault", FAULT_KINDS)
+def test_batch_bitmatch_grid_full(fault, delivery):
+    """The full fault × delivery grid with rotating adversaries (16 buckets
+    × 3 lanes) — still run by default, excluded from the tier-1 budget."""
+    jb = get_backend("jax")
+    i = FAULT_KINDS.index(fault) + DELIVERY_KINDS.index(delivery)
+    adv, proto = _ADV_PROTO[i % len(_ADV_PROTO)]
+    cfgs = _lanes(adv, proto, delivery, fault)
+    _assert_lanes_match_numpy(cfgs, jb.run_batch(cfgs))
+
+
+def test_batch_padding_lanes_vs_per_config_jax():
+    """Mixed n in one tier-8 bucket, checked against the *jax* per-config
+    path directly (not just numpy): the padding seam must not shift a single
+    PRF draw."""
+    jb = get_backend("jax")
+    cfgs = [_cfg("byzantine", "bracha", "urn2", "none", n=7, f=2),
+            _cfg("byzantine", "bracha", "urn2", "none", n=5, f=1, seed=3,
+                 instances=5),
+            _cfg("byzantine", "bracha", "urn2", "none", n=8, f=2, seed=4)]
+    batched = jb.run_batch(cfgs)
+    for cfg, res in zip(cfgs, batched):
+        ref = jb.run(cfg)
+        np.testing.assert_array_equal(ref.rounds, res.rounds)
+        np.testing.assert_array_equal(ref.decision, res.decision)
+
+
+def test_run_many_groups_preserve_input_order():
+    jb = get_backend("jax")
+    cfgs = [_cfg("none", "benor", "urn3", "none", n=5, f=1, seed=t,
+                 instances=3 + t) for t in range(3)]
+    cfgs.insert(1, _cfg("none", "benor", "urn3", "none", n=16, f=4, seed=5,
+                        instances=3))
+    results, report = jb.run_many(cfgs)
+    assert [len(r.inst_ids) for r in results] == [3, 3, 4, 5]
+    _assert_lanes_match_numpy(cfgs, results)
+    assert report["buckets"] == 2 and report["configs"] == 4
+    occ = {o["bucket"]: o["configs"] for o in report["occupancy"]}
+    assert sorted(occ.values()) == [1, 3]
+    assert report["compile_cache"]["compiles"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# counters: invariance, pad-exact totals, bucket-keyed LRU (satellite)
+
+
+def test_batch_counters_invariance_and_pad_exact_totals():
+    """Counters-on batched lanes: (rounds, decision) bit-identical to the
+    counter-free per-config path, and totals equal to the numpy counted run
+    — including on a padded lane (n=7 inside the tier-8 program)."""
+    jb = get_backend("jax")
+    cfgs = [_cfg("adaptive", "bracha", "urn2", "partition", seed=3,
+                 coin="shared", instances=5),
+            _cfg("adaptive", "bracha", "urn2", "partition", f=1, seed=21,
+                 coin="shared", instances=4)]
+    results, docs = jb.run_batch(cfgs, counters=True)
+    for cfg, res, doc in zip(cfgs, results, docs):
+        ref = get_backend("numpy").run(cfg)
+        np.testing.assert_array_equal(ref.rounds, res.rounds)
+        np.testing.assert_array_equal(ref.decision, res.decision)
+        _, ndoc = get_backend("numpy").run_with_counters(cfg)
+        assert doc["totals"] == ndoc["totals"]
+        assert doc["supported"] and doc["schema"] == ndoc["schema"]
+
+
+def test_run_with_counters_is_bucket_keyed_and_bounded():
+    """The satellite fix: counted configs sharing a bucket share one
+    compiled program (cache hit, no growth), and the cache is the bounded
+    LRU whose stats the run-record carries."""
+    from byzantinerandomizedconsensus_tpu.backends import batch as batch_mod
+    from byzantinerandomizedconsensus_tpu.backends.jax_backend import (
+        JaxBackend)
+
+    jb = JaxBackend()  # fresh instance: cache counters start at zero
+    assert not hasattr(jb, "_compiled_counters")  # the old dict is gone
+    cache = batch_mod.compile_cache(jb)
+    a = _cfg("none", "benor", "urn2", "none", f=2, seed=1, instances=3)
+    b = _cfg("none", "benor", "urn2", "none", f=1, seed=2, instances=3)
+    jb.run_with_counters(a)
+    compiles_after_first = cache.stats()["compiles"]
+    jb.run_with_counters(b)  # same bucket, different lane data
+    s = cache.stats()
+    assert s["compiles"] == compiles_after_first  # no second compile
+    assert s["hits"] >= 1
+    assert s["entries"] <= s["max_entries"]
+    assert jb.compile_cache_stats() == s
+
+
+# ---------------------------------------------------------------------------
+# fused superset lanes (the sparse-grid lever)
+
+
+def test_fused_lanes_bitmatch_mixed_axes():
+    """One fused bucket per (protocol, delivery, tier): adversary kind,
+    fault kind, coin, init and round_cap all ride as lane codes — every
+    lane bit-identical to the per-config numpy path. Two buckets compile
+    here (bracha/urn2 with four mixed lanes incl. a padding lane, and
+    benor/keys with the Byzantine equivocation-matrix case)."""
+    jb = get_backend("jax")
+    groups = [
+        [  # bracha + urn2 tier: mixed adversary/faults/coin/init/cap/n
+            _cfg("byzantine", "bracha", "urn2", "partition", coin="shared",
+                 init="all1", round_cap=24),
+            _cfg("adaptive", "bracha", "urn2", "none", f=1, seed=5,
+                 coin="shared", round_cap=48),
+            _cfg("none", "bracha", "urn2", "omission", n=5, f=1, seed=9,
+                 init="split", crash_window=2),
+            _cfg("adaptive_min", "bracha", "urn2", "recover", f=1, seed=3,
+                 coin="shared", crash_window=8, instances=6),
+        ],
+        [  # benor + keys tier: the (B, R, n) equivocation superset case
+            _cfg("byzantine", "benor", "keys", "none", n=6, f=1, seed=2),
+            _cfg("crash", "benor", "keys", "recover", seed=4,
+                 crash_window=2),
+            _cfg("adaptive", "benor", "keys", "none", n=11, f=2, seed=7,
+                 round_cap=48),
+            _cfg("none", "benor", "keys", "partition", f=1, seed=8,
+                 init="all0"),
+        ],
+    ]
+    for cfgs in groups:
+        results, report = jb.run_fused(cfgs)
+        _assert_lanes_match_numpy(cfgs, results)
+    assert report["mode"] == "fused"
+
+
+def test_fused_buckets_collapse_axes():
+    from byzantinerandomizedconsensus_tpu.backends.batch import (
+        FUSED_SMALL_TIER, FusedBucket)
+
+    a = FusedBucket.of(_cfg("none", "benor", "urn3", "none", n=4, f=1))
+    b = FusedBucket.of(_cfg("adaptive_min", "benor", "urn3", "omission",
+                            n=39, f=2, seed=9, coin="shared", init="split",
+                            round_cap=128))
+    assert a == b and a.n_pad == FUSED_SMALL_TIER
+
+
+# ---------------------------------------------------------------------------
+# tier-1 smoke: a 4-config bucket end-to-end through bench_batch
+
+
+def test_bench_batch_smoke_runs_a_bucket_end_to_end(tmp_path, capsys):
+    from byzantinerandomizedconsensus_tpu.obs import record
+    from byzantinerandomizedconsensus_tpu.tools import bench_batch
+
+    out = tmp_path / "batch_smoke.json"
+    rc = bench_batch.main(["--smoke", "--configs", "3",
+                           "--out", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert record.validate_record(doc) == []
+    assert doc["kind"] == "bench_batch"
+    assert doc["record_revision"] >= 1  # schema v1.1
+    dense = doc["legs"]["dense_bucket"]
+    assert dense["lanes"] == 4 and dense["bit_identical"]
+    assert doc["legs"]["batched"]["mismatches"] == 0
+    assert doc["legs"]["batched"]["violations"] == 0
+    assert "compile_cache" in doc and "compiles" in doc["compile_cache"]

@@ -61,6 +61,37 @@ def test_ledger_report_renders(capsys):
     out = capsys.readouterr().out
     assert "0 parse errors" in out
     assert "device-keyed chain" in out
+    # Round 10: the committed batch A/B carries compile-cache stats, so the
+    # report must print the schema-v1.1 columns.
+    assert "compile-cache columns" in out
+    assert "artifacts/batch_r10.json" in out
+
+
+def test_census_includes_batch_artifact():
+    """The round-10 batch A/B artifact: scanned, parsed, zero mismatches,
+    the ≥3× chaos-grid wall reduction recorded, and the compile-cache
+    columns reconstructed by the ledger."""
+    import pathlib
+
+    from byzantinerandomizedconsensus_tpu.utils.rounds import repo_root
+
+    doc = ledger.build_ledger()
+    assert doc["parse_errors"] == []
+    rows = {r["artifact"]: r for r in doc["compile_cache_rows"]}
+    assert "artifacts/batch_r10.json" in rows
+    row = rows["artifacts/batch_r10.json"]
+    assert isinstance(row["compiles"], int) and row["compiles"] >= 1
+    assert isinstance(row["hits"], int)
+
+    batch = json.loads(
+        (pathlib.Path(repo_root()) / "artifacts/batch_r10.json").read_text())
+    assert batch["kind"] == "bench_batch"
+    assert record.validate_record(batch) == []
+    assert batch["record_revision"] >= 1  # schema v1.1
+    assert batch["legs"]["batched"]["mismatches"] == 0
+    assert batch["legs"]["batched"]["violations"] == 0
+    assert batch["legs"]["dense_bucket"]["bit_identical"] is True
+    assert batch["summary"]["speedup_batched_vs_per_config"] >= 3.0
 
 
 def test_ledger_synthetic_chain_and_parse_errors(tmp_path):

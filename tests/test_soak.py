@@ -95,9 +95,11 @@ def test_soak_reports_mismatch_instead_of_raising(monkeypatch):
 def test_chaos_smoke_subprocess_leg(tmp_path):
     """The deterministic tier-1 chaos smoke: 8 seeded configs, each run in a
     real subprocess (numpy-vs-jax + oracle subsample + safety invariants) —
-    zero mismatches, zero violations, zero skips."""
+    zero mismatches, zero violations, zero skips. Runs under ``--jobs 2``
+    (round 10): the population is pre-drawn, so the worker pool must report
+    the exact same census the sequential path would."""
     doc = soak.run_soak(8, seed=123, oracle_every=4, oracle_instances=2,
-                        chaos=True, timeout_s=600,
+                        chaos=True, timeout_s=600, jobs=2,
                         checkpoint=str(tmp_path / "ck.json"),
                         progress=lambda *a: None)
     assert doc["configs"] == 8
@@ -110,6 +112,16 @@ def test_chaos_smoke_subprocess_leg(tmp_path):
     assert sum(doc["by_faults"].values()) == 8
     assert sum(1 for k, v in doc["by_faults"].items()
                if k != "none" and v) >= 2  # fault kinds actually exercised
+
+    # A --jobs run's checkpoint resumes (no subprocesses this time): the
+    # parallel merge wrote every record under the same binding keys.
+    doc2 = soak.run_soak(8, seed=123, oracle_every=4, oracle_instances=2,
+                         chaos=True, timeout_s=600, jobs=3,
+                         checkpoint=str(tmp_path / "ck.json"),
+                         progress=lambda *a: None)
+    assert doc2["resumed_configs"] == 8
+    assert doc2["mismatches"] == [] and doc2["skipped"] == []
+    assert doc2["oracle_subsampled_configs"] == 2
 
 
 def test_chaos_survives_crash_and_hang_and_resumes(tmp_path):
