@@ -76,6 +76,15 @@ def main() -> int:
     # remain for A/B runs.
     backend = sys.argv[2] if len(sys.argv) > 2 else os.environ.get("BENCH_BACKEND", "")
     delivery = os.environ.get("BENCH_DELIVERY", None)
+    # BENCH_COMPACTION=1 (or a policy spelling like "width=4096,segment=1")
+    # swaps in the decision-driven lane-compaction runner
+    # (backends/compaction.py; docs/PERF.md round 11) — bit-identical results,
+    # straggler-free device schedule. The record then carries the schema-v1.2
+    # ``compaction`` block (occupancy, wasted-lane-rounds, policy).
+    compaction_spec = os.environ.get("BENCH_COMPACTION", "")
+    if compaction_spec and compaction_spec != "0" and not backend:
+        backend = ("jax_compact" if compaction_spec == "1"
+                   else f"jax_compact:{compaction_spec}")
     if not backend:
         import jax
 
@@ -170,9 +179,32 @@ def main() -> int:
     # stays where BENCH_r1-r5 consumers expect it.
     from byzantinerandomizedconsensus_tpu.obs import record as obs_record
 
+    # Schema v1.2 (obs/record.py): the compaction block whenever the run
+    # went through the compacted lane grid (jax_compact backend) — the
+    # straggler-metric leg of the round-11 runner rides the same one-line
+    # artifact. The plain per-chunk path instead reports the standard
+    # wasted-lane metric (utils/metrics.py) computed from its own rounds
+    # output and chunk size, so BENCH_r11+ always carries the occupancy
+    # story, compacted or not.
+    compaction = obs_record.compaction_block(be)
+    from byzantinerandomizedconsensus_tpu.utils import metrics as _metrics
+
+    chunk = be._chunk_size(cfg) if hasattr(be, "_chunk_size") else None
+    straggler = ({
+        "chunk": chunk,
+        "wasted_lane_fraction": _metrics.wasted_lane_fraction(
+            res.rounds, chunk),
+        "mean_max_rounds_per_chunk": round(_metrics.mean_max_rounds_per_chunk(
+            res.rounds, chunk), 4),
+    } if chunk else {})
+
     print(json.dumps({
         "record_version": obs_record.RECORD_VERSION,
+        "record_revision": obs_record.RECORD_REVISION,
         "kind": "bench",
+        # Top-level env fingerprint (schema v1+ proper): BENCH_r1-r10
+        # consumers keep reading the legacy detail.env copy below.
+        "env": obs_record.env_fingerprint(),
         "metric": "consensus_instances_per_sec@n512_f170_shared_coin",
         "value": round(inst_per_sec, 1),
         "unit": "instances/s",
@@ -192,9 +224,11 @@ def main() -> int:
                {"device_busy_error": dev.get("error", "?")}),
             "mean_rounds_to_decision": round(float(res.rounds.mean()), 4),
             "undecided": undecided,
+            **straggler,
             **({"counters": counters} if counters is not None else {}),
             "env": obs_record.env_fingerprint(),
         },
+        **({"compaction": compaction} if compaction is not None else {}),
     }))
     return 0
 

@@ -48,6 +48,19 @@ def _jax_pallas():
     return JaxBackend(kernel="pallas")
 
 
+def _jax_compact(policy: str = ""):
+    """``jax_compact[:<policy>]`` — the decision-driven lane-compaction
+    runner (backends/compaction.py; docs/PERF.md round 11): bit-identical to
+    ``jax``, straggler-free device schedule. Policy spelling:
+    ``width=4096,segment=2,threshold=0.25`` (any subset)."""
+    from byzantinerandomizedconsensus_tpu.backends.compaction import (
+        CompactionPolicy)
+    from byzantinerandomizedconsensus_tpu.backends.jax_backend import (
+        CompactedJaxBackend)
+
+    return CompactedJaxBackend(policy=CompactionPolicy.parse(policy))
+
+
 def _native(n_threads: str = "0"):
     """``native`` or ``native:<threads>`` — the C++ core (native/simcore.cpp)."""
     from byzantinerandomizedconsensus_tpu.backends.native_backend import NativeBackend
@@ -82,6 +95,7 @@ register_backend("jax", _jax)
 register_backend("jax_cpu", _jax_cpu)
 register_backend("jax_sharded", _jax_sharded)
 register_backend("jax_pallas", _jax_pallas)
+register_backend("jax_compact", _jax_compact)
 register_backend("native", _native)
 register_backend("virtual", _virtual)
 

@@ -449,7 +449,7 @@ def _empty_result(cfg, ids):
 
 
 def run_many(backend, cfgs: Sequence[SimConfig], inst_ids=None,
-             counters: bool = False, progress=None):
+             counters: bool = False, progress=None, compaction=None):
     """Group arbitrary configs by shape bucket and run each group batched.
 
     Returns ``(results, report)`` with ``results`` in input order and
@@ -457,6 +457,14 @@ def run_many(backend, cfgs: Sequence[SimConfig], inst_ids=None,
     backend's compile-cache stats (the run-record ``batch`` payload).
     ``inst_ids`` is an optional per-config list of instance-id arrays.
     With ``counters``, returns ``(results, docs, report)``.
+
+    ``compaction``: a :class:`~.compaction.CompactionPolicy` routes each
+    bucket group through the decision-driven compacted lane grid instead of
+    the vmapped config lanes — every (config, instance) pair of a bucket
+    feeds ONE shared queue, so lanes freed by one config's fast instances
+    are refilled with the next config's (queue-fed lane recycling across
+    configs; docs/PERF.md round 11). Bit-identical either way; the report
+    gains the run-record ``compaction`` block (obs/record.py schema v1.2).
     """
     cfgs = [c.validate() for c in cfgs]
     groups: OrderedDict = OrderedDict()
@@ -466,26 +474,49 @@ def run_many(backend, cfgs: Sequence[SimConfig], inst_ids=None,
     results = [None] * len(cfgs)
     docs = [None] * len(cfgs)
     occupancy = []
+    compaction_stats = []
     for bucket, idxs in groups.items():
         if progress is not None:
             progress(f"batch bucket {bucket.label()}: {len(idxs)} config(s)")
-        out = run_batch(backend, [cfgs[i] for i in idxs],
-                        inst_ids=(None if inst_ids is None
-                                  else [inst_ids[i] for i in idxs]),
-                        counters=counters)
-        group_res, group_docs = out if counters else (out, None)
+        group_ids = (None if inst_ids is None
+                     else [inst_ids[i] for i in idxs])
+        if compaction is not None:
+            from byzantinerandomizedconsensus_tpu.backends import (
+                compaction as _compaction)
+
+            group = [cfgs[i] for i in idxs]
+            ids_list = [
+                backend._resolve_inst_ids(
+                    c, None if group_ids is None else group_ids[j])
+                for j, c in enumerate(group)]
+            group_res, group_docs, stats = _compaction.run_bucket(
+                backend, bucket, group, ids_list, policy=compaction,
+                counters=counters, progress=progress)
+            compaction_stats.append(stats)
+            occupancy.append({"bucket": bucket.label(), "configs": len(idxs),
+                              "lane_tier": stats["width"],
+                              "compaction": stats})
+        else:
+            out = run_batch(backend, [cfgs[i] for i in idxs],
+                            inst_ids=group_ids, counters=counters)
+            group_res, group_docs = out if counters else (out, None)
+            occupancy.append({"bucket": bucket.label(), "configs": len(idxs),
+                              "lane_tier": lane_tier(len(idxs))})
         for j, i in enumerate(idxs):
             results[i] = group_res[j]
             if counters:
                 docs[i] = group_docs[j]
-        occupancy.append({"bucket": bucket.label(), "configs": len(idxs),
-                          "lane_tier": lane_tier(len(idxs))})
     report = {
         "buckets": len(groups),
         "configs": len(cfgs),
         "occupancy": occupancy,
         "compile_cache": compile_cache(backend).stats(),
     }
+    if compaction_stats:
+        from byzantinerandomizedconsensus_tpu.backends import (
+            compaction as _compaction)
+
+        report["compaction"] = _compaction.merge_stats(compaction_stats)
     if counters:
         return results, docs, report
     return results, report
@@ -644,13 +675,17 @@ def _run_fused_lanes(bucket: FusedBucket, keys, fs, wins, neffs, caps,
 
 
 def run_fused(backend, cfgs: Sequence[SimConfig], inst_ids=None,
-              progress=None):
+              progress=None, compaction=None):
     """Run arbitrary configs through fused superset lanes — grouped only by
     (protocol, delivery, tier, pack version). Bit-identical per lane to the
     per-config path; no counter leg (the counter schema is a static function
     of the fault kind, which is lane data here).
 
-    Returns ``(results, report)`` like :func:`run_many`.
+    Returns ``(results, report)`` like :func:`run_many`. ``compaction``
+    routes each fused bucket through the compacted lane grid (one queue per
+    bucket, instance-granular lanes carrying the folded-axis codes as lane
+    operands — docs/PERF.md round 11): a sparse heterogeneous grid then
+    recycles lanes across *configs* as well as instances.
     """
     if backend.kernel != "xla":
         raise ValueError(
@@ -665,6 +700,7 @@ def run_fused(backend, cfgs: Sequence[SimConfig], inst_ids=None,
         groups.setdefault(FusedBucket.of(c), []).append(i)
     results = [None] * len(cfgs)
     occupancy = []
+    compaction_stats = []
     cache = compile_cache(backend)
     for bucket, idxs in groups.items():
         if progress is not None:
@@ -678,6 +714,21 @@ def run_fused(backend, cfgs: Sequence[SimConfig], inst_ids=None,
         if max_i == 0:
             for j, i in enumerate(idxs):
                 results[i] = _empty_result(group[j], ids_list[j])
+            continue
+        if compaction is not None:
+            from byzantinerandomizedconsensus_tpu.backends import (
+                compaction as _compaction)
+
+            group_res, _docs, stats = _compaction.run_bucket(
+                backend, bucket, group, ids_list, policy=compaction,
+                counters=False, progress=progress)
+            for j, i in enumerate(idxs):
+                results[i] = group_res[j]
+            compaction_stats.append(stats)
+            occupancy.append({"bucket": bucket.label(),
+                              "configs": len(idxs),
+                              "lane_tier": stats["width"],
+                              "compaction": stats})
             continue
         lanes = len(group)
         l_pad = lane_tier(lanes)
@@ -724,6 +775,11 @@ def run_fused(backend, cfgs: Sequence[SimConfig], inst_ids=None,
         "occupancy": occupancy,
         "compile_cache": cache.stats(),
     }
+    if compaction_stats:
+        from byzantinerandomizedconsensus_tpu.backends import (
+            compaction as _compaction)
+
+        report["compaction"] = _compaction.merge_stats(compaction_stats)
     return results, report
 
 

@@ -1,0 +1,551 @@
+"""Decision-driven lane compaction — continuous batching at the instance axis
+(docs/PERF.md round 11).
+
+The jit'd ``lax.while_loop`` chunk runner (backends/jax_backend.py::_run_chunk)
+makes every instance in a chunk pay the chunk's **max** rounds-to-decision:
+docs/PERF.md measures mean max-rounds/chunk at 2.08 against 1.42 mean rounds
+at the headline operating point — a ~1.5x straggler tax that is also why the
+chunk size is capped at 2048. Inference servers solved the same problem with
+*continuous batching*: retire finished sequences, refill their slots, keep
+the device at fixed occupancy. This module applies that idiom at the instance
+axis:
+
+- the round loop runs in short **segments** (``CompactionPolicy.segment``
+  rounds per dispatch) over a fixed-width lane grid, one instance per lane,
+  each lane carrying its own round counter ``r`` — lanes at different global
+  rounds coexist in one dispatch;
+- after each segment the host fetches only the tiny per-lane
+  ``(finished, rounds, decision)`` surface; when the retired fraction of the
+  grid crosses ``refill_threshold`` (and a queue of pending instances
+  exists), survivors are **compacted** (gathered by lane permutation) and the
+  freed lanes **refilled** from the queue — all on device, inside the same
+  compiled step program;
+- once the queue is dry the **drain** variant of the program (segment length
+  = the round cap) runs the stragglers to completion in one dispatch: the
+  per-lane loop conditions stop it the moment the last lane decides, so the
+  tail costs exactly one straggler tail for the whole run instead of one per
+  chunk.
+
+Bit-identity to ``_run_chunk`` is the law. It holds by construction: the PRF
+addresses every draw by *coordinates* ``(key, instance, round, step, ...)``
+(spec §2), and a lane's round counter is the instance's own round index — so
+which lane, segment, or refill generation an instance lands in never enters
+any draw or any threshold. The per-lane state update, decision predicate and
+extraction are the same models/ functions ``_run_chunk`` calls, vmapped over
+lanes instead of batched over a chunk axis (tests/test_compaction.py asserts
+bit-identity across the fault x adversary x delivery grid, with mixed-n
+padding lanes and with counters on).
+
+The lane grid speaks the round-10 bucket language (backends/batch.py): lane
+operands are ``(key, f, crash_window, n_eff)`` — so one compiled step program
+serves every config of a :class:`~.batch.ShapeBucket`, and ``run_many`` /
+``run_fused`` feed a whole bucket's configs through ONE shared queue
+(``compaction=`` policy): lanes freed by one config's instances are refilled
+with the next config's, keeping occupancy high across config boundaries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu.backends.batch import (
+    ADV_CODES, COIN_CODES, FAULT_CODES, INIT_CODES, FusedBucket,
+    FusedLaneConfig, LaneConfig, ShapeBucket, _chunk_instances, _PadAdversary,
+    compile_cache, lane_tier)
+from byzantinerandomizedconsensus_tpu.ops import prf
+
+
+@dataclasses.dataclass(frozen=True)
+class CompactionPolicy:
+    """The decision-driven refill law.
+
+    ``width``: lanes resident on device (None = the backend's chunk-sizing
+    law for the bucket, the like-for-like A/B width; compaction removes the
+    straggler pressure that capped chunks at 2048, so larger widths are now
+    profitable — tools/bench_compaction.py sweeps this). Rounded to the next
+    power of two so nearby runs share programs.
+
+    ``segment``: rounds per device dispatch between refill opportunities.
+    Small segments react faster (retired lanes idle at most ``segment - 1``
+    rounds before a refill can reclaim them) but pay more host round-trips.
+
+    ``refill_threshold``: compact + refill when at least this fraction of
+    lanes is retired (and pending instances exist). The host always refills
+    when the grid is fully drained, whatever the threshold.
+    """
+
+    width: Optional[int] = None
+    segment: int = 2
+    refill_threshold: float = 0.25
+
+    def validate(self) -> "CompactionPolicy":
+        if self.width is not None and self.width < 1:
+            raise ValueError(f"compaction width={self.width} out of range")
+        if self.segment < 1:
+            raise ValueError(
+                f"compaction segment={self.segment} out of range (>= 1)")
+        if not (0.0 < self.refill_threshold <= 1.0):
+            raise ValueError(
+                f"refill_threshold={self.refill_threshold} out of range "
+                "(0 < t <= 1)")
+        return self
+
+    @classmethod
+    def parse(cls, spec: str) -> "CompactionPolicy":
+        """``"width=4096,segment=2,threshold=0.25"`` (any subset; bare "1"
+        or "" = defaults) — the CLI/env spelling of the policy."""
+        kw: dict = {}
+        if spec and spec not in ("1", "default"):
+            for part in spec.split(","):
+                k, _, v = part.partition("=")
+                k = k.strip()
+                if k in ("width", "w"):
+                    kw["width"] = int(v)
+                elif k in ("segment", "seg", "s"):
+                    kw["segment"] = int(v)
+                elif k in ("threshold", "thr", "t", "refill_threshold"):
+                    kw["refill_threshold"] = float(v)
+                else:
+                    raise ValueError(
+                        f"unknown compaction policy field {k!r}; use "
+                        "width=/segment=/threshold=")
+        return cls(**kw).validate()
+
+    def doc(self) -> dict:
+        """The run-record ``policy`` sub-block (obs/record.py schema v1.2)."""
+        return {"width": self.width, "segment": self.segment,
+                "refill_threshold": self.refill_threshold}
+
+
+def _lane_cfg(bucket, op):
+    """The per-lane config view: strict buckets trace (f, crash_window,
+    n_eff); fused buckets additionally trace the folded-axis codes + cap."""
+    if isinstance(bucket, FusedBucket):
+        return FusedLaneConfig(
+            bucket, f=op["f"], crash_window=op["win"], n_eff=op["neff"],
+            round_cap=op["cap"], adv_code=op["adv"], faults_code=op["flt"],
+            coin_code=op["coin"], init_code=op["init"])
+    return LaneConfig(bucket, f=op["f"], crash_window=op["win"],
+                      n_eff=op["neff"])
+
+
+def _lane_cap(bucket, op):
+    """Round cap per lane: static for strict buckets (part of the bucket),
+    traced lane data for fused ones."""
+    if isinstance(bucket, FusedBucket):
+        return op["cap"]
+    return bucket.round_cap
+
+
+def _host_op_row(bucket, cfg) -> dict:
+    """The host-side lane-operand row for one config (numpy scalars)."""
+    row = {
+        "key": np.asarray(prf.seed_key(cfg.seed), dtype=np.uint32),
+        "f": np.int32(cfg.f),
+        "win": np.uint32(cfg.crash_window),
+        "neff": np.int32(cfg.n),
+    }
+    if isinstance(bucket, FusedBucket):
+        row.update({
+            "cap": np.int32(cfg.round_cap),
+            "adv": np.int32(ADV_CODES[cfg.adversary]),
+            "flt": np.int32(FAULT_CODES[cfg.faults]),
+            "coin": np.int32(COIN_CODES[cfg.coin]),
+            "init": np.int32(INIT_CODES[cfg.init]),
+        })
+    return row
+
+
+def _lane_fns(bucket, counters: bool):
+    """The per-lane building blocks the three compiled programs share.
+
+    ``fresh_one(op, iid)`` does the one-time per-instance work — initial
+    state (spec §3.1) plus the adversary/fault setup draws (spec §3.2/§3.3/
+    §9) — exactly what ``_run_chunk`` computes once per chunk invocation;
+    carrying the products in the lane carry keeps the hot segment program
+    free of it (a straggler-tax fix must not re-tax every segment).
+
+    ``lane_segment(...)`` runs up to ``seg`` rounds of ONE lane from its own
+    round counter ``r0``. Under vmap, jax batches the ``while_loop`` to "run
+    while any lane's condition holds, freeze finished lanes' carries" — the
+    chunk runner's frozen-decided-instance semantics, per lane.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from byzantinerandomizedconsensus_tpu.models import (
+        benor, bracha, state as state_mod)
+    from byzantinerandomizedconsensus_tpu.obs import counters as _c
+
+    round_body = (benor.round_body if bucket.protocol == "benor"
+                  else bracha.round_body)
+
+    def lane_adv(op, cfg):
+        pad = jnp.arange(bucket.n_pad, dtype=jnp.int32) >= cfg.n_eff
+        return _PadAdversary(cfg, pad)
+
+    def fresh_one(op, iid):
+        cfg = _lane_cfg(bucket, op)
+        adv = lane_adv(op, cfg)
+        st = state_mod.init_state(cfg, op["key"], iid[None], xp=jnp)
+        setup = adv.setup(op["key"], iid[None], xp=jnp)
+        return ({k: v[0] for k, v in st.items()},
+                jax.tree_util.tree_map(lambda v: v[0], setup))
+
+    def lane_segment(seg, op, iid, r0, st_row, setup_row, done0, acc0=None):
+        cfg = _lane_cfg(bucket, op)
+        cap = _lane_cap(bucket, op)
+        adv = lane_adv(op, cfg)
+        key = op["key"]
+        ids = iid[None]
+        setup = jax.tree_util.tree_map(lambda v: v[None], setup_row)
+        faulty = setup["faulty"]
+        st = {k: v[None] for k, v in st_row.items()}
+        init = (jnp.int32(0), st, done0) + (
+            ((acc0[None],) if counters else ()))
+
+        def cond(carry):
+            k, _, done = carry[:3]
+            return (k < seg) & (done < 0) & (r0 + k < cap)
+
+        def body(carry):
+            k, st, done = carry[:3]
+            rr = r0 + k
+            obs = {} if counters else None
+            st2 = round_body(cfg, key, ids, rr, st, adv, setup, xp=jnp,
+                             counts_fn=None, obs=obs)
+            out = (k + 1, st2)
+            if counters:
+                acc = _c.accumulate(carry[3],
+                                    _c.round_increments(cfg, obs, jnp),
+                                    (done < 0)[None], cfg, jnp)
+            done_now = state_mod.all_correct_decided(st2, faulty, xp=jnp)[0]
+            done = jnp.where((done < 0) & done_now, rr + 1, done)
+            return out + (done,) + ((acc,) if counters else ())
+
+        final = jax.lax.while_loop(cond, body, init)
+        k, st, done = final[:3]
+        r1 = r0 + k
+        done_b = done >= 0
+        finished = done_b | (r1 >= cap)
+        rounds = jnp.where(done_b, done, cap).astype(jnp.int32)
+        decision = state_mod.extract_decision(st, faulty, done_b[None],
+                                              xp=jnp)[0]
+        st_out = {kk: v[0] for kk, v in st.items()}
+        out = (r1, st_out, done, rounds, decision, finished)
+        if counters:
+            out += (final[3][0],)
+        return out
+
+    return fresh_one, lane_segment
+
+
+# Carry layout: (ops, iids, r, st, setup, done[, acc]).
+def _n_carry(counters: bool) -> int:
+    return 7 if counters else 6
+
+
+def _make_init(bucket, counters: bool):
+    """The grid-fill program: build the whole carry fresh from a W-row
+    operand block. ``init(ops, iids, n_fill) -> carry``; slots at index
+    ``>= n_fill`` start already-retired (``done = 0``) so the segment loop
+    never runs them (queue shorter than the grid)."""
+    import jax
+    import jax.numpy as jnp
+
+    from byzantinerandomizedconsensus_tpu.obs import counters as _c
+
+    fresh_one, _ = _lane_fns(bucket, counters)
+
+    def init(ops, iids, n_fill):
+        W = iids.shape[0]
+        st, setup = jax.vmap(fresh_one)(ops, iids)
+        done = jnp.where(jnp.arange(W, dtype=jnp.int32) < n_fill,
+                         jnp.int32(-1), jnp.int32(0))
+        carry = (ops, iids, jnp.zeros(W, dtype=jnp.int32), st, setup, done)
+        if counters:
+            n_c = len(_c.counter_names(_StaticCfgView(bucket)))
+            carry += (jnp.zeros((W, n_c, 2), dtype=jnp.uint32),)
+        return carry
+
+    return init
+
+
+def _make_refill(bucket, F: int, counters: bool):
+    """The compaction program: gather survivors, splice a fresh block in.
+
+    ``refill(perm, n_keep, n_fill, ops_block, iids_block, *carry) ->
+    carry'``. Slot ``i < n_keep`` takes old carry row ``perm[i]`` (survivors
+    packed first); slot ``n_keep + j`` takes fresh block row ``j`` (live for
+    ``j < n_fill``, inert-retired otherwise). The fresh block is ``F`` rows —
+    a power-of-two quantum so the expensive one-time work (init draws, §3.2
+    setup) is paid for the refill size, not the grid width.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    fresh_one, _ = _lane_fns(bucket, counters)
+
+    def refill(perm, n_keep, n_fill, ops_block, iids_block, *carry):
+        W = perm.shape[0]
+        idx = jnp.arange(W, dtype=jnp.int32)
+        keep = idx < n_keep
+        src_new = jnp.clip(idx - n_keep, 0, F - 1)
+        st_f, setup_f = jax.vmap(fresh_one)(ops_block, iids_block)
+        ops, iids, r, st, setup, done = carry[:6]
+
+        def merge(old, fresh_block):
+            return jax.tree_util.tree_map(
+                lambda a, b: jnp.where(
+                    keep.reshape((W,) + (1,) * (a.ndim - 1)),
+                    a[perm], b[src_new]),
+                old, fresh_block)
+
+        out = (merge(ops, ops_block), merge(iids, iids_block),
+               jnp.where(keep, r[perm], jnp.int32(0)),
+               merge(st, st_f), merge(setup, setup_f),
+               jnp.where(keep, done[perm],
+                         jnp.where(idx - n_keep < n_fill, jnp.int32(-1),
+                                   jnp.int32(0))))
+        if counters:
+            acc = carry[6]
+            out += (jnp.where(keep[:, None, None], acc[perm],
+                              jnp.zeros_like(acc)),)
+        return out
+
+    return refill
+
+
+def _make_segment(bucket, seg: int, counters: bool):
+    """The hot program: up to ``seg`` rounds per lane, nothing else.
+    ``segment(*carry) -> carry' + (rounds, decision, finished)``."""
+    import jax
+    from functools import partial as _partial
+
+    _, lane_segment = _lane_fns(bucket, counters)
+
+    def segment(*carry):
+        ops, iids, r, st, setup, done = carry[:6]
+        args = (ops, iids, r, st, setup, done) + (
+            (carry[6],) if counters else ())
+        out = jax.vmap(_partial(lane_segment, seg))(*args)
+        r1, st1, done1, rounds, decision, finished = out[:6]
+        new = (ops, iids, r1, st1, setup, done1)
+        if counters:
+            new += (out[6],)
+        return new + (rounds, decision, finished)
+
+    return segment
+
+
+class _StaticCfgView:
+    """Minimal cfg duck for counter-schema resolution from a bucket (the
+    schema is a static function of protocol/delivery/faults, all bucket
+    statics)."""
+
+    def __init__(self, bucket):
+        self.protocol = bucket.protocol
+        self.delivery = bucket.delivery
+        self.faults = bucket.faults
+
+
+def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
+               counters: bool = False, progress=None):
+    """Run every instance of every config of ONE bucket through the
+    compacted lane grid. Returns ``(results, docs_or_None, stats)`` with
+    ``results`` per-config SimResults bit-identical to the per-chunk path and
+    ``stats`` the run-record ``compaction`` block payload (occupancy,
+    wasted-lane-rounds, refills).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from byzantinerandomizedconsensus_tpu.backends.base import SimResult
+    from byzantinerandomizedconsensus_tpu.obs import counters as _c
+
+    policy = (policy or CompactionPolicy()).validate()
+    if counters and isinstance(bucket, FusedBucket):
+        raise _c.CountersUnsupported(
+            "fused compacted lanes have no counter leg: the counter schema "
+            "is a static function of the fault kind, which is lane data "
+            "here (same rule as run_fused)")
+
+    total = sum(len(ids) for ids in ids_list)
+    if total == 0:
+        results = [SimResult(config=c, inst_ids=i,
+                             rounds=np.empty(0, dtype=np.int32),
+                             decision=np.empty(0, dtype=np.uint8))
+                   for c, i in zip(cfgs, ids_list)]
+        docs = None
+        if counters:
+            docs = [_c.counters_doc(c, _c.finalize(c, _c.zeros(c, 0, np)),
+                                    backend=backend.name) for c in cfgs]
+        return results, docs, {"width": 0, "segments": 0, "refills": 0,
+                               "device_lane_rounds": 0,
+                               "useful_lane_rounds": 0, "occupancy": None,
+                               "wasted_lane_fraction": None,
+                               "policy": policy.doc()}
+
+    base = policy.width or _chunk_instances(
+        bucket, 1, total, backend.chunk_bytes, backend.max_chunk)
+    W = min(lane_tier(base), lane_tier(total))
+
+    # The shared work stream: configs in input order, flattened to parallel
+    # (config index, row position, instance id) arrays with a head pointer.
+    # Queue order never enters any draw (spec §2 coordinates).
+    work_cfg = np.concatenate([np.full(len(ids), ci, dtype=np.int32)
+                               for ci, ids in enumerate(ids_list)])
+    work_pos = np.concatenate([np.arange(len(ids), dtype=np.int64)
+                               for ids in ids_list])
+    work_iid = np.concatenate([np.asarray(ids, dtype=np.uint32)
+                               for ids in ids_list])
+    head = 0
+    cfg_rows = [_host_op_row(bucket, c) for c in cfgs]
+    op_mat = {k: np.stack([row[k] for row in cfg_rows])
+              for k in cfg_rows[0]}  # (n_cfgs, ...) per operand
+    n_counters = len(_c.counter_names(cfgs[0])) if counters else 0
+
+    rounds_out = [np.zeros(len(ids), dtype=np.int32) for ids in ids_list]
+    dec_out = [np.zeros(len(ids), dtype=np.uint8) for ids in ids_list]
+    acc_out = ([np.zeros((len(ids), n_counters, 2), dtype=np.uint32)
+                for ids in ids_list] if counters else None)
+
+    cache = compile_cache(backend)
+    seg = policy.segment
+    drain_seg = max(seg, max(int(c.round_cap) for c in cfgs))
+
+    def init_program():
+        return cache.get(("compact-init", bucket, W, counters),
+                         lambda: jax.jit(_make_init(bucket, counters)))
+
+    def refill_program(F):
+        return cache.get(("compact-refill", bucket, W, F, counters),
+                         lambda: jax.jit(_make_refill(bucket, F, counters)))
+
+    def segment_program(seg_len):
+        return cache.get(("compact-seg", bucket, W, seg_len, counters),
+                         lambda: jax.jit(_make_segment(bucket, seg_len,
+                                                       counters)))
+
+    def block(take, F):
+        """(ops, iids) operand block of F rows: the next ``take`` stream
+        items, padded with row-0 repeats (inert — ``n_fill`` gates them)."""
+        src = np.zeros(F, dtype=np.int32)
+        src[:take] = work_cfg[head:head + take]
+        iids = np.zeros(F, dtype=np.uint32)
+        iids[:take] = work_iid[head:head + take]
+        return ({k: jnp.asarray(v[src]) for k, v in op_mat.items()},
+                jnp.asarray(iids))
+
+    owner_cfg = np.full(W, -1, dtype=np.int32)   # -1 = lane not live
+    owner_pos = np.zeros(W, dtype=np.int64)
+    prev_r = np.zeros(W, dtype=np.int64)
+    segments = refills = 0
+    device_rounds = useful_rounds = 0
+    n_carry = _n_carry(counters)
+
+    # Fill the whole grid, then alternate segment dispatches with
+    # compaction+refill dispatches whenever the retired fraction crosses the
+    # policy threshold (always when the grid fully drains).
+    take = min(W, total)
+    ops_b, iids_b = block(take, W)
+    carry = init_program()(ops_b, iids_b, jnp.int32(take))
+    owner_cfg[:take] = work_cfg[:take]
+    owner_pos[:take] = work_pos[:take]
+    head = take
+
+    while True:
+        fn = segment_program(seg if head < total else drain_seg)
+        out = fn(*carry)
+        carry = out[:n_carry]
+        fetch = jax.device_get(
+            (carry[2],) + out[n_carry:n_carry + 3]
+            + ((carry[6],) if counters else ()))
+        r_h, rounds_h, dec_h, fin_h = fetch[:4]
+        segments += 1
+        trips = np.asarray(r_h, dtype=np.int64) - prev_r
+        device_rounds += int(trips.max()) * W
+        useful_rounds += int(trips.sum())
+        prev_r = np.asarray(r_h, dtype=np.int64)
+        retire = np.asarray(fin_h, dtype=bool) & (owner_cfg >= 0)
+        for ci in np.unique(owner_cfg[retire]):
+            sel = retire & (owner_cfg == ci)
+            rows = owner_pos[sel]
+            rounds_out[ci][rows] = rounds_h[sel]
+            dec_out[ci][rows] = dec_h[sel]
+            if counters:
+                acc_out[ci][rows] = fetch[4][sel]
+        owner_cfg[retire] = -1
+        live = owner_cfg >= 0
+        free = W - int(live.sum())
+        if progress is not None:
+            progress(f"compaction segment {segments}: {W - free}/{W} live, "
+                     f"{total - head} queued")
+        if head >= total:
+            if not live.any():
+                break
+            continue  # queue dry: drain the stragglers, no more refills
+        if free >= W * policy.refill_threshold or not live.any():
+            perm = np.concatenate([np.flatnonzero(live),
+                                   np.flatnonzero(~live)]).astype(np.int32)
+            n_keep = W - free
+            take = min(free, total - head)
+            # The fresh block is always W rows (n_fill gates the live ones):
+            # ONE refill program per bucket, so the warm-up compiles exactly
+            # the timed program set (utils/timing.py discipline).
+            ops_b, iids_b = block(take, W)
+            carry = refill_program(W)(
+                jnp.asarray(perm), jnp.int32(n_keep), jnp.int32(take),
+                ops_b, iids_b, *carry)
+            owner_cfg = np.concatenate(
+                [owner_cfg[perm[:n_keep]], np.full(free, -1, dtype=np.int32)])
+            owner_pos = np.concatenate(
+                [owner_pos[perm[:n_keep]], np.zeros(free, dtype=np.int64)])
+            prev_r = np.concatenate(
+                [prev_r[perm[:n_keep]], np.zeros(free, dtype=np.int64)])
+            sl = slice(n_keep, n_keep + take)
+            owner_cfg[sl] = work_cfg[head:head + take]
+            owner_pos[sl] = work_pos[head:head + take]
+            head += take
+            refills += 1
+
+    results = [SimResult(config=c, inst_ids=i, rounds=r, decision=d)
+               for c, i, r, d in zip(cfgs, ids_list, rounds_out, dec_out)]
+    docs = None
+    if counters:
+        docs = [_c.counters_doc(c, _c.finalize(c, rows),
+                                backend=backend.name)
+                for c, rows in zip(cfgs, acc_out)]
+    stats = {
+        "width": W,
+        "segments": segments,
+        "refills": refills,
+        "device_lane_rounds": device_rounds,
+        "useful_lane_rounds": useful_rounds,
+        "occupancy": (round(useful_rounds / device_rounds, 4)
+                      if device_rounds else None),
+        "wasted_lane_fraction": (round(1.0 - useful_rounds / device_rounds, 4)
+                                 if device_rounds else None),
+        "policy": policy.doc(),
+    }
+    return results, docs, stats
+
+
+def merge_stats(per_bucket: Sequence[dict]) -> dict:
+    """Fold per-bucket compaction stats into the one run-record block
+    (obs/record.py schema v1.2 ``compaction``)."""
+    dev = sum(s["device_lane_rounds"] for s in per_bucket)
+    use = sum(s["useful_lane_rounds"] for s in per_bucket)
+    return {
+        "buckets": len(per_bucket),
+        "segments": sum(s["segments"] for s in per_bucket),
+        "refills": sum(s["refills"] for s in per_bucket),
+        "device_lane_rounds": dev,
+        "useful_lane_rounds": use,
+        "occupancy": round(use / dev, 4) if dev else None,
+        "wasted_lane_fraction": round(1.0 - use / dev, 4) if dev else None,
+        "policy": per_bucket[0]["policy"] if per_bucket else None,
+    }

@@ -111,20 +111,31 @@ class JaxBackend(JitChunkedBackend):
         self.kernel = kernel
 
     def _chunk_size(self, cfg: SimConfig) -> int:
+        # The chunk may never exceed the spec §2 instance-field ceiling of
+        # the config's packing law (v2 narrows instances to 2^16): the cap
+        # used to be independent of the pack law, which left a future
+        # max_chunk bump free to outrun it. validate() rejects configs whose
+        # *total* instances overflow; this clamp keeps the per-dispatch shape
+        # inside the same law by construction.
+        from byzantinerandomizedconsensus_tpu.ops import prf
+
+        pack_cap = (prf.V2_MAX_INSTANCES if cfg.pack_version == 2
+                    else prf.MAX_INSTANCES)
+        max_chunk = min(self.max_chunk, pack_cap)
         if cfg.count_level:
             # No O(B·n²) transient at all — state is O(B·n). Measured optimum
             # at n=512 on v5e is ~2k instances/chunk: beyond that the
             # while-loop straggler cost (whole chunk pays max rounds) outweighs
             # dispatch amortisation.
-            return max(1, min(self.max_chunk, (1 << 20) // max(1, cfg.n)))
+            return max(1, min(max_chunk, (1 << 20) // max(1, cfg.n)))
         if self.kernel == "pallas":
             # The fused kernel keeps the (B,n,n) key tensor VMEM-resident per
             # block — HBM holds only O(B·n) state, so the chunk is sized for
             # dispatch amortisation vs while-loop straggler cost (measured
             # optimum ~4k instances at n=512 on v5e; degrades past 16k).
-            return max(1, min(self.max_chunk, 4096))
+            return max(1, min(max_chunk, 4096))
         per_inst = cfg.n * cfg.n * 4 * 4  # ~4 live (B,n,n) u32-sized transients
-        return max(1, min(self.max_chunk, self.chunk_bytes // per_inst))
+        return max(1, min(max_chunk, self.chunk_bytes // per_inst))
 
     def _make_fn(self, cfg: SimConfig):
         if self.kernel != "xla":
@@ -178,24 +189,30 @@ class JaxBackend(JitChunkedBackend):
                                counters=counters)
 
     def run_many(self, cfgs, inst_ids=None, counters: bool = False,
-                 progress=None):
+                 progress=None, compaction=None):
         """Auto-group arbitrary configs by shape bucket and run each group
         batched; returns ``(results, report)`` (+ counters docs when asked).
-        The fleet-path entry point (soak, divergence, acceptance grids)."""
+        The fleet-path entry point (soak, divergence, acceptance grids).
+        ``compaction`` (a CompactionPolicy) swaps each bucket's config lanes
+        for the compacted instance-lane grid with one shared queue per
+        bucket (backends/compaction.py; docs/PERF.md round 11)."""
         from byzantinerandomizedconsensus_tpu.backends import batch
 
         return batch.run_many(self, cfgs, inst_ids=inst_ids,
-                              counters=counters, progress=progress)
+                              counters=counters, progress=progress,
+                              compaction=compaction)
 
-    def run_fused(self, cfgs, inst_ids=None, progress=None):
+    def run_fused(self, cfgs, inst_ids=None, progress=None, compaction=None):
         """Fused superset lanes for sparse grids (backends/batch.py): only
         (protocol, delivery, tier, pack version) stay baked; adversary kind,
         fault kind, coin, init and round_cap ride as traced lane codes.
-        Bit-identical per lane; the chaos-grid amortization lever."""
+        Bit-identical per lane; the chaos-grid amortization lever.
+        ``compaction`` recycles lanes across configs AND instances of each
+        fused bucket (backends/compaction.py)."""
         from byzantinerandomizedconsensus_tpu.backends import batch
 
         return batch.run_fused(self, cfgs, inst_ids=inst_ids,
-                               progress=progress)
+                               progress=progress, compaction=compaction)
 
     def compile_cache_stats(self) -> dict:
         """The bucket-program LRU counters for run records (obs/record.py
@@ -203,6 +220,35 @@ class JaxBackend(JitChunkedBackend):
         from byzantinerandomizedconsensus_tpu.backends import batch
 
         return batch.compile_cache(self).stats()
+
+    def run_compacted(self, cfg: SimConfig, inst_ids=None,
+                      counters: bool = False, policy=None):
+        """Decision-driven lane compaction (backends/compaction.py; docs/
+        PERF.md round 11): the round loop runs in short segments over a
+        fixed-width lane grid, retired lanes are compacted away and refilled
+        from the pending-instance queue — the continuous-batching idiom at
+        the instance axis. Bit-identical per instance to :meth:`run`
+        (tests/test_compaction.py). Returns ``(SimResult, stats)``, or
+        ``(SimResult, counters_doc, stats)`` with ``counters``; ``stats`` is
+        the run-record ``compaction`` block payload (occupancy,
+        wasted-lane-rounds, refills — obs/record.py schema v1.2)."""
+        from byzantinerandomizedconsensus_tpu.backends import batch, compaction
+        from byzantinerandomizedconsensus_tpu.obs import counters as _counters
+
+        if self.kernel != "xla":
+            raise ValueError(
+                f"compacted lanes require the default 'xla' kernel; "
+                f"kernel={self.kernel!r} compiles per-config programs")
+        cfg = cfg.validate()
+        self._check_config(cfg)
+        ids = self._resolve_inst_ids(cfg, inst_ids)
+        bucket = batch.ShapeBucket.of(cfg, counters=counters)
+        with self._device_ctx():
+            results, docs, stats = compaction.run_bucket(
+                self, bucket, [cfg], [ids], policy=policy, counters=counters)
+        if counters:
+            return results[0], docs[0], stats
+        return results[0], stats
 
     def run_with_counters(self, cfg: SimConfig,
                           inst_ids: Optional[np.ndarray] = None):
@@ -235,3 +281,73 @@ class JaxBackend(JitChunkedBackend):
         results, docs = self.run_batch(
             [cfg], inst_ids=[ids], counters=True)
         return results[0], docs[0]
+
+
+def _floor_pow2(x: int) -> int:
+    t = 1
+    while t * 2 <= x:
+        t <<= 1
+    return t
+
+
+class CompactedJaxBackend(JaxBackend):
+    """``jax_compact[:<policy>]`` — the JaxBackend with the decision-driven
+    lane-compaction runner (backends/compaction.py) as its ``run`` path:
+    bit-identical results, straggler-free device schedule. The optional
+    parameter is the :class:`~.compaction.CompactionPolicy` spelling, e.g.
+    ``jax_compact:width=4096,segment=1,threshold=0.25``.
+
+    The timing discipline (utils/timing.timed_best_of) warms up with a
+    ``_chunk_size``-sized id subset, so ``_chunk_size`` here returns the
+    resolved lane-grid width — the warm-up then compiles exactly the step +
+    drain programs the timed run uses. ``last_stats`` holds the compaction
+    block of the most recent run for record builders (bench.py schema v1.2).
+    """
+
+    name = "jax_compact"
+
+    def __init__(self, policy=None, **kw):
+        from byzantinerandomizedconsensus_tpu.backends.compaction import (
+            CompactionPolicy)
+
+        super().__init__(**kw)
+        self.policy = (policy or CompactionPolicy()).validate()
+        self.last_stats: Optional[dict] = None
+
+    def _resolved_width(self, cfg: SimConfig) -> int:
+        from byzantinerandomizedconsensus_tpu.backends.batch import lane_tier
+
+        if self.policy.width is not None:
+            return lane_tier(self.policy.width)
+        return _floor_pow2(super()._chunk_size(cfg))
+
+    def _chunk_size(self, cfg: SimConfig) -> int:
+        # 2x the grid width: timed_best_of warms up with a subset this
+        # size, which exercises the FULL compiled program set (init, the
+        # hot segment, one compaction+refill, the drain) at the timed
+        # width — a W-sized warm-up would drain immediately and leave the
+        # segment + refill compiles inside the timed window.
+        from byzantinerandomizedconsensus_tpu.ops import prf
+
+        pack_cap = (prf.V2_MAX_INSTANCES if cfg.pack_version == 2
+                    else prf.MAX_INSTANCES)
+        return min(2 * self._resolved_width(cfg), pack_cap)
+
+    def run(self, cfg: SimConfig, inst_ids=None) -> "SimResult":
+        import dataclasses as _dc
+
+        policy = _dc.replace(self.policy, width=self._resolved_width(cfg))
+        res, stats = self.run_compacted(cfg, inst_ids=inst_ids,
+                                        policy=policy)
+        self.last_stats = stats
+        return res
+
+    def run_with_counters(self, cfg: SimConfig,
+                          inst_ids: Optional[np.ndarray] = None):
+        import dataclasses as _dc
+
+        policy = _dc.replace(self.policy, width=self._resolved_width(cfg))
+        res, doc, stats = self.run_compacted(
+            cfg, inst_ids=inst_ids, counters=True, policy=policy)
+        self.last_stats = stats
+        return res, doc

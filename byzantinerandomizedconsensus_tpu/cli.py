@@ -306,17 +306,23 @@ def main(argv=None) -> int:
                         "subprocess-isolated with timeout/retry/checkpoint "
                         "(tools/soak.py --chaos; all further options pass "
                         "through)")
+    sub.add_parser("compaction",
+                   help="decision-driven lane-compaction A/B at the "
+                        "headline shape (tools/bench_compaction.py; all "
+                        "further options pass through)")
 
     if argv is None:
         argv = sys.argv[1:]
-    if argv and argv[0] in ("accept", "slack", "product", "ledger", "chaos"):
+    if argv and argv[0] in ("accept", "slack", "product", "ledger", "chaos",
+                            "compaction"):
         from byzantinerandomizedconsensus_tpu.tools import (
-            acceptance, ledger, product, slack, soak)
+            acceptance, bench_compaction, ledger, product, slack, soak)
 
         if argv[0] == "chaos":
             return soak.main(["--chaos", *argv[1:]])
         tool = {"accept": acceptance, "slack": slack,
-                "product": product, "ledger": ledger}[argv[0]]
+                "product": product, "ledger": ledger,
+                "compaction": bench_compaction}[argv[0]]
         return tool.main(argv[1:])
     args = ap.parse_args(argv)
     if getattr(args, "backend", "").startswith("jax"):

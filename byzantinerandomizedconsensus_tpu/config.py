@@ -154,7 +154,11 @@ class SimConfig:
         if not (0 < self.instances <= max_inst):
             raise ValueError(
                 f"instances={self.instances} out of range (1..{max_inst}) "
-                f"under packing v{self.pack_version} (n={self.n})")
+                f"under packing v{self.pack_version} (n={self.n}): the spec "
+                f"§2 v{self.pack_version} law packs instance ids in "
+                f"{17 if self.pack_version == 1 else 16} bits — chunk sizing "
+                "(backends/jax_backend.py::_chunk_size) is clamped to the "
+                "same ceiling")
         if not (0 < self.round_cap <= max_rounds):
             raise ValueError(
                 f"round_cap={self.round_cap} out of range (1..{max_rounds}) "

@@ -27,6 +27,15 @@ and per-tool ``batch`` payloads carrying bucket occupancy. v1.1 records keep
 the revision in ``record_revision``; :func:`validate_record` accepts both and
 checks the block shapes when present.
 
+Schema v1.2 (round 11) adds the **compaction** block
+(:func:`compaction_block` — the decision-driven lane-compaction runner's
+occupancy, wasted-lane-rounds and refill policy, backends/compaction.py),
+carried by artifacts whose runs went through the compacted lane grid
+(bench.py under BENCH_COMPACTION, tools/bench_compaction.py, batched tools
+with a ``compaction=`` policy). Same compatibility rule: ``record_version``
+stays 1, the revision is declarative, and :func:`validate_record` checks the
+block shape only when present.
+
 tools/ledger.py consumes both this format and the legacy r1–r7 shapes;
 :func:`validate_record` is the schema check the tier-1 tests pin.
 """
@@ -38,8 +47,9 @@ import dataclasses
 import numpy as np
 
 RECORD_VERSION = 1
-# Minor schema revision (v1.1): compile-cache / batch observability fields.
-RECORD_REVISION = 1
+# Minor schema revisions: v1.1 (round 10) compile-cache / batch fields;
+# v1.2 (round 11) the compaction block.
+RECORD_REVISION = 2
 
 
 def env_fingerprint() -> dict:
@@ -163,6 +173,29 @@ def compile_cache_block(backend) -> dict | None:
         return None
 
 
+#: The fields a schema-v1.2 ``compaction`` block must carry (the lane-grid
+#: occupancy accounting of backends/compaction.py::run_bucket/merge_stats).
+COMPACTION_BLOCK_KEYS = ("occupancy", "wasted_lane_fraction", "segments",
+                         "refills", "policy")
+
+
+def compaction_block(stats: dict | None) -> dict | None:
+    """The schema-v1.2 ``compaction`` block from a compacted-runner stats
+    dict (backends/compaction.py), or from a backend object exposing
+    ``last_stats`` (the ``jax_compact`` backend). None in, None out — a
+    record without the block stays a valid v1/v1.1 record."""
+    if stats is None:
+        return None
+    if not isinstance(stats, dict):
+        stats = getattr(stats, "last_stats", None)
+        if stats is None:
+            return None
+    return {k: stats.get(k) for k in
+            ("width", "segments", "refills", "device_lane_rounds",
+             "useful_lane_rounds", "occupancy", "wasted_lane_fraction",
+             "policy") if k in stats}
+
+
 def validate_record(doc: dict) -> list:
     """Schema check: returns a list of problems (empty = valid v1 record)."""
     problems = []
@@ -195,4 +228,12 @@ def validate_record(doc: dict) -> list:
             for key in ("compiles", "hits", "evictions"):
                 if key not in cc:
                     problems.append(f"compile_cache block missing {key!r}")
+    comp = doc.get("compaction")
+    if comp is not None:
+        if not isinstance(comp, dict):
+            problems.append("compaction block is not a dict")
+        else:
+            for key in COMPACTION_BLOCK_KEYS:
+                if key not in comp:
+                    problems.append(f"compaction block missing {key!r}")
     return problems

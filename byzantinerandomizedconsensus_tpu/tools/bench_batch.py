@@ -84,7 +84,8 @@ def leg_subprocess(cfgs, timeout_s: float, jobs: int = 1,
             "statuses": statuses}
 
 
-def leg_batched(cfgs, progress=print, fused: bool = True) -> dict:
+def leg_batched(cfgs, progress=print, fused: bool = True,
+                compaction=None) -> dict:
     """The round-10 discipline: one process, configs grouped into vmapped
     lanes — with the chaos instrument's full differential kept (numpy leg +
     §1 safety invariants + bit-compare per config).
@@ -95,16 +96,22 @@ def leg_batched(cfgs, progress=print, fused: bool = True) -> dict:
     280 configs — the strict law is the *dense*-grid lever, see the
     dense_bucket leg); fusing adversary/faults/coin/init/cap into lane codes
     leaves one program per (protocol, delivery, tier) and is what amortizes
-    here."""
+    here.
+
+    ``compaction`` (a CompactionPolicy) additionally routes each bucket
+    through the round-11 compacted lane grid — instance-granular lanes with
+    one queue per bucket, recycling lanes across configs
+    (backends/compaction.py); the leg then carries the schema-v1.2
+    ``compaction`` block."""
     from byzantinerandomizedconsensus_tpu.models import invariants
 
     jb = get_backend("jax")
     numpy_be = get_backend("numpy")
     t0 = time.perf_counter()
     if fused:
-        results, report = jb.run_fused(cfgs)
+        results, report = jb.run_fused(cfgs, compaction=compaction)
     else:
-        results, report = jb.run_many(cfgs)
+        results, report = jb.run_many(cfgs, compaction=compaction)
     mismatches = 0
     violations = 0
     for cfg, res in zip(cfgs, results):
@@ -125,7 +132,9 @@ def leg_batched(cfgs, progress=print, fused: bool = True) -> dict:
             "mismatches": mismatches, "violations": violations,
             "buckets": report["buckets"],
             "occupancy": report["occupancy"],
-            "compile_cache": report["compile_cache"]}
+            "compile_cache": report["compile_cache"],
+            **({"compaction": report["compaction"]}
+               if "compaction" in report else {})}
 
 
 def leg_dense_bucket(lanes: int = 8, progress=print) -> dict:
@@ -170,6 +179,11 @@ def main(argv=None) -> int:
                     help="worker width for the subprocess-with-jobs leg")
     ap.add_argument("--timeout", type=float, default=soak.CHAOS_TIMEOUT_S)
     ap.add_argument("--dense-lanes", type=int, default=8)
+    ap.add_argument("--compaction", default=None, metavar="POLICY",
+                    help="also run the batched leg through the round-11 "
+                         "compacted lane grid (backends/compaction.py); "
+                         "POLICY e.g. 'width=256,segment=1' or '1' for "
+                         "defaults")
     ap.add_argument("--skip-subprocess", action="store_true",
                     help="skip both subprocess legs (minutes each on the "
                          "full grid)")
@@ -190,6 +204,13 @@ def main(argv=None) -> int:
     legs: dict = {"dense_bucket": leg_dense_bucket(args.dense_lanes,
                                                    progress=progress)}
     legs["batched"] = leg_batched(cfgs, progress=progress)
+    if args.compaction is not None:
+        from byzantinerandomizedconsensus_tpu.backends.compaction import (
+            CompactionPolicy)
+
+        legs["batched_compacted"] = leg_batched(
+            cfgs, progress=progress,
+            compaction=CompactionPolicy.parse(args.compaction))
     if not args.skip_subprocess:
         legs["per_config_subprocess"] = leg_subprocess(
             cfgs, args.timeout, jobs=1, progress=progress)
@@ -208,6 +229,10 @@ def main(argv=None) -> int:
                 base / legs["per_config_subprocess_jobs"]["wall_s"], 2) \
                 if legs["per_config_subprocess_jobs"]["wall_s"] > 0 else None
     summary["dense_bucket_speedup"] = legs["dense_bucket"]["speedup"]
+    if "batched_compacted" in legs and legs["batched_compacted"]["wall_s"]:
+        summary["speedup_compacted_vs_batched"] = round(
+            legs["batched"]["wall_s"]
+            / legs["batched_compacted"]["wall_s"], 2)
 
     from byzantinerandomizedconsensus_tpu.obs import record
 
@@ -234,6 +259,9 @@ def main(argv=None) -> int:
     out.write_text(json.dumps(doc, indent=1) + "\n")
     print(json.dumps({"out": str(out), **summary}))
     bad = legs["batched"]["mismatches"] + legs["batched"]["violations"]
+    if "batched_compacted" in legs:
+        bad += (legs["batched_compacted"]["mismatches"]
+                + legs["batched_compacted"]["violations"])
     return 0 if bad == 0 else 1
 
 

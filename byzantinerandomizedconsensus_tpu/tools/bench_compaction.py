@@ -1,0 +1,223 @@
+"""Decision-driven lane compaction A/B — the round-11 measurement instrument.
+
+Measures the headline shape (config 4 — bracha n=512 f=170, shared coin —
+at 100k instances) under the shipped per-chunk ``lax.while_loop`` runner vs
+the compacted lane grid (backends/compaction.py), per delivery law:
+
+- ``urn2`` (the shipped §4b-v2 product path — the headline leg), and
+- ``urn`` (the §4b cross-check sampler, whose every round costs the full
+  D-draw loop — the cost model under which docs/PERF.md round 1's
+  Σ max-rounds straggler accounting translates 1:1 into device time).
+
+Per leg: warmed best-of-N walls + the device-busy leg or its honest error
+(utils/timing.py — the regression_verdict rule decides which signal a
+speedup claim may key on), a bit-identity assertion against the per-chunk
+result (the A/B must not buy speed by changing results), the per-chunk
+straggler metrics (utils/metrics.wasted_lane_fraction /
+mean_max_rounds_per_chunk — the "before" numbers), and the compacted
+runner's measured occupancy / wasted-lane-rounds (the "after" numbers,
+schema v1.2 ``compaction`` block). A small policy sweep per delivery picks
+the best compacted configuration and keeps every swept point on the record.
+
+Emits a run-record (kind="bench_compaction", schema v1.2) — committed as
+``artifacts/compaction_r11.json``:
+
+    python -m byzantinerandomizedconsensus_tpu.tools.bench_compaction \
+        --out artifacts/compaction_r11.json
+
+The tier-1 smoke (tests/test_compaction.py) runs ``--smoke`` — tiny
+instance counts, 2 repeats, seconds not minutes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu.backends import get_backend
+from byzantinerandomizedconsensus_tpu.backends.compaction import (
+    CompactionPolicy)
+from byzantinerandomizedconsensus_tpu.config import preset
+from byzantinerandomizedconsensus_tpu.utils import metrics
+from byzantinerandomizedconsensus_tpu.utils.rounds import default_artifact
+from byzantinerandomizedconsensus_tpu.utils.timing import (
+    device_busy, regression_verdict, timed_best_of)
+
+#: The default policy sweep per delivery law. The refill threshold is the
+#: regime switch (docs/PERF.md round 11): ~0.25 keeps the grid continuously
+#: mixed (best where round cost is proportional to lane-rounds — §4b urn,
+#: keys), ~0.9 degenerates to generational refills that still absorb the
+#: cross-chunk tail (best for §4b-v2 urn2, whose straggler rounds run at
+#: K≈0 chain cost and are nearly free to begin with).
+DEFAULT_POLICIES = ("width=2048,segment=1,threshold=0.25",
+                    "width=2048,segment=1,threshold=0.9",
+                    "width=2048,segment=2,threshold=0.9")
+
+
+def _timing_entry(be, cfg, repeats, progress) -> tuple[dict, object, list]:
+    res, walls = timed_best_of(be, cfg, repeats)
+    dev = device_busy(be, cfg)
+    if "device_busy_suspect" in dev:
+        dev = {"error": dev["device_busy_suspect"]}
+    entry = {
+        "wall_s": round(min(walls), 3),
+        "walls_s": [round(w, 3) for w in walls],
+        "instances_per_sec": round(len(res.inst_ids) / min(walls), 1),
+        **({"device_busy_s": dev["device_busy_s"]}
+           if "device_busy_s" in dev
+           else {"device_busy_error": dev.get("error", "?")}),
+    }
+    return entry, res, walls
+
+
+def run_leg(delivery: str, instances: int, policies, repeats: int,
+            progress=print) -> dict:
+    """One delivery law's A/B: per-chunk baseline + the policy sweep."""
+    from byzantinerandomizedconsensus_tpu.obs import record
+
+    cfg = preset("config4", instances=instances, delivery=delivery)
+    jb = get_backend("jax")
+    progress(f"[{delivery}] per-chunk baseline ({instances} instances)...")
+    base_entry, base_res, base_walls = _timing_entry(jb, cfg, repeats,
+                                                     progress)
+    chunk = jb._chunk_size(cfg)
+    base_entry.update({
+        "backend": "jax",
+        "chunk": chunk,
+        "wasted_lane_fraction": metrics.wasted_lane_fraction(
+            base_res.rounds, chunk),
+        "mean_max_rounds_per_chunk": round(
+            metrics.mean_max_rounds_per_chunk(base_res.rounds, chunk), 4),
+        "mean_rounds": round(float(base_res.rounds.mean()), 4),
+    })
+    progress(f"[{delivery}] per-chunk: {base_entry['wall_s']} s, "
+             f"wasted_lane_fraction {base_entry['wasted_lane_fraction']}")
+
+    swept = []
+    for spec in policies:
+        policy = CompactionPolicy.parse(spec)
+        cb = get_backend(f"jax_compact:{spec}")
+        progress(f"[{delivery}] compacted {spec}...")
+        entry, res, walls = _timing_entry(cb, cfg, repeats, progress)
+        bit_identical = bool(
+            np.array_equal(base_res.rounds, res.rounds)
+            and np.array_equal(base_res.decision, res.decision))
+        verdict = regression_verdict(
+            walls, rate=entry["instances_per_sec"],
+            prev_wall_rate=base_entry["instances_per_sec"],
+            device_busy_s=entry.get("device_busy_s"),
+            prev_device_busy_s=base_entry.get("device_busy_s"))
+        entry.update({
+            "backend": f"jax_compact:{spec}",
+            "policy": policy.doc(),
+            "bit_identical": bit_identical,
+            "compaction": record.compaction_block(cb.last_stats),
+            # This backend instance's own bucket-program LRU — the
+            # doc-level block would read a fresh unused instance.
+            "compile_cache": cb.compile_cache_stats(),
+            # vs_prev_round here is compacted-vs-per-chunk (>1 = compaction
+            # faster), keyed per the regression_verdict device-busy rule.
+            **{k: v for k, v in verdict.items() if k != "walls_spread"},
+        })
+        progress(f"[{delivery}] {spec}: {entry['wall_s']} s "
+                 f"(x{verdict.get('vs_prev_round', '?')} vs per-chunk, "
+                 f"occupancy {entry['compaction']['occupancy']}, "
+                 f"bit_identical={bit_identical})")
+        swept.append(entry)
+
+    best = max(swept, key=lambda e: e.get("vs_prev_round") or 0.0)
+    return {
+        "delivery": delivery,
+        "instances": instances,
+        "per_chunk": base_entry,
+        "compacted": swept,
+        "best": {
+            "policy": best["policy"],
+            "wall_speedup_vs_per_chunk": best.get("vs_prev_round"),
+            "regression_signal": best.get("regression_signal"),
+            "bit_identical": best["bit_identical"],
+            "occupancy": best["compaction"]["occupancy"],
+            "wasted_lane_fraction_after":
+                best["compaction"]["wasted_lane_fraction"],
+            "wasted_lane_fraction_before":
+                base_entry["wasted_lane_fraction"],
+        },
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--instances", type=int, default=100_000,
+                    help="instances for the headline shape (config 4)")
+    ap.add_argument("--deliveries", nargs="*", default=["urn2", "urn"],
+                    help="delivery laws to A/B (headline first)")
+    ap.add_argument("--policies", nargs="*", default=list(DEFAULT_POLICIES))
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tier-1 smoke: tiny instances, 2 repeats")
+    ap.add_argument("--out", default=default_artifact("compaction"))
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        args.instances = min(args.instances, 2000)
+        args.repeats = min(args.repeats, 2)
+        args.policies = args.policies[:1]
+
+    from byzantinerandomizedconsensus_tpu.utils.devices import (
+        ensure_live_backend)
+
+    ensure_live_backend()
+    import jax
+
+    progress = lambda msg: print(msg, flush=True)  # noqa: E731
+    legs = {d: run_leg(d, args.instances, args.policies, args.repeats,
+                       progress=progress)
+            for d in args.deliveries}
+
+    from byzantinerandomizedconsensus_tpu.obs import record
+
+    headline = legs.get(args.deliveries[0], {})
+    summary = {
+        f"speedup_{d}": leg["best"]["wall_speedup_vs_per_chunk"]
+        for d, leg in legs.items()
+    }
+    summary["bit_identical_all"] = all(
+        e["bit_identical"] for leg in legs.values()
+        for e in leg["compacted"])
+    doc = {
+        **record.new_record("bench_compaction"),
+        "description": "decision-driven lane compaction A/B at the headline "
+                       "shape (config 4, 100k instances): shipped per-chunk "
+                       "runner vs the compacted lane grid "
+                       "(backends/compaction.py), per delivery law, with "
+                       "occupancy + wasted-lane-rounds before/after "
+                       "(tools/bench_compaction.py; round 11)",
+        "platform": jax.default_backend(),
+        "headline_delivery": args.deliveries[0],
+        "legs": legs,
+        "summary": summary,
+        "compaction": (headline.get("best") and next(
+            (e["compaction"] for e in headline["compacted"]
+             if e["policy"] == headline["best"]["policy"]), None)),
+        "device_chain_note": (
+            "wall-only A/B; CPU XLA walls are a valid capture for the "
+            "scheduling-discipline ratio, but the r5 device chain rule "
+            "still applies to any kernel-time claim — re-run on the device "
+            "of record before flipping any product default (docs/PERF.md "
+            "round 11)"),
+        # No doc-level compile_cache block: each compacted entry carries its
+        # own backend instance's LRU stats (the bare 'jax_compact' instance
+        # never ran anything and would record a fictitious all-zero block).
+    }
+    out = pathlib.Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(doc, indent=1) + "\n")
+    print(json.dumps({"out": str(out), **summary}))
+    return 0 if summary["bit_identical_all"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

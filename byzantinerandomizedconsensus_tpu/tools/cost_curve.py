@@ -69,6 +69,15 @@ def _point(n: int, delivery: str, instances: int, backend: str,
     entry["delivery"] = delivery
     entry["shape"] = shape
     entry["pack_version"] = cfg.pack_version
+    # Schema v1.2: points timed through the compacted lane grid
+    # (--compaction / backend jax_compact) carry the runner's occupancy
+    # block next to their walls.
+    from byzantinerandomizedconsensus_tpu.backends import get_backend
+    from byzantinerandomizedconsensus_tpu.obs import record
+
+    comp = record.compaction_block(get_backend(backend))
+    if comp is not None:
+        entry["compaction"] = comp
     return entry
 
 
@@ -128,7 +137,20 @@ def main(argv=None) -> int:
                     help="attach the protocol-counter block per point "
                          "(obs/counters.py; chain_trips/chain_trips_max is "
                          "the direct K=D evidence)")
+    ap.add_argument("--compaction", default=None, metavar="POLICY",
+                    help="time every point through the round-11 compacted "
+                         "lane grid instead of the per-chunk runner "
+                         "(backend jax_compact — backends/compaction.py); "
+                         "POLICY e.g. 'width=2048,segment=1' or '1' for "
+                         "defaults. Points then carry the schema-v1.2 "
+                         "compaction block")
     args = ap.parse_args(argv)
+
+    if args.compaction is not None:
+        if args.backend != "jax":
+            raise SystemExit("--compaction applies to the jax backend only")
+        args.backend = ("jax_compact" if args.compaction in ("1", "")
+                        else f"jax_compact:{args.compaction}")
 
     from byzantinerandomizedconsensus_tpu.utils.devices import ensure_live_backend
 

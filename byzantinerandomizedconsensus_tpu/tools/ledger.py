@@ -135,6 +135,38 @@ def _compile_cache_of(doc):
     return (cc if isinstance(cc, dict) else None), buckets
 
 
+def _compaction_rows_of(name: str, doc) -> list:
+    """Schema-v1.2 ``compaction`` blocks of one artifact, wherever they sit
+    (top level, per-leg, per-point): (path, occupancy, wasted_lane_fraction,
+    segments, refills) rows for the ledger's occupancy columns."""
+    from byzantinerandomizedconsensus_tpu.obs import record as _record
+
+    rows = []
+
+    def walk(node, path):
+        if isinstance(node, dict):
+            comp = node.get("compaction")
+            if isinstance(comp, dict) and all(
+                    k in comp for k in _record.COMPACTION_BLOCK_KEYS):
+                rows.append({
+                    "artifact": name,
+                    "path": path or ".",
+                    "occupancy": comp.get("occupancy"),
+                    "wasted_lane_fraction": comp.get("wasted_lane_fraction"),
+                    "segments": comp.get("segments"),
+                    "refills": comp.get("refills"),
+                })
+            for k, v in node.items():
+                if k != "compaction":
+                    walk(v, f"{path}.{k}" if path else k)
+        elif isinstance(node, list):
+            for i, v in enumerate(node):
+                walk(v, f"{path}[{i}]")
+
+    walk(_parsed(doc), "")
+    return rows
+
+
 def build_ledger(root=None) -> dict:
     """Assemble the full ledger document from the committed artifacts."""
     root = pathlib.Path(root or repo_root())
@@ -250,6 +282,12 @@ def build_ledger(root=None) -> dict:
             "buckets": buckets,
         })
 
+    # ---- compaction occupancy columns (schema v1.2, round 11): every
+    # committed artifact carrying the compacted lane grid's accounting.
+    compaction_rows = []
+    for name, doc in sorted(docs.items()):
+        compaction_rows.extend(_compaction_rows_of(name, doc))
+
     from byzantinerandomizedconsensus_tpu.obs import record
 
     return {
@@ -260,6 +298,7 @@ def build_ledger(root=None) -> dict:
         "files_scanned": len(files),
         "parse_errors": parse_errors,
         "compile_cache_rows": compile_cache_rows,
+        "compaction_rows": compaction_rows,
         "bench_rounds": {str(r): bench[r] for r in rounds_seen},
         "wall_chain": chain,
         "device_chain": device_chain,
@@ -317,6 +356,17 @@ def format_report(doc: dict) -> str:
                 f"{row['hits']} hits, {row['evictions']} evicted"
                 + (f", {row['buckets']} buckets"
                    if row["buckets"] is not None else ""))
+    # Present only once an artifact carries the v1.2 compaction block — old
+    # ledgers render identically on old artifact sets.
+    if doc.get("compaction_rows"):
+        lines.append("compaction occupancy columns (schema v1.2 — "
+                     "artifact[path]: occupancy/wasted/segments/refills):")
+        for row in doc["compaction_rows"]:
+            lines.append(
+                f"  {row['artifact']}[{row['path']}]: "
+                f"occupancy {row['occupancy']}, "
+                f"wasted {row['wasted_lane_fraction']}, "
+                f"{row['segments']} segments, {row['refills']} refills")
     return "\n".join(lines)
 
 

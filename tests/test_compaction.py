@@ -1,0 +1,361 @@
+"""Decision-driven lane compaction (backends/compaction.py; round 11).
+
+The acceptance bar is bit-identity: every instance that rides the compacted
+lane grid — whatever lane, segment, or refill generation it lands in — must
+equal the per-chunk path bit-for-bit, across the fault × adversary ×
+delivery grid, with mixed-n padding lanes, with counters on (pad-exact
+totals equality), and across refill boundaries that cut through crash
+windows. Plus the policy law's pinned rejections, the §2 chunk-ceiling
+clamp (satellite), the standard straggler metrics (utils/metrics.py), the
+schema-v1.2 record block, and the bench_compaction tier-1 smoke.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from byzantinerandomizedconsensus_tpu.backends import get_backend
+from byzantinerandomizedconsensus_tpu.backends.compaction import (
+    CompactionPolicy)
+from byzantinerandomizedconsensus_tpu.config import (
+    DELIVERY_KINDS, FAULT_KINDS, SimConfig)
+from byzantinerandomizedconsensus_tpu.utils import metrics
+
+# One protocol pairing per adversary (mirrors tests/test_batch.py).
+_ADV_PROTO = (("none", "benor"), ("crash", "benor"), ("byzantine", "bracha"),
+              ("adaptive", "bracha"), ("adaptive_min", "bracha"))
+
+#: Small grid + tiny width so every run exercises several refill
+#: generations (width 4 over ~13 queued instances).
+_POLICY = CompactionPolicy(width=4, segment=1, refill_threshold=0.25)
+
+
+def _cfg(adv, proto, delivery, fault, n=7, f=2, seed=13, **kw):
+    base = dict(protocol=proto, n=n, f=f, instances=4, adversary=adv,
+                coin="local", seed=seed, round_cap=32, delivery=delivery,
+                faults=fault)
+    base.update(kw)
+    return SimConfig(**base).validate()
+
+
+def _lanes(adv, proto, delivery, fault):
+    """Three configs of one bucket: varying f, seed and (mixed-n padding) n."""
+    return [
+        _cfg(adv, proto, delivery, fault),
+        _cfg(adv, proto, delivery, fault, f=1, seed=99, instances=6),
+        _cfg(adv, proto, delivery, fault, n=6, f=1, seed=7, instances=3),
+    ]
+
+
+def _assert_compacted_matches(cfgs, policy=_POLICY):
+    jb = get_backend("jax")
+    results, report = jb.run_many(cfgs, compaction=policy)
+    for cfg, res in zip(cfgs, results):
+        ref = get_backend("numpy").run(cfg)
+        np.testing.assert_array_equal(ref.rounds, res.rounds)
+        np.testing.assert_array_equal(ref.decision, res.decision)
+    comp = report["compaction"]
+    assert comp["occupancy"] is None or 0 < comp["occupancy"] <= 1
+    assert comp["segments"] >= 1
+    return report
+
+
+# ---------------------------------------------------------------------------
+# policy law
+
+
+def test_policy_parse_and_validate():
+    p = CompactionPolicy.parse("width=64,segment=3,threshold=0.5")
+    assert (p.width, p.segment, p.refill_threshold) == (64, 3, 0.5)
+    assert CompactionPolicy.parse("1") == CompactionPolicy()
+    assert CompactionPolicy.parse("") == CompactionPolicy()
+    assert CompactionPolicy.parse("w=8,s=2,t=1.0").width == 8
+    with pytest.raises(ValueError, match="unknown compaction policy field"):
+        CompactionPolicy.parse("wat=3")
+    with pytest.raises(ValueError, match="segment=0 out of range"):
+        CompactionPolicy(segment=0).validate()
+    with pytest.raises(ValueError, match="refill_threshold"):
+        CompactionPolicy(refill_threshold=0.0).validate()
+    with pytest.raises(ValueError, match="width=0 out of range"):
+        CompactionPolicy(width=0).validate()
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: compacted lanes vs the per-chunk path
+
+
+def test_compaction_bitmatch_tier1_sample():
+    """Covering sample over (fault, delivery) with rotating adversaries —
+    every fault kind and every delivery law once, 3 mixed-n configs each
+    through one shared queue at width 4 (several refill generations). The
+    full 16-cell grid runs as the slow-marked variant below."""
+    cells = [(FAULT_KINDS[i], DELIVERY_KINDS[j])
+             for i, j in ((0, 0), (1, 1), (2, 3), (3, 2))]
+    for i, (fault, delivery) in enumerate(cells):
+        adv, proto = _ADV_PROTO[i % len(_ADV_PROTO)]
+        _assert_compacted_matches(_lanes(adv, proto, delivery, fault))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("delivery", DELIVERY_KINDS)
+@pytest.mark.parametrize("fault", FAULT_KINDS)
+def test_compaction_bitmatch_grid_full(fault, delivery):
+    i = FAULT_KINDS.index(fault) + DELIVERY_KINDS.index(delivery)
+    adv, proto = _ADV_PROTO[i % len(_ADV_PROTO)]
+    _assert_compacted_matches(_lanes(adv, proto, delivery, fault))
+
+
+def test_compacted_backend_vs_per_chunk_jax():
+    """The registered ``jax_compact`` backend against per-chunk *jax*
+    directly (not just numpy): lane placement and segment boundaries must
+    not shift a single PRF draw."""
+    cfg = _cfg("byzantine", "bracha", "urn2", "none", instances=13, seed=2)
+    ref = get_backend("jax").run(cfg)
+    cb = get_backend("jax_compact:width=4,segment=2")
+    res = cb.run(cfg)
+    np.testing.assert_array_equal(ref.rounds, res.rounds)
+    np.testing.assert_array_equal(ref.decision, res.decision)
+    stats = cb.last_stats
+    assert stats["refills"] >= 1          # 13 instances through 4 lanes
+    assert stats["useful_lane_rounds"] == int(ref.rounds.sum())
+    assert stats["device_lane_rounds"] >= stats["useful_lane_rounds"]
+    assert stats["policy"]["segment"] == 2
+
+
+def test_refill_mid_stream_crash_window():
+    """Refill boundaries cutting through §3.3/§9 crash windows: instances
+    enter lanes mid-run (their round counter restarts at 0 while neighbours
+    sit at later rounds), and crash/recovery draws keyed on (instance,
+    round) must replay bit-identically. crash_window=3 with segment=2 puts
+    window edges inside and across segments."""
+    for faults, adv in (("recover", "crash"), ("none", "crash")):
+        cfg = _cfg(adv, "benor", "urn2", faults, instances=11, seed=31,
+                   crash_window=3)
+        ref = get_backend("numpy").run(cfg)
+        res = get_backend("jax_compact:width=4,segment=2,threshold=0.25").run(cfg)
+        np.testing.assert_array_equal(ref.rounds, res.rounds)
+        np.testing.assert_array_equal(ref.decision, res.decision)
+
+
+def test_fused_compaction_mixed_axes():
+    """run_fused(compaction=...): one queue per fused bucket, with
+    adversary/fault/coin/init/cap codes riding as per-lane operands — every
+    config bit-identical to numpy."""
+    jb = get_backend("jax")
+    cfgs = [
+        _cfg("byzantine", "bracha", "urn2", "partition", coin="shared",
+             init="all1", round_cap=24, instances=5),
+        _cfg("adaptive", "bracha", "urn2", "none", f=1, seed=5,
+             coin="shared", init="split", instances=4),
+        _cfg("none", "bracha", "urn2", "omission", n=6, f=1, seed=8,
+             round_cap=48, instances=3),
+    ]
+    results, report = jb.run_fused(cfgs, compaction=_POLICY)
+    for cfg, res in zip(cfgs, results):
+        ref = get_backend("numpy").run(cfg)
+        np.testing.assert_array_equal(ref.rounds, res.rounds)
+        np.testing.assert_array_equal(ref.decision, res.decision)
+    assert report["compaction"]["segments"] >= 1
+    assert report["mode"] == "fused"
+
+
+# ---------------------------------------------------------------------------
+# counters: invariance + pad-exact totals on the compacted path
+
+
+def test_compaction_counters_invariance_and_pad_exact_totals():
+    """Counters-on compacted lanes: (rounds, decision) bit-identical to the
+    counter-free path, per-instance accumulator rows harvested at retire
+    time, and totals equal to the numpy counted run — including on a padded
+    lane (n=6 inside the tier-8 program)."""
+    jb = get_backend("jax")
+    cfgs = [_cfg("adaptive", "bracha", "urn2", "partition", seed=3,
+                 coin="shared", instances=5),
+            _cfg("adaptive", "bracha", "urn2", "partition", n=6, f=1,
+                 seed=21, coin="shared", instances=4)]
+    results, docs, report = jb.run_many(cfgs, counters=True,
+                                        compaction=_POLICY)
+    for cfg, res, doc in zip(cfgs, results, docs):
+        ref = get_backend("numpy").run(cfg)
+        np.testing.assert_array_equal(ref.rounds, res.rounds)
+        np.testing.assert_array_equal(ref.decision, res.decision)
+        _, ndoc = get_backend("numpy").run_with_counters(cfg)
+        assert doc["totals"] == ndoc["totals"]
+        assert doc["supported"] and doc["schema"] == ndoc["schema"]
+    assert report["compaction"]["segments"] >= 1
+
+
+def test_fused_compaction_rejects_counters():
+    from byzantinerandomizedconsensus_tpu.backends import compaction
+    from byzantinerandomizedconsensus_tpu.backends.batch import FusedBucket
+    from byzantinerandomizedconsensus_tpu.obs.counters import (
+        CountersUnsupported)
+
+    cfg = _cfg("none", "benor", "urn2", "none")
+    jb = get_backend("jax")
+    with pytest.raises(CountersUnsupported, match="fused compacted lanes"):
+        compaction.run_bucket(jb, FusedBucket.of(cfg), [cfg],
+                              [np.arange(4)], counters=True)
+
+
+# ---------------------------------------------------------------------------
+# straggler metrics (satellite): the PERF round-1 accounting as a metric
+
+
+def test_wasted_lane_fraction_and_mean_max_rounds():
+    rounds = np.array([1, 2, 1, 1, 3, 1, 1, 1], dtype=np.int32)
+    # chunks of 4: maxes 2 and 3 -> device = (2 + 3) * 4 = 20, useful = 11.
+    assert metrics.mean_max_rounds_per_chunk(rounds, 4) == 2.5
+    assert metrics.wasted_lane_fraction(rounds, 4) == round(1 - 11 / 20, 6)
+    # one instance per chunk: no straggler waste at all.
+    assert metrics.wasted_lane_fraction(rounds, 1) == 0.0
+    # tail chunk padded to the compiled width: 5 instances over chunk=4
+    # pay (max(r[:4]) + max(r[4:])) * 4 device lane-rounds.
+    r5 = np.array([1, 1, 1, 1, 4], dtype=np.int32)
+    assert metrics.wasted_lane_fraction(r5, 4) == round(1 - 8 / 20, 6)
+    assert metrics.wasted_lane_fraction(np.empty(0, dtype=np.int32), 4) is None
+    with pytest.raises(ValueError, match="chunk=0"):
+        metrics.wasted_lane_fraction(rounds, 0)
+
+
+def test_summary_reports_straggler_metrics():
+    from byzantinerandomizedconsensus_tpu.backends.base import SimResult
+
+    cfg = _cfg("none", "benor", "urn2", "none", instances=6)
+    res = SimResult(config=cfg, inst_ids=np.arange(6),
+                    rounds=np.array([1, 2, 1, 1, 1, 1], dtype=np.int32),
+                    decision=np.zeros(6, dtype=np.uint8))
+    s = metrics.summary(res, chunk=3)
+    assert s["chunk"] == 3
+    assert s["mean_max_rounds_per_chunk"] == 1.5
+    assert s["wasted_lane_fraction"] == round(1 - 7 / 9, 6)
+    assert "wasted_lane_fraction" not in metrics.summary(res)
+
+
+# ---------------------------------------------------------------------------
+# §2 packing ceiling (satellite): chunk sizing clamped to the pack law
+
+
+def test_chunk_size_respects_pack_law_ceiling():
+    from byzantinerandomizedconsensus_tpu.backends.jax_backend import (
+        JaxBackend)
+    from byzantinerandomizedconsensus_tpu.ops import prf
+
+    jb = JaxBackend(chunk_bytes=1 << 40, max_chunk=1 << 20)
+    v1 = SimConfig(protocol="bracha", n=4, f=1, instances=8,
+                   delivery="urn2").validate()
+    assert jb._chunk_size(v1) <= prf.MAX_INSTANCES
+    v2 = SimConfig(protocol="bracha", n=2048, f=682, instances=8,
+                   delivery="urn2").validate()
+    assert v2.pack_version == 2
+    assert jb._chunk_size(v2) <= prf.V2_MAX_INSTANCES
+    # keys model at tiny n would otherwise blow past the v1 ceiling too.
+    k1 = SimConfig(protocol="benor", n=4, f=1, instances=8).validate()
+    assert jb._chunk_size(k1) <= prf.MAX_INSTANCES
+
+
+def test_validate_instances_overflow_names_pack_law():
+    from byzantinerandomizedconsensus_tpu.ops import prf
+
+    with pytest.raises(ValueError, match=r"spec\s+§2 v2 law packs instance"):
+        SimConfig(protocol="bracha", n=2048, f=682,
+                  instances=prf.V2_MAX_INSTANCES + 1).validate()
+
+
+# ---------------------------------------------------------------------------
+# schema v1.2: the compaction record block
+
+
+def test_record_compaction_block_and_validation():
+    from byzantinerandomizedconsensus_tpu.obs import record
+
+    assert record.RECORD_REVISION >= 2
+    assert record.compaction_block(None) is None
+    stats = {"width": 8, "segments": 3, "refills": 2,
+             "device_lane_rounds": 40, "useful_lane_rounds": 30,
+             "occupancy": 0.75, "wasted_lane_fraction": 0.25,
+             "policy": {"width": 8, "segment": 1, "refill_threshold": 0.25}}
+    block = record.compaction_block(stats)
+    doc = record.new_record("bench_compaction")
+    doc["compaction"] = block
+    assert record.validate_record(doc) == []
+    bad = dict(doc)
+    bad["compaction"] = {"occupancy": 0.5}
+    problems = record.validate_record(bad)
+    assert any("compaction block missing" in p for p in problems)
+
+
+def test_run_record_from_backend_last_stats():
+    from byzantinerandomizedconsensus_tpu.obs import record
+
+    cfg = _cfg("none", "benor", "urn2", "none", instances=6)
+    cb = get_backend("jax_compact:width=4,segment=1")
+    cb.run(cfg)
+    block = record.compaction_block(cb)
+    assert block is not None and block["policy"]["width"] == 4
+    doc = record.new_record("bench")
+    doc["compaction"] = block
+    assert record.validate_record(doc) == []
+
+
+def test_bench_headline_records_compaction_block(tmp_path, monkeypatch,
+                                                 capsys):
+    """bench.py under BENCH_COMPACTION: the one-line artifact carries the
+    schema-v1.2 compaction block next to the standard straggler metrics,
+    keeps the CPU-only device_chain_note, and validates (satellite)."""
+    import importlib.util
+
+    from byzantinerandomizedconsensus_tpu.obs import record
+    from byzantinerandomizedconsensus_tpu.utils.rounds import repo_root
+
+    spec = importlib.util.spec_from_file_location(
+        "bench", repo_root() / "bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    monkeypatch.setenv("BENCH_COMPACTION", "width=32,segment=1")
+    monkeypatch.setattr("sys.argv", ["bench.py", "64"])
+    assert bench.main() == 0
+    line = [ln for ln in capsys.readouterr().out.splitlines()
+            if ln.startswith("{")][-1]
+    doc = json.loads(line)
+    assert doc["record_revision"] >= 2
+    assert record.validate_record(doc) == []
+    assert doc["compaction"]["policy"]["width"] == 32
+    assert doc["compaction"]["occupancy"] is not None
+    assert doc["detail"]["wasted_lane_fraction"] is not None
+    assert doc["detail"]["mean_max_rounds_per_chunk"] >= 1
+    import jax
+
+    if jax.default_backend() != "tpu":
+        assert "device_chain_note" in doc
+
+
+# ---------------------------------------------------------------------------
+# bench_compaction smoke (the r11 A/B instrument, tier-1 sized)
+
+
+def test_bench_compaction_smoke(tmp_path, capsys):
+    from byzantinerandomizedconsensus_tpu.obs import record
+    from byzantinerandomizedconsensus_tpu.tools import bench_compaction
+
+    out = tmp_path / "compaction_smoke.json"
+    rc = bench_compaction.main([
+        "--smoke", "--instances", "64", "--deliveries", "urn2",
+        "--policies", "width=16,segment=1,threshold=0.25",
+        "--out", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert doc["kind"] == "bench_compaction"
+    assert record.validate_record(doc) == []
+    leg = doc["legs"]["urn2"]
+    assert leg["per_chunk"]["wasted_lane_fraction"] is not None
+    assert leg["best"]["bit_identical"] is True
+    assert leg["best"]["occupancy"] is not None
+    assert doc["summary"]["bit_identical_all"] is True
+    # The ledger reconstructs the occupancy columns from this artifact.
+    from byzantinerandomizedconsensus_tpu.tools import ledger
+
+    rows = ledger._compaction_rows_of("x.json", doc)
+    assert rows and all(r["occupancy"] is not None for r in rows)
+    capsys.readouterr()

@@ -144,6 +144,46 @@ def test_ledger_json_out(tmp_path, capsys):
     capsys.readouterr()
 
 
+def test_census_includes_compaction_artifact():
+    """The round-11 lane-compaction A/B artifact: scanned, parsed with zero
+    errors, bit-identity recorded on every compacted leg, and the
+    schema-v1.2 occupancy columns reconstructed by the ledger (artifact +
+    path + occupancy/wasted/segments/refills)."""
+    import pathlib
+
+    from byzantinerandomizedconsensus_tpu.utils.rounds import repo_root
+
+    doc = ledger.build_ledger()
+    assert doc["parse_errors"] == []
+    rows = [r for r in doc["compaction_rows"]
+            if r["artifact"] == "artifacts/compaction_r11.json"]
+    assert rows, "compaction_r11.json must yield occupancy columns"
+    for r in rows:
+        assert r["occupancy"] is not None and 0 < r["occupancy"] <= 1
+        assert r["wasted_lane_fraction"] is not None
+        assert isinstance(r["segments"], int) and r["segments"] >= 1
+        assert isinstance(r["refills"], int)
+
+    comp = json.loads(
+        (pathlib.Path(repo_root())
+         / "artifacts/compaction_r11.json").read_text())
+    assert comp["kind"] == "bench_compaction"
+    assert record.validate_record(comp) == []
+    assert comp["record_revision"] >= 2  # schema v1.2
+    assert comp["summary"]["bit_identical_all"] is True
+    assert "device_chain_note" in comp  # CPU-only capture, rule on record
+    # The headline urn2 leg carries the before/after straggler numbers.
+    leg = comp["legs"]["urn2"]
+    assert leg["per_chunk"]["wasted_lane_fraction"] is not None
+    assert leg["best"]["occupancy"] is not None
+    # §4b urn — the cost model the straggler accounting describes 1:1 —
+    # must show the real win (the round-11 acceptance floor).
+    assert comp["legs"]["urn"]["best"]["wall_speedup_vs_per_chunk"] >= 1.2
+
+    # And the report renders the v1.2 columns.
+    assert "compaction occupancy columns" in ledger.format_report(doc)
+
+
 def test_census_includes_chaos_artifact():
     """The round-9 chaos artifact is part of the committed census: it must
     be scanned, parse cleanly, and carry zero mismatches/violations."""
