@@ -85,6 +85,18 @@ def main() -> int:
     if compaction_spec and compaction_spec != "0" and not backend:
         backend = ("jax_compact" if compaction_spec == "1"
                    else f"jax_compact:{compaction_spec}")
+    # BENCH_TRACE=DIR (round 12): host-side telemetry (obs/trace.py) for the
+    # whole bench run — dispatch/compile/compaction spans land in
+    # DIR/trace-bench.jsonl and the record gains the schema-v1.3 ``trace``
+    # block. The timed windows below stay inside the traced region on
+    # purpose: the overhead is measured and bounded (docs/PERF.md round 12),
+    # and results are bit-identical by construction.
+    trace_dir = os.environ.get("BENCH_TRACE")
+    bench_tracer = None
+    if trace_dir:
+        from byzantinerandomizedconsensus_tpu.obs import trace as _trace
+
+        bench_tracer = _trace.configure(trace_dir, role="bench")
     if not backend:
         import jax
 
@@ -189,6 +201,12 @@ def main() -> int:
     compaction = obs_record.compaction_block(be)
     from byzantinerandomizedconsensus_tpu.utils import metrics as _metrics
 
+    trace_block = None
+    if bench_tracer is not None:
+        from byzantinerandomizedconsensus_tpu.obs import trace as _trace
+
+        trace_block = _trace.finish(bench_tracer)  # flush, close, digest
+
     chunk = be._chunk_size(cfg) if hasattr(be, "_chunk_size") else None
     straggler = ({
         "chunk": chunk,
@@ -229,6 +247,7 @@ def main() -> int:
             "env": obs_record.env_fingerprint(),
         },
         **({"compaction": compaction} if compaction is not None else {}),
+        **({"trace": trace_block} if trace_block is not None else {}),
     }))
     return 0
 

@@ -212,12 +212,19 @@ class JitChunkedBackend(SimulatorBackend):
         return self._compiled[key]
 
     def run(self, cfg: SimConfig, inst_ids: Optional[np.ndarray] = None) -> SimResult:
+        from byzantinerandomizedconsensus_tpu.obs import trace as _trace
+
         cfg = cfg.validate()
         self._check_config(cfg)
         ids = self._resolve_inst_ids(cfg, inst_ids)
         chunk = self._clamp_chunk(cfg, min(self._chunk_size(cfg), max(1, len(ids))))
         fn = self._fn(cfg)
-        with self._device_ctx():
+        # The host-telemetry seam for the per-config path (obs/trace.py):
+        # one span per run covering dispatch + the batched fetch, so a
+        # BENCH_TRACE capture shows the product path's chunk anatomy too.
+        with self._device_ctx(), \
+                _trace.span("backend.run", backend=self.name, n=cfg.n,
+                            instances=int(len(ids)), chunk=int(chunk)):
             rounds_out, decision_out = self._run_chunked(
                 fn, ids, chunk, self._extra_args(cfg))
         return SimResult(config=cfg, inst_ids=ids, rounds=rounds_out, decision=decision_out)

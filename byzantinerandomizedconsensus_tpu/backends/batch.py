@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import time
 from collections import OrderedDict
 from functools import partial
 from typing import Optional, Sequence
@@ -48,6 +49,7 @@ import numpy as np
 
 from byzantinerandomizedconsensus_tpu.config import SimConfig, validate_batch
 from byzantinerandomizedconsensus_tpu.models.adversaries import AdversaryModel
+from byzantinerandomizedconsensus_tpu.obs import trace as _trace
 from byzantinerandomizedconsensus_tpu.ops import prf
 
 # Supported n tiers: a lane's n is padded up to the next tier so that nearby
@@ -218,11 +220,34 @@ class _PadAdversary(AdversaryModel):
         return v, sil, b
 
 
+def _key_label(key) -> str:
+    """Compact human spelling of a cache key for trace events (buckets know
+    their own label; everything else falls back to str)."""
+    if isinstance(key, tuple):
+        return "/".join(_key_label(k) for k in key)
+    lab = getattr(key, "label", None)
+    if callable(lab):
+        try:
+            return lab()
+        except Exception:
+            pass
+    return str(key)
+
+
 class CompileCache:
     """Bounded LRU of compiled bucket programs, with the observability
-    counters the run record carries (compiles / hits / evictions). One
-    instance per backend serves both the batched path and the counter leg —
-    the fix for the previously unbounded ``_compiled_counters`` dict."""
+    counters the run record carries (compiles / hits / evictions, plus the
+    schema-v1.3 ``compile_wall_s`` total). One instance per backend serves
+    both the batched path and the counter leg — the fix for the previously
+    unbounded ``_compiled_counters`` dict.
+
+    Compile wall accounting: ``build()`` usually returns a *lazy* ``jax.jit``
+    wrapper, so the XLA compile is actually paid on the first invocation —
+    callable entries are therefore wrapped to time that first call (trace +
+    compile; the one execution riding along is the standard first-call
+    proxy), fold it into ``compile_wall_s``, emit the
+    ``compile_cache.compile`` trace event (obs/trace.py), and then unwrap
+    so steady-state calls pay nothing."""
 
     def __init__(self, max_entries: int = 32):
         if max_entries < 1:
@@ -232,31 +257,68 @@ class CompileCache:
         self.compiles = 0
         self.hits = 0
         self.evictions = 0
+        self.compile_wall_s = 0.0
 
     def get(self, key, build):
         if key in self._entries:
             self._entries.move_to_end(key)
             self.hits += 1
+            _trace.event("compile_cache.hit", key=_key_label(key))
             return self._entries[key]
+        t0 = time.perf_counter()
         fn = build()
+        wall = time.perf_counter() - t0
         self.compiles += 1
+        self.compile_wall_s += wall
+        if callable(fn):
+            fn = self._timed_first_call(key, fn, wall)
+        else:
+            _trace.event("compile_cache.compile", key=_key_label(key),
+                         wall_s=round(wall, 6))
         self._entries[key] = fn
         while len(self._entries) > self.max_entries:
-            self._entries.popitem(last=False)
+            old_key, _ = self._entries.popitem(last=False)
             self.evictions += 1
+            _trace.event("compile_cache.evict", key=_key_label(old_key))
         return fn
+
+    def _timed_first_call(self, key, fn, build_wall: float):
+        timed = False
+
+        def wrapper(*args, **kw):
+            # Only the FIRST invocation is the compile; callers that hold
+            # the wrapper (the multi-chunk dispatch loop fetches it once)
+            # keep calling it, and those later calls are plain execution —
+            # timing them would inflate compile_wall_s and spam the trace.
+            nonlocal timed
+            if timed:
+                return fn(*args, **kw)
+            t0 = time.perf_counter()
+            out = fn(*args, **kw)
+            wall = time.perf_counter() - t0
+            timed = True
+            self.compile_wall_s += wall
+            _trace.event("compile_cache.compile", key=_key_label(key),
+                         wall_s=round(build_wall + wall, 6))
+            if self._entries.get(key) is wrapper:  # still cached: unwrap
+                self._entries[key] = fn
+            return out
+
+        return wrapper
 
     def __len__(self):
         return len(self._entries)
 
     def stats(self) -> dict:
-        """The run-record ``compile_cache`` block (obs/record.py v1.1)."""
+        """The run-record ``compile_cache`` block (obs/record.py v1.1;
+        ``compile_wall_s`` since schema v1.3)."""
         return {
             "compiles": self.compiles,
             "hits": self.hits,
             "evictions": self.evictions,
             "entries": len(self._entries),
             "max_entries": self.max_entries,
+            "compile_wall_s": round(self.compile_wall_s, 6),
         }
 
 
@@ -390,7 +452,10 @@ def _dispatch_and_collect(backend, fn, lane_ops, cfgs, ids_list, l_pad,
         return ids if len(ids) else np.zeros(1, dtype=np.int64)
 
     pending = []
-    with backend._device_ctx():
+    with backend._device_ctx(), \
+            _trace.span("batch.dispatch", lanes=l_pad, chunk=chunk,
+                        configs=lanes,
+                        occupancy=round(lanes / l_pad, 4)) as sp:
         for lo in range(0, max_i, chunk):
             grid = np.empty((l_pad, chunk), dtype=np.uint32)
             for l in range(l_pad):
@@ -403,6 +468,7 @@ def _dispatch_and_collect(backend, fn, lane_ops, cfgs, ids_list, l_pad,
                         [seg, np.full(chunk - len(seg), seg[-1])])
                 grid[l] = seg.astype(np.uint32)
             pending.append(fn(*lane_ops, jnp.asarray(grid)))
+        sp["dispatches"] = len(pending)
         fetched = jax.device_get(pending)
 
     results = []
@@ -480,28 +546,37 @@ def run_many(backend, cfgs: Sequence[SimConfig], inst_ids=None,
             progress(f"batch bucket {bucket.label()}: {len(idxs)} config(s)")
         group_ids = (None if inst_ids is None
                      else [inst_ids[i] for i in idxs])
-        if compaction is not None:
-            from byzantinerandomizedconsensus_tpu.backends import (
-                compaction as _compaction)
+        with _trace.span("batch.bucket", bucket=bucket.label(),
+                         configs=len(idxs),
+                         mode=("compacted" if compaction is not None
+                               else "bucketed")) as sp:
+            if compaction is not None:
+                from byzantinerandomizedconsensus_tpu.backends import (
+                    compaction as _compaction)
 
-            group = [cfgs[i] for i in idxs]
-            ids_list = [
-                backend._resolve_inst_ids(
-                    c, None if group_ids is None else group_ids[j])
-                for j, c in enumerate(group)]
-            group_res, group_docs, stats = _compaction.run_bucket(
-                backend, bucket, group, ids_list, policy=compaction,
-                counters=counters, progress=progress)
-            compaction_stats.append(stats)
-            occupancy.append({"bucket": bucket.label(), "configs": len(idxs),
-                              "lane_tier": stats["width"],
-                              "compaction": stats})
-        else:
-            out = run_batch(backend, [cfgs[i] for i in idxs],
-                            inst_ids=group_ids, counters=counters)
-            group_res, group_docs = out if counters else (out, None)
-            occupancy.append({"bucket": bucket.label(), "configs": len(idxs),
-                              "lane_tier": lane_tier(len(idxs))})
+                group = [cfgs[i] for i in idxs]
+                ids_list = [
+                    backend._resolve_inst_ids(
+                        c, None if group_ids is None else group_ids[j])
+                    for j, c in enumerate(group)]
+                group_res, group_docs, stats = _compaction.run_bucket(
+                    backend, bucket, group, ids_list, policy=compaction,
+                    counters=counters, progress=progress)
+                compaction_stats.append(stats)
+                sp["lane_tier"] = stats["width"]
+                sp["occupancy"] = stats["occupancy"]
+                occupancy.append({"bucket": bucket.label(),
+                                  "configs": len(idxs),
+                                  "lane_tier": stats["width"],
+                                  "compaction": stats})
+            else:
+                out = run_batch(backend, [cfgs[i] for i in idxs],
+                                inst_ids=group_ids, counters=counters)
+                group_res, group_docs = out if counters else (out, None)
+                sp["lane_tier"] = lane_tier(len(idxs))
+                occupancy.append({"bucket": bucket.label(),
+                                  "configs": len(idxs),
+                                  "lane_tier": lane_tier(len(idxs))})
         for j, i in enumerate(idxs):
             results[i] = group_res[j]
             if counters:
@@ -719,9 +794,13 @@ def run_fused(backend, cfgs: Sequence[SimConfig], inst_ids=None,
             from byzantinerandomizedconsensus_tpu.backends import (
                 compaction as _compaction)
 
-            group_res, _docs, stats = _compaction.run_bucket(
-                backend, bucket, group, ids_list, policy=compaction,
-                counters=False, progress=progress)
+            with _trace.span("batch.bucket", bucket=bucket.label(),
+                             configs=len(idxs), mode="compacted") as sp:
+                group_res, _docs, stats = _compaction.run_bucket(
+                    backend, bucket, group, ids_list, policy=compaction,
+                    counters=False, progress=progress)
+                sp["lane_tier"] = stats["width"]
+                sp["occupancy"] = stats["occupancy"]
             for j, i in enumerate(idxs):
                 results[i] = group_res[j]
             compaction_stats.append(stats)
@@ -761,9 +840,11 @@ def run_fused(backend, cfgs: Sequence[SimConfig], inst_ids=None,
             jnp.asarray(np.asarray([INIT_CODES[lc(i).init]
                                     for i in range(l_pad)], dtype=np.int32)),
         )
-        group_res = _dispatch_and_collect(
-            backend, fn, lane_ops, group, ids_list, l_pad, chunk, max_i,
-            counters=False)
+        with _trace.span("batch.bucket", bucket=bucket.label(),
+                         configs=len(idxs), mode="fused", lane_tier=l_pad):
+            group_res = _dispatch_and_collect(
+                backend, fn, lane_ops, group, ids_list, l_pad, chunk, max_i,
+                counters=False)
         for j, i in enumerate(idxs):
             results[i] = group_res[j]
         occupancy.append({"bucket": bucket.label(), "configs": len(idxs),

@@ -55,6 +55,7 @@ from byzantinerandomizedconsensus_tpu.backends.batch import (
     ADV_CODES, COIN_CODES, FAULT_CODES, INIT_CODES, FusedBucket,
     FusedLaneConfig, LaneConfig, ShapeBucket, _chunk_instances, _PadAdversary,
     compile_cache, lane_tier)
+from byzantinerandomizedconsensus_tpu.obs import trace as _trace
 from byzantinerandomizedconsensus_tpu.ops import prf
 
 
@@ -451,36 +452,49 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
     # compaction+refill dispatches whenever the retired fraction crosses the
     # policy threshold (always when the grid fully drains).
     take = min(W, total)
-    ops_b, iids_b = block(take, W)
-    carry = init_program()(ops_b, iids_b, jnp.int32(take))
+    with _trace.span("compaction.init", width=W, fill=take,
+                     queued=total - take):
+        ops_b, iids_b = block(take, W)
+        carry = init_program()(ops_b, iids_b, jnp.int32(take))
     owner_cfg[:take] = work_cfg[:take]
     owner_pos[:take] = work_pos[:take]
     head = take
 
     while True:
-        fn = segment_program(seg if head < total else drain_seg)
-        out = fn(*carry)
-        carry = out[:n_carry]
-        fetch = jax.device_get(
-            (carry[2],) + out[n_carry:n_carry + 3]
-            + ((carry[6],) if counters else ()))
-        r_h, rounds_h, dec_h, fin_h = fetch[:4]
-        segments += 1
-        trips = np.asarray(r_h, dtype=np.int64) - prev_r
-        device_rounds += int(trips.max()) * W
-        useful_rounds += int(trips.sum())
-        prev_r = np.asarray(r_h, dtype=np.int64)
-        retire = np.asarray(fin_h, dtype=bool) & (owner_cfg >= 0)
-        for ci in np.unique(owner_cfg[retire]):
-            sel = retire & (owner_cfg == ci)
-            rows = owner_pos[sel]
-            rounds_out[ci][rows] = rounds_h[sel]
-            dec_out[ci][rows] = dec_h[sel]
-            if counters:
-                acc_out[ci][rows] = fetch[4][sel]
-        owner_cfg[retire] = -1
-        live = owner_cfg >= 0
-        free = W - int(live.sum())
+        # The per-trip wall the round-11 anatomy reconstructed by hand is
+        # now this span's duration; drain trips get their own kind so the
+        # straggler tail is directly queryable in the digest.
+        drain = head >= total
+        with _trace.span("compaction.drain" if drain
+                         else "compaction.segment",
+                         width=W, queued=total - head) as sp:
+            fn = segment_program(drain_seg if drain else seg)
+            out = fn(*carry)
+            carry = out[:n_carry]
+            fetch = jax.device_get(
+                (carry[2],) + out[n_carry:n_carry + 3]
+                + ((carry[6],) if counters else ()))
+            r_h, rounds_h, dec_h, fin_h = fetch[:4]
+            segments += 1
+            trips = np.asarray(r_h, dtype=np.int64) - prev_r
+            device_rounds += int(trips.max()) * W
+            useful_rounds += int(trips.sum())
+            prev_r = np.asarray(r_h, dtype=np.int64)
+            retire = np.asarray(fin_h, dtype=bool) & (owner_cfg >= 0)
+            for ci in np.unique(owner_cfg[retire]):
+                sel = retire & (owner_cfg == ci)
+                rows = owner_pos[sel]
+                rounds_out[ci][rows] = rounds_h[sel]
+                dec_out[ci][rows] = dec_h[sel]
+                if counters:
+                    acc_out[ci][rows] = fetch[4][sel]
+            owner_cfg[retire] = -1
+            live = owner_cfg >= 0
+            free = W - int(live.sum())
+            sp["trip_max"] = int(trips.max())
+            sp["useful_trips"] = int(trips.sum())
+            sp["retired"] = int(retire.sum())
+            sp["live"] = W - free
         if progress is not None:
             progress(f"compaction segment {segments}: {W - free}/{W} live, "
                      f"{total - head} queued")
@@ -489,28 +503,36 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
                 break
             continue  # queue dry: drain the stragglers, no more refills
         if free >= W * policy.refill_threshold or not live.any():
-            perm = np.concatenate([np.flatnonzero(live),
-                                   np.flatnonzero(~live)]).astype(np.int32)
-            n_keep = W - free
-            take = min(free, total - head)
-            # The fresh block is always W rows (n_fill gates the live ones):
-            # ONE refill program per bucket, so the warm-up compiles exactly
-            # the timed program set (utils/timing.py discipline).
-            ops_b, iids_b = block(take, W)
-            carry = refill_program(W)(
-                jnp.asarray(perm), jnp.int32(n_keep), jnp.int32(take),
-                ops_b, iids_b, *carry)
-            owner_cfg = np.concatenate(
-                [owner_cfg[perm[:n_keep]], np.full(free, -1, dtype=np.int32)])
-            owner_pos = np.concatenate(
-                [owner_pos[perm[:n_keep]], np.zeros(free, dtype=np.int64)])
-            prev_r = np.concatenate(
-                [prev_r[perm[:n_keep]], np.zeros(free, dtype=np.int64)])
-            sl = slice(n_keep, n_keep + take)
-            owner_cfg[sl] = work_cfg[head:head + take]
-            owner_pos[sl] = work_pos[head:head + take]
-            head += take
-            refills += 1
+            with _trace.span("compaction.refill", width=W) as sp:
+                perm = np.concatenate(
+                    [np.flatnonzero(live),
+                     np.flatnonzero(~live)]).astype(np.int32)
+                n_keep = W - free
+                take = min(free, total - head)
+                # The fresh block is always W rows (n_fill gates the live
+                # ones): ONE refill program per bucket, so the warm-up
+                # compiles exactly the timed program set (utils/timing.py
+                # discipline).
+                ops_b, iids_b = block(take, W)
+                carry = refill_program(W)(
+                    jnp.asarray(perm), jnp.int32(n_keep), jnp.int32(take),
+                    ops_b, iids_b, *carry)
+                owner_cfg = np.concatenate(
+                    [owner_cfg[perm[:n_keep]],
+                     np.full(free, -1, dtype=np.int32)])
+                owner_pos = np.concatenate(
+                    [owner_pos[perm[:n_keep]],
+                     np.zeros(free, dtype=np.int64)])
+                prev_r = np.concatenate(
+                    [prev_r[perm[:n_keep]], np.zeros(free, dtype=np.int64)])
+                sl = slice(n_keep, n_keep + take)
+                owner_cfg[sl] = work_cfg[head:head + take]
+                owner_pos[sl] = work_pos[head:head + take]
+                head += take
+                refills += 1
+                sp["keep"] = n_keep
+                sp["take"] = take
+                sp["queued"] = total - head
 
     results = [SimResult(config=c, inst_ids=i, rounds=r, decision=d)
                for c, i, r, d in zip(cfgs, ids_list, rounds_out, dec_out)]

@@ -336,10 +336,18 @@ class CompactedJaxBackend(JaxBackend):
     def run(self, cfg: SimConfig, inst_ids=None) -> "SimResult":
         import dataclasses as _dc
 
+        from byzantinerandomizedconsensus_tpu.obs import trace as _trace
+
         policy = _dc.replace(self.policy, width=self._resolved_width(cfg))
         res, stats = self.run_compacted(cfg, inst_ids=inst_ids,
                                         policy=policy)
         self.last_stats = stats
+        # One summary event per compacted run (obs/trace.py): a BENCH_TRACE
+        # capture then carries the occupancy verdict next to the per-trip
+        # segment/refill/drain spans run_bucket emitted.
+        _trace.event("compact.run", width=stats["width"],
+                     segments=stats["segments"], refills=stats["refills"],
+                     occupancy=stats["occupancy"])
         return res
 
     def run_with_counters(self, cfg: SimConfig,

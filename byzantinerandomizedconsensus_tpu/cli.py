@@ -310,19 +310,26 @@ def main(argv=None) -> int:
                    help="decision-driven lane-compaction A/B at the "
                         "headline shape (tools/bench_compaction.py; all "
                         "further options pass through)")
+    sub.add_parser("trace",
+                   help="host-side telemetry consumers (tools/trace.py): "
+                        "`trace export --chrome` (Perfetto), `trace "
+                        "summary` (p50/p90/p99 span digest), `trace "
+                        "follow DIR` (live fleet progress), `trace "
+                        "overhead` (the traced-vs-untraced A/B)")
 
     if argv is None:
         argv = sys.argv[1:]
     if argv and argv[0] in ("accept", "slack", "product", "ledger", "chaos",
-                            "compaction"):
+                            "compaction", "trace"):
         from byzantinerandomizedconsensus_tpu.tools import (
             acceptance, bench_compaction, ledger, product, slack, soak)
+        from byzantinerandomizedconsensus_tpu.tools import trace as trace_tool
 
         if argv[0] == "chaos":
             return soak.main(["--chaos", *argv[1:]])
         tool = {"accept": acceptance, "slack": slack,
                 "product": product, "ledger": ledger,
-                "compaction": bench_compaction}[argv[0]]
+                "compaction": bench_compaction, "trace": trace_tool}[argv[0]]
         return tool.main(argv[1:])
     args = ap.parse_args(argv)
     if getattr(args, "backend", "").startswith("jax"):

@@ -1,8 +1,12 @@
 """Observability subsystem (flight recorder): protocol counters harvested from
 the round kernels (obs/counters.py), the unified versioned run-record schema
-every artifact-writing tool emits (obs/record.py), and the committed-artifact
-regression-chain ledger (tools/ledger.py). See docs/OBSERVABILITY.md."""
+every artifact-writing tool emits (obs/record.py), the host-side telemetry
+pipeline — structured trace spans/events from the orchestration seams with
+Chrome-trace export and live follow mode (obs/trace.py; round 12) — and the
+committed-artifact regression-chain ledger (tools/ledger.py). See
+docs/OBSERVABILITY.md."""
 
+from byzantinerandomizedconsensus_tpu.obs import trace
 from byzantinerandomizedconsensus_tpu.obs.counters import (
     COUNTER_SCHEMA_VERSION,
     CountersUnsupported,
@@ -23,4 +27,5 @@ __all__ = [
     "RECORD_VERSION",
     "env_fingerprint",
     "new_record",
+    "trace",
 ]

@@ -36,6 +36,16 @@ with a ``compaction=`` policy). Same compatibility rule: ``record_version``
 stays 1, the revision is declarative, and :func:`validate_record` checks the
 block shape only when present.
 
+Schema v1.3 (round 12) adds the **trace** block (:func:`trace_block` — the
+host-side telemetry pipeline, obs/trace.py): the trace JSONL file name, its
+event count, and the per-span-kind count/total/p50/p90/p99 digest — carried
+by artifacts whose runs were traced (``brc-tpu chaos --trace``, ``BENCH_TRACE``
+bench runs, the trace-overhead A/B). The v1.1 ``compile_cache`` block also
+gains ``compile_wall_s`` (total seconds spent compiling bucket programs —
+backends/batch.py CompileCache). Same compatibility rule as v1.1/v1.2:
+``record_version`` stays 1, the revision is declarative, and
+:func:`validate_record` checks the block shapes only when present.
+
 tools/ledger.py consumes both this format and the legacy r1–r7 shapes;
 :func:`validate_record` is the schema check the tier-1 tests pin.
 """
@@ -48,8 +58,9 @@ import numpy as np
 
 RECORD_VERSION = 1
 # Minor schema revisions: v1.1 (round 10) compile-cache / batch fields;
-# v1.2 (round 11) the compaction block.
-RECORD_REVISION = 2
+# v1.2 (round 11) the compaction block; v1.3 (round 12) the trace block +
+# compile_wall_s in the compile-cache block.
+RECORD_REVISION = 3
 
 
 def env_fingerprint() -> dict:
@@ -196,6 +207,30 @@ def compaction_block(stats: dict | None) -> dict | None:
              "policy") if k in stats}
 
 
+#: The fields a schema-v1.3 ``trace`` block must carry (the host-side
+#: telemetry binding of obs/trace.py: file + event census + span digest).
+TRACE_BLOCK_KEYS = ("file", "events", "digest")
+
+
+def trace_block(path) -> dict | None:
+    """The schema-v1.3 ``trace`` block from a trace JSONL path: the file
+    name (basename — artifacts move, the binding is by name next to the
+    record), its event count, and the per-span-kind digest
+    (obs/trace.digest). None on any failure — observability must not break
+    record assembly."""
+    import pathlib
+
+    from byzantinerandomizedconsensus_tpu.obs import trace as _trace
+
+    try:
+        path = pathlib.Path(path)
+        events = _trace.read_events(path)
+        return {"file": path.name, "events": len(events),
+                "digest": _trace.digest(events)}
+    except Exception:
+        return None
+
+
 def validate_record(doc: dict) -> list:
     """Schema check: returns a list of problems (empty = valid v1 record)."""
     problems = []
@@ -236,4 +271,18 @@ def validate_record(doc: dict) -> list:
             for key in COMPACTION_BLOCK_KEYS:
                 if key not in comp:
                     problems.append(f"compaction block missing {key!r}")
+    tr = doc.get("trace")
+    if tr is not None:
+        if not isinstance(tr, dict):
+            problems.append("trace block is not a dict")
+        else:
+            for key in TRACE_BLOCK_KEYS:
+                if key not in tr:
+                    problems.append(f"trace block missing {key!r}")
+            dg = tr.get("digest")
+            if dg is not None and isinstance(dg, dict):
+                for kind, entry in dg.items():
+                    if not isinstance(entry, dict) or "count" not in entry:
+                        problems.append(
+                            f"trace digest entry {kind!r} missing 'count'")
     return problems

@@ -184,6 +184,11 @@ def main(argv=None) -> int:
                          "compacted lane grid (backends/compaction.py); "
                          "POLICY e.g. 'width=256,segment=1' or '1' for "
                          "defaults")
+    ap.add_argument("--trace", default=None, metavar="DIR",
+                    help="host-side telemetry (obs/trace.py): record the "
+                         "in-process legs' dispatch/compile spans to "
+                         "DIR/trace-bench_batch.jsonl; the artifact gains "
+                         "the schema-v1.3 trace block")
     ap.add_argument("--skip-subprocess", action="store_true",
                     help="skip both subprocess legs (minutes each on the "
                          "full grid)")
@@ -200,6 +205,12 @@ def main(argv=None) -> int:
 
     progress = lambda msg: print(msg, flush=True)  # noqa: E731
     cfgs = chaos_grid(args.configs, args.seed)
+
+    tracer = None
+    if args.trace:
+        from byzantinerandomizedconsensus_tpu.obs import trace as _trace
+
+        tracer = _trace.configure(args.trace, role="bench_batch")
 
     legs: dict = {"dense_bucket": leg_dense_bucket(args.dense_lanes,
                                                    progress=progress)}
@@ -236,6 +247,12 @@ def main(argv=None) -> int:
 
     from byzantinerandomizedconsensus_tpu.obs import record
 
+    trace_block = None
+    if tracer is not None:
+        from byzantinerandomizedconsensus_tpu.obs import trace as _trace
+
+        trace_block = _trace.finish(tracer)
+
     doc = {
         **record.new_record("bench_batch"),
         "description": "config-batched execution A/B on the seeded chaos "
@@ -253,6 +270,7 @@ def main(argv=None) -> int:
         "legs": legs,
         "summary": summary,
         "compile_cache": record.compile_cache_block("jax"),
+        **({"trace": trace_block} if trace_block is not None else {}),
     }
     out = pathlib.Path(args.out)
     out.parent.mkdir(parents=True, exist_ok=True)

@@ -156,6 +156,11 @@ def main(argv=None) -> int:
                     help="delivery laws to A/B (headline first)")
     ap.add_argument("--policies", nargs="*", default=list(DEFAULT_POLICIES))
     ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--trace", default=None, metavar="DIR",
+                    help="host-side telemetry (obs/trace.py): record the "
+                         "compacted legs' segment/refill/drain spans to "
+                         "DIR/trace-bench_compaction.jsonl; the artifact "
+                         "gains the schema-v1.3 trace block")
     ap.add_argument("--smoke", action="store_true",
                     help="tier-1 smoke: tiny instances, 2 repeats")
     ap.add_argument("--out", default=default_artifact("compaction"))
@@ -172,12 +177,24 @@ def main(argv=None) -> int:
     ensure_live_backend()
     import jax
 
+    tracer = None
+    if args.trace:
+        from byzantinerandomizedconsensus_tpu.obs import trace as _trace
+
+        tracer = _trace.configure(args.trace, role="bench_compaction")
+
     progress = lambda msg: print(msg, flush=True)  # noqa: E731
     legs = {d: run_leg(d, args.instances, args.policies, args.repeats,
                        progress=progress)
             for d in args.deliveries}
 
     from byzantinerandomizedconsensus_tpu.obs import record
+
+    trace_block = None
+    if tracer is not None:
+        from byzantinerandomizedconsensus_tpu.obs import trace as _trace
+
+        trace_block = _trace.finish(tracer)
 
     headline = legs.get(args.deliveries[0], {})
     summary = {
@@ -211,6 +228,7 @@ def main(argv=None) -> int:
         # No doc-level compile_cache block: each compacted entry carries its
         # own backend instance's LRU stats (the bare 'jax_compact' instance
         # never ran anything and would record a fictitious all-zero block).
+        **({"trace": trace_block} if trace_block is not None else {}),
     }
     out = pathlib.Path(args.out)
     out.parent.mkdir(parents=True, exist_ok=True)

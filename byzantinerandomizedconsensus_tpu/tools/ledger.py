@@ -135,35 +135,66 @@ def _compile_cache_of(doc):
     return (cc if isinstance(cc, dict) else None), buckets
 
 
-def _compaction_rows_of(name: str, doc) -> list:
-    """Schema-v1.2 ``compaction`` blocks of one artifact, wherever they sit
-    (top level, per-leg, per-point): (path, occupancy, wasted_lane_fraction,
-    segments, refills) rows for the ledger's occupancy columns."""
-    from byzantinerandomizedconsensus_tpu.obs import record as _record
-
-    rows = []
+def _blocks_of(doc, block_key: str, required_keys) -> list:
+    """Every ``block_key`` sub-dict of an artifact carrying all
+    ``required_keys``, wherever it sits (top level, per-leg, per-point):
+    (path, block) pairs — the one recursive walk the v1.2 compaction and
+    v1.3 trace columns (and any future versioned block) share."""
+    found = []
 
     def walk(node, path):
         if isinstance(node, dict):
-            comp = node.get("compaction")
-            if isinstance(comp, dict) and all(
-                    k in comp for k in _record.COMPACTION_BLOCK_KEYS):
-                rows.append({
-                    "artifact": name,
-                    "path": path or ".",
-                    "occupancy": comp.get("occupancy"),
-                    "wasted_lane_fraction": comp.get("wasted_lane_fraction"),
-                    "segments": comp.get("segments"),
-                    "refills": comp.get("refills"),
-                })
+            blk = node.get(block_key)
+            if isinstance(blk, dict) and all(k in blk for k in required_keys):
+                found.append((path or ".", blk))
             for k, v in node.items():
-                if k != "compaction":
+                if k != block_key:
                     walk(v, f"{path}.{k}" if path else k)
         elif isinstance(node, list):
             for i, v in enumerate(node):
                 walk(v, f"{path}[{i}]")
 
     walk(_parsed(doc), "")
+    return found
+
+
+def _compaction_rows_of(name: str, doc) -> list:
+    """Schema-v1.2 ``compaction`` blocks of one artifact: (path, occupancy,
+    wasted_lane_fraction, segments, refills) rows for the ledger's
+    occupancy columns."""
+    from byzantinerandomizedconsensus_tpu.obs import record as _record
+
+    return [{
+        "artifact": name,
+        "path": path,
+        "occupancy": comp.get("occupancy"),
+        "wasted_lane_fraction": comp.get("wasted_lane_fraction"),
+        "segments": comp.get("segments"),
+        "refills": comp.get("refills"),
+    } for path, comp in _blocks_of(doc, "compaction",
+                                   _record.COMPACTION_BLOCK_KEYS)]
+
+
+def _trace_rows_of(name: str, doc) -> list:
+    """Schema-v1.3 ``trace`` blocks of one artifact: (path, file, events,
+    span kinds, total span seconds) rows for the ledger's trace-digest
+    columns."""
+    from byzantinerandomizedconsensus_tpu.obs import record as _record
+
+    rows = []
+    for path, tr in _blocks_of(doc, "trace", _record.TRACE_BLOCK_KEYS):
+        dg = tr.get("digest")
+        dg = dg if isinstance(dg, dict) else {}
+        total = sum(e.get("total_s", 0.0) for e in dg.values()
+                    if isinstance(e, dict))
+        rows.append({
+            "artifact": name,
+            "path": path,
+            "file": tr.get("file"),
+            "events": tr.get("events"),
+            "span_kinds": len(dg),
+            "total_s": round(total, 4),
+        })
     return rows
 
 
@@ -279,6 +310,10 @@ def build_ledger(root=None) -> dict:
             "compiles": cc.get("compiles"),
             "hits": cc.get("hits"),
             "evictions": cc.get("evictions"),
+            # schema v1.3: total seconds spent compiling bucket programs
+            # (None for pre-v1.3 artifacts — the column, not the value, is
+            # what the ledger reconstructs).
+            "compile_wall_s": cc.get("compile_wall_s"),
             "buckets": buckets,
         })
 
@@ -287,6 +322,12 @@ def build_ledger(root=None) -> dict:
     compaction_rows = []
     for name, doc in sorted(docs.items()):
         compaction_rows.extend(_compaction_rows_of(name, doc))
+
+    # ---- trace-digest columns (schema v1.3, round 12): every committed
+    # artifact binding a host-telemetry trace file + span digest.
+    trace_rows = []
+    for name, doc in sorted(docs.items()):
+        trace_rows.extend(_trace_rows_of(name, doc))
 
     from byzantinerandomizedconsensus_tpu.obs import record
 
@@ -299,6 +340,7 @@ def build_ledger(root=None) -> dict:
         "parse_errors": parse_errors,
         "compile_cache_rows": compile_cache_rows,
         "compaction_rows": compaction_rows,
+        "trace_rows": trace_rows,
         "bench_rounds": {str(r): bench[r] for r in rounds_seen},
         "wall_chain": chain,
         "device_chain": device_chain,
@@ -348,12 +390,15 @@ def format_report(doc: dict) -> str:
     # Present only once any committed artifact carries the v1.1 block — old
     # ledgers render identically on old artifact sets.
     if doc.get("compile_cache_rows"):
-        lines.append("compile-cache columns (schema v1.1 — "
-                     "artifact: compiles/hits/evictions/buckets):")
+        lines.append("compile-cache columns (schema v1.1; compile wall "
+                     "since v1.3 — artifact: compiles/hits/evictions/"
+                     "wall/buckets):")
         for row in doc["compile_cache_rows"]:
             lines.append(
                 f"  {row['artifact']}: {row['compiles']} compiled, "
                 f"{row['hits']} hits, {row['evictions']} evicted"
+                + (f", {row['compile_wall_s']} s compile wall"
+                   if row.get("compile_wall_s") is not None else "")
                 + (f", {row['buckets']} buckets"
                    if row["buckets"] is not None else ""))
     # Present only once an artifact carries the v1.2 compaction block — old
@@ -367,6 +412,15 @@ def format_report(doc: dict) -> str:
                 f"occupancy {row['occupancy']}, "
                 f"wasted {row['wasted_lane_fraction']}, "
                 f"{row['segments']} segments, {row['refills']} refills")
+    # Present only once an artifact carries the v1.3 trace block.
+    if doc.get("trace_rows"):
+        lines.append("trace-digest columns (schema v1.3 — artifact[path]: "
+                     "file/events/span kinds/total span seconds):")
+        for row in doc["trace_rows"]:
+            lines.append(
+                f"  {row['artifact']}[{row['path']}]: {row['file']}, "
+                f"{row['events']} events, {row['span_kinds']} span kinds, "
+                f"{row['total_s']} s total")
     return "\n".join(lines)
 
 

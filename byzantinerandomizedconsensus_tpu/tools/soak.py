@@ -49,6 +49,7 @@ import numpy as np
 from byzantinerandomizedconsensus_tpu.backends import get_backend
 from byzantinerandomizedconsensus_tpu.config import (
     DELIVERY_KINDS, FAULT_KINDS, SimConfig)
+from byzantinerandomizedconsensus_tpu.obs import trace as _trace
 from byzantinerandomizedconsensus_tpu.utils.rounds import default_artifact
 
 # Bumped whenever the draw sequence below changes shape: an artifact's config
@@ -156,7 +157,8 @@ def run_soak(n_configs: int, seed: int = 0, oracle_every: int = 10,
              oracle_instances: int = 3, progress=print, chaos: bool = False,
              timeout_s: float = CHAOS_TIMEOUT_S,
              backoff_s: float = CHAOS_BACKOFF_S,
-             checkpoint=None, inject=None, jobs: int = 1) -> dict:
+             checkpoint=None, inject=None, jobs: int = 1,
+             trace_dir=None) -> dict:
     """Run the differential; returns the artifact document (never raises on a
     mismatch — a soak must report every divergence it finds, not stop at the
     first).
@@ -172,7 +174,55 @@ def run_soak(n_configs: int, seed: int = 0, oracle_every: int = 10,
     own timeout → backoff → retry ladder, and the checkpoint is merged and
     written only on the coordinating thread as completions arrive — a kill
     mid-run still resumes every finished config.
+
+    ``trace_dir`` (round 12) enables the host-side telemetry pipeline
+    (obs/trace.py): the coordinator records worker-lifecycle events
+    (spawn/timeout/backoff/retry/skip, checkpoint merges) and heartbeat
+    progress events to ``trace-coord.jsonl``, every subprocess worker
+    appends to its own file via the exported ``BRC_TRACE`` variable, and on
+    completion the per-worker files are merged into ``trace.jsonl``, whose
+    span digest rides the artifact as the schema-v1.3 ``trace`` block.
+    Live view: ``brc-tpu trace follow <trace_dir>`` while the soak runs.
     """
+    tracer = None
+    prev_trace_env = os.environ.get(_trace.TRACE_ENV)
+    if trace_dir is not None:
+        pathlib.Path(trace_dir).mkdir(parents=True, exist_ok=True)
+        tracer = _trace.configure(trace_dir, role="coord")
+        os.environ[_trace.TRACE_ENV] = str(trace_dir)
+        _trace.event("chaos.start", configs=n_configs, seed=seed,
+                     chaos=chaos, jobs=jobs)
+    try:
+        doc = _run_soak(n_configs, seed, oracle_every, oracle_instances,
+                        progress, chaos, timeout_s, backoff_s, checkpoint,
+                        inject, jobs)
+    except BaseException:
+        # A raising soak body must not leave the global tracer collecting
+        # into the dead run's file (later runs in this process would append
+        # to it silently); the sink is closed, no merge/trace block.
+        if tracer is not None:
+            _trace.finish(tracer)
+        raise
+    finally:
+        if trace_dir is not None:
+            if prev_trace_env is None:
+                os.environ.pop(_trace.TRACE_ENV, None)
+            else:
+                os.environ[_trace.TRACE_ENV] = prev_trace_env
+    if tracer is not None:
+        _trace.event("chaos.done", mismatches=len(doc["mismatches"]),
+                     violations=len(doc.get("violations", [])),
+                     skipped=len(doc.get("skipped", [])))
+        _trace.finish(tracer)
+        from byzantinerandomizedconsensus_tpu.obs import record as _record
+
+        merged = _trace.merge(trace_dir)
+        doc["trace"] = _record.trace_block(merged)
+    return doc
+
+
+def _run_soak(n_configs, seed, oracle_every, oracle_instances, progress,
+              chaos, timeout_s, backoff_s, checkpoint, inject, jobs) -> dict:
     rng = random.Random(seed)
     mismatches = []
     violations = []
@@ -215,7 +265,7 @@ def run_soak(n_configs: int, seed: int = 0, oracle_every: int = 10,
         def _work(k):
             rec = _run_chaos_config(
                 cfgs[k], _oracle_n(k), timeout_s=timeout_s,
-                backoff_s=backoff_s, inject=(inject or {}).get(k))
+                backoff_s=backoff_s, inject=(inject or {}).get(k), index=k)
             rec["index"] = k
             return k, rec
 
@@ -228,6 +278,7 @@ def run_soak(n_configs: int, seed: int = 0, oracle_every: int = 10,
                 records[str(k)] = rec
                 if ckpt_path is not None:
                     _save_checkpoint(ckpt_path, seed, records)
+                    _trace.event("chaos.checkpoint", merged=len(records))
             rec = records[str(k)]
             # Count only oracle legs that actually ran: the child stamps
             # ``oracle_instances`` after its compare (so resumed records
@@ -250,6 +301,11 @@ def run_soak(n_configs: int, seed: int = 0, oracle_every: int = 10,
                                    "violations": rec["violations"]})
                 progress(f"soak[{k}]: SAFETY VIOLATION {cfg}")
             done_count += 1
+            # The live-fleet heartbeat: one instant event per completion —
+            # `brc-tpu trace follow` renders the newest of these.
+            _trace.event("chaos.progress", done=done_count, total=n_configs,
+                         mismatches=len(mismatches),
+                         violations=len(violations), skipped=len(skipped))
             if (rec["status"] == "ok" and not rec.get("violations")
                     and done_count % 25 == 0):
                 progress(f"soak[{done_count}/{n_configs}]: "
@@ -365,37 +421,54 @@ def _save_checkpoint(path: pathlib.Path, seed: int, records: dict) -> None:
 
 
 def _run_chaos_config(cfg: SimConfig, oracle_n: int, timeout_s: float,
-                      backoff_s: float, inject=None) -> dict:
+                      backoff_s: float, inject=None, index=None) -> dict:
     """One config in a subprocess: wall timeout, one retry with exponential
     backoff, then an honest skip-with-record. Returns the per-config record
-    (status ok | mismatch | skipped, plus the child's payload)."""
+    (status ok | mismatch | skipped, plus the child's payload). The whole
+    ladder is one ``chaos.config`` trace span; each rung (spawn / timeout /
+    exit-error / backoff / retry / skip) is an instant event — the worker
+    lifecycle the round-12 telemetry pipeline makes queryable."""
     cmd = [sys.executable, "-m", "byzantinerandomizedconsensus_tpu.tools.soak",
            "--child-config", json.dumps(dataclasses.asdict(cfg)),
            "--child-oracle", str(oracle_n)]
     if inject:
         cmd += ["--inject", inject]
     errors = []
-    for attempt in range(2):
-        if attempt:
-            time.sleep(backoff_s * (2 ** (attempt - 1)))
-        try:
-            proc = subprocess.run(cmd, capture_output=True, text=True,
-                                  timeout=timeout_s)
-        except subprocess.TimeoutExpired:
-            errors.append(f"attempt {attempt}: timeout after {timeout_s}s")
-            continue
-        if proc.returncode != 0:
-            errors.append(f"attempt {attempt}: exit {proc.returncode} "
-                          f"({(proc.stderr or '').strip()[-200:]})")
-            continue
-        try:
-            payload = json.loads(proc.stdout.strip().splitlines()[-1])
-        except (ValueError, IndexError):
-            errors.append(f"attempt {attempt}: unparseable child output "
-                          f"({proc.stdout[-200:]!r})")
-            continue
-        payload["attempts"] = attempt + 1
-        return payload
+    with _trace.span("chaos.config", index=index) as sp:
+        for attempt in range(2):
+            if attempt:
+                sleep_s = backoff_s * (2 ** (attempt - 1))
+                _trace.event("chaos.backoff", index=index,
+                             sleep_s=round(sleep_s, 3))
+                time.sleep(sleep_s)
+                _trace.event("chaos.retry", index=index, attempt=attempt)
+            _trace.event("chaos.spawn", index=index, attempt=attempt)
+            try:
+                proc = subprocess.run(cmd, capture_output=True, text=True,
+                                      timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                _trace.event("chaos.timeout", index=index, attempt=attempt,
+                             timeout_s=timeout_s)
+                errors.append(f"attempt {attempt}: timeout after {timeout_s}s")
+                continue
+            if proc.returncode != 0:
+                _trace.event("chaos.exit_error", index=index, attempt=attempt,
+                             rc=proc.returncode)
+                errors.append(f"attempt {attempt}: exit {proc.returncode} "
+                              f"({(proc.stderr or '').strip()[-200:]})")
+                continue
+            try:
+                payload = json.loads(proc.stdout.strip().splitlines()[-1])
+            except (ValueError, IndexError):
+                errors.append(f"attempt {attempt}: unparseable child output "
+                              f"({proc.stdout[-200:]!r})")
+                continue
+            payload["attempts"] = attempt + 1
+            sp["status"] = payload.get("status")
+            sp["attempts"] = attempt + 1
+            return payload
+        _trace.event("chaos.skip", index=index)
+        sp["status"] = "skipped"
     return {"status": "skipped", "config": dataclasses.asdict(cfg),
             "attempts": 2, "error": "; ".join(errors)}
 
@@ -414,15 +487,21 @@ def run_child(cfg_dict: dict, oracle_n: int, inject=None) -> dict:
     from byzantinerandomizedconsensus_tpu.backends import batch as _batch
 
     _batch.maybe_enable_cache_from_env()
+    # Per-worker telemetry file (BRC_TRACE, set by the parent's --trace):
+    # this child appends to its own trace-w<pid>.jsonl; the coordinator
+    # merges every worker file after the run (obs/trace.py).
+    _trace.maybe_enable_from_env()
     cfg = SimConfig(**cfg_dict).validate()
     from byzantinerandomizedconsensus_tpu.models import invariants
     from byzantinerandomizedconsensus_tpu.utils.devices import (
         ensure_live_backend)
 
     numpy_be = get_backend("numpy")
-    res, state, faulty = numpy_be.run_with_state(cfg)
-    viol = invariants.state_violations(cfg, state, faulty, res=res,
-                                       inst_ids=res.inst_ids)
+    with _trace.span("chaos.child.numpy", n=cfg.n, protocol=cfg.protocol,
+                     delivery=cfg.delivery, faults=cfg.faults):
+        res, state, faulty = numpy_be.run_with_state(cfg)
+        viol = invariants.state_violations(cfg, state, faulty, res=res,
+                                           inst_ids=res.inst_ids)
     rec = {
         "status": "ok",
         "config": cfg_dict,
@@ -432,7 +511,9 @@ def run_child(cfg_dict: dict, oracle_n: int, inject=None) -> dict:
         "capped": int((res.decision == 2).sum()),
     }
     ensure_live_backend()  # never hang the child on a dead TPU tunnel
-    jres = get_backend("jax").run(cfg)
+    with _trace.span("chaos.child.jax", n=cfg.n, protocol=cfg.protocol,
+                     delivery=cfg.delivery, faults=cfg.faults):
+        jres = get_backend("jax").run(cfg)
     if not (np.array_equal(res.rounds, jres.rounds)
             and np.array_equal(res.decision, jres.decision)):
         rec["status"] = "mismatch"
@@ -441,7 +522,9 @@ def run_child(cfg_dict: dict, oracle_n: int, inject=None) -> dict:
         return rec
     if oracle_n > 0:
         ids = np.arange(min(oracle_n, cfg.instances), dtype=np.int64)
-        ores = get_backend("cpu").run(cfg, ids)
+        with _trace.span("chaos.child.oracle", n=cfg.n,
+                         instances=int(len(ids))):
+            ores = get_backend("cpu").run(cfg, ids)
         rec["oracle_instances"] = int(len(ids))
         if not (np.array_equal(res.rounds[: len(ids)], ores.rounds)
                 and np.array_equal(res.decision[: len(ids)], ores.decision)):
@@ -481,6 +564,13 @@ def main(argv=None) -> int:
                          "shared by every worker subprocess (exported as "
                          "BRC_COMPILATION_CACHE) — retries and resumes "
                          "start warm")
+    ap.add_argument("--trace", default=None, metavar="DIR",
+                    help="host-side telemetry (obs/trace.py): coordinator "
+                         "lifecycle/heartbeat events + one JSONL per worker "
+                         "subprocess in DIR (exported as BRC_TRACE), merged "
+                         "to DIR/trace.jsonl after the run; the artifact "
+                         "gains the schema-v1.3 trace block. Watch live "
+                         "with `brc-tpu trace follow DIR`")
     ap.add_argument("--liveness", action="store_true",
                     help="chaos: embed the spec-§9 liveness-degradation rows "
                          "(tools/divergence.py fault leg) in the artifact")
@@ -515,7 +605,7 @@ def main(argv=None) -> int:
                    oracle_instances=args.oracle_instances,
                    chaos=args.chaos, timeout_s=args.timeout,
                    backoff_s=args.backoff, checkpoint=checkpoint,
-                   jobs=max(1, args.jobs))
+                   jobs=max(1, args.jobs), trace_dir=args.trace)
     if args.chaos:
         doc["jobs"] = max(1, args.jobs)
         if args.compile_cache:

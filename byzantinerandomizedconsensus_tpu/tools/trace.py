@@ -1,0 +1,347 @@
+"""``brc-tpu trace`` — consumer surfaces over the host-side telemetry JSONL
+(obs/trace.py; round 12).
+
+Four verbs:
+
+- ``export --chrome SRC [--out FILE]`` — convert a trace JSONL file (or a
+  trace directory: its merged ``trace.jsonl``, else all per-worker files)
+  to Chrome trace-event JSON, loadable in Perfetto / chrome://tracing next
+  to a ``--profile`` device trace — host orchestration and device kernels
+  on one screen.
+- ``summary SRC [--json FILE]`` — the per-span-kind count/total/p50/p90/p99
+  digest (obs/trace.digest, via the one ``utils/metrics.percentiles``
+  implementation), rendered as a table; ``--json`` also writes it.
+- ``follow DIR [--interval S] [--once]`` — tail a *live* trace directory
+  (``brc-tpu chaos --trace DIR`` writes one line-buffered JSONL per worker):
+  incremental byte offsets per file, one status line per tick — configs
+  done, mismatches/violations/skips, compaction queue depth, compiles.
+- ``overhead`` — the round-12 inertness instrument: run the seeded chaos
+  grid (tools/bench_batch.chaos_grid — the same population as
+  artifacts/chaos_r9.json) through the fused lanes traced vs untraced,
+  best-of-N walls each, and emit a schema-v1.3 run record
+  (kind="trace_bench", trace block bound) — committed as
+  ``artifacts/trace_r12.json``; exit 0 iff the overhead is within bounds
+  and the traced run was bit-identical.
+
+    python -m byzantinerandomizedconsensus_tpu.tools.trace overhead \
+        --configs 280 --out artifacts/trace_r12.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+from byzantinerandomizedconsensus_tpu.obs import trace as _trace
+
+#: The acceptance bound on tracing overhead over the seeded chaos grid
+#: (ISSUE 7): traced wall / untraced wall - 1 must stay within this.
+OVERHEAD_BOUND = 0.02
+
+
+def _events_of(src) -> list:
+    """Events of a trace JSONL file, or of a directory (preferring its
+    merged ``trace.jsonl``, else concatenating the per-worker files in
+    time order)."""
+    p = pathlib.Path(src)
+    if p.is_dir():
+        merged = p / "trace.jsonl"
+        if merged.exists():
+            return _trace.read_events(merged)
+        events = []
+        for f in sorted(p.glob("trace-*.jsonl")):
+            events.extend(_trace.read_events(f))
+        events.sort(key=lambda e: e.get("ts", 0.0))
+        return events
+    return _trace.read_events(p)
+
+
+def cmd_export(args) -> int:
+    try:
+        events = _events_of(args.src)
+    except OSError as e:
+        print(f"cannot read trace {args.src!r}: {e}", file=sys.stderr)
+        return 2
+    if not args.chrome:
+        print("export currently supports --chrome only", file=sys.stderr)
+        return 2
+    src = pathlib.Path(args.src)
+    out = pathlib.Path(args.out) if args.out else (
+        src / "trace.chrome.json" if src.is_dir()
+        else src.with_suffix(".chrome.json"))
+    _trace.write_chrome(events, out)
+    print(json.dumps({"out": str(out), "events": len(events)}))
+    return 0
+
+
+def cmd_summary(args) -> int:
+    try:
+        events = _events_of(args.src)
+    except OSError as e:
+        print(f"cannot read trace {args.src!r}: {e}", file=sys.stderr)
+        return 2
+    dg = _trace.digest(events)
+    problems = _trace.validate_events(events)
+    lines = [f"trace summary — {len(events)} events, "
+             f"{len(dg)} kinds, {len(problems)} problems"]
+    for kind, entry in dg.items():
+        if "p50_s" in entry:
+            lines.append(
+                f"  {kind}: {entry['count']} spans, "
+                f"total {entry['total_s']} s, p50 {entry['p50_s']} s, "
+                f"p90 {entry['p90_s']} s, p99 {entry['p99_s']} s")
+        else:
+            lines.append(f"  {kind}: {entry['count']} events")
+    for p in problems:
+        lines.append(f"  PROBLEM: {p}")
+    print("\n".join(lines))
+    if args.json:
+        out = pathlib.Path(args.json)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(json.dumps(
+            {"events": len(events), "digest": dg,
+             "problems": problems}, indent=1) + "\n")
+    return 1 if problems else 0
+
+
+# ---------------------------------------------------------------------------
+# follow — live tail of a trace directory
+
+
+def _follow_consume(state: dict, ev: dict) -> None:
+    """Fold one event into the follow-mode aggregate."""
+    state["events"] += 1
+    kind = ev.get("kind", "")
+    attrs = ev.get("attrs") or {}
+    if kind == "chaos.progress":
+        state["progress"] = attrs
+    elif kind == "chaos.start":
+        state["total"] = attrs.get("configs")
+    elif kind == "compile_cache.compile":
+        state["compiles"] += 1
+    elif kind in ("compaction.segment", "compaction.drain"):
+        state["queue"] = attrs.get("queued")
+        state["live"] = attrs.get("live")
+    elif kind == "chaos.skip":
+        state["skips"] += 1
+
+
+def _follow_render(state: dict) -> str:
+    p = state.get("progress") or {}
+    done = p.get("done", 0)
+    total = p.get("total", state.get("total", "?"))
+    parts = [f"{state['events']} events",
+             f"configs {done}/{total}",
+             f"mismatches {p.get('mismatches', 0)}",
+             f"violations {p.get('violations', 0)}",
+             f"skipped {p.get('skipped', state['skips'])}",
+             f"compiles {state['compiles']}"]
+    if state.get("queue") is not None:
+        parts.append(f"queue {state['queue']} (live {state.get('live')})")
+    return "[trace] " + " | ".join(parts)
+
+
+def follow(trace_dir, interval: float = 2.0, once: bool = False,
+           out=print, max_ticks=None) -> dict:
+    """Tail every ``trace*.jsonl`` in ``trace_dir``: per-file byte offsets,
+    only complete lines consumed, one aggregate status line per tick.
+    ``once`` (and ``max_ticks``) bound the loop for drills/tests; returns
+    the final aggregate state."""
+    trace_dir = pathlib.Path(trace_dir)
+    offsets: dict = {}
+    state = {"events": 0, "compiles": 0, "skips": 0, "progress": None,
+             "queue": None, "live": None, "total": None}
+    ticks = 0
+    while True:
+        # Per-worker files only: a post-run merged trace.jsonl duplicates
+        # every worker event and would double-count the aggregate.
+        for p in sorted(trace_dir.glob("trace-*.jsonl")):
+            off = offsets.get(p, 0)
+            try:
+                with open(p, "rb") as fh:
+                    fh.seek(off)
+                    data = fh.read()
+            except OSError:
+                continue
+            end = data.rfind(b"\n") + 1
+            if end <= 0:
+                continue
+            offsets[p] = off + end
+            for line in data[:end].splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue  # torn line mid-write: next tick re-reads
+                _follow_consume(state, ev)
+        out(_follow_render(state))
+        ticks += 1
+        if once or (max_ticks is not None and ticks >= max_ticks):
+            return state
+        done = (state.get("progress") or {}).get("done")
+        total = state.get("total")
+        if done is not None and total is not None and done >= total:
+            return state
+        time.sleep(interval)
+
+
+def cmd_follow(args) -> int:
+    follow(args.src, interval=args.interval, once=args.once)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# overhead — the round-12 inertness measurement
+
+
+def cmd_overhead(args) -> int:
+    import numpy as np
+
+    from byzantinerandomizedconsensus_tpu.backends import get_backend
+    from byzantinerandomizedconsensus_tpu.obs import record
+    from byzantinerandomizedconsensus_tpu.tools import bench_batch
+    from byzantinerandomizedconsensus_tpu.utils.devices import (
+        ensure_live_backend)
+
+    ensure_live_backend()
+    cfgs = bench_batch.chaos_grid(args.configs, args.seed)
+    jb = get_backend("jax")
+    out = pathlib.Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    trace_path = out.with_suffix(".jsonl")
+    trace_path.unlink(missing_ok=True)
+
+    print(f"warm-up: fused grid of {len(cfgs)} configs...", flush=True)
+    baseline, _ = jb.run_fused(cfgs)
+
+    def timed(traced: bool):
+        if traced:
+            _trace.configure(path=trace_path)
+        t0 = time.perf_counter()
+        results, report = jb.run_fused(cfgs)
+        wall = time.perf_counter() - t0
+        if traced:
+            _trace.disable()
+        return wall, results, report
+
+    walls_off, walls_on = [], []
+    identical = True
+    for rep in range(args.repeats):
+        w_off, _res, _ = timed(False)
+        w_on, res_on, _ = timed(True)
+        walls_off.append(round(w_off, 3))
+        walls_on.append(round(w_on, 3))
+        identical = identical and all(
+            np.array_equal(a.rounds, b.rounds)
+            and np.array_equal(a.decision, b.decision)
+            for a, b in zip(baseline, res_on))
+        print(f"repeat {rep}: untraced {w_off:.2f} s, traced {w_on:.2f} s, "
+              f"bit_identical={identical}", flush=True)
+
+    # A compacted sample leg so the committed trace carries the round-11
+    # per-trip anatomy (segment/refill/drain spans) as a queryable timeline,
+    # not just dispatch spans. Untimed: not part of the overhead A/B.
+    from byzantinerandomizedconsensus_tpu.backends.compaction import (
+        CompactionPolicy)
+
+    sample = cfgs[:args.compacted_sample]
+    _trace.configure(path=trace_path)
+    res_comp, _rep = jb.run_fused(sample, compaction=CompactionPolicy(
+        width=64, segment=1))
+    _trace.disable()
+    identical = identical and all(
+        np.array_equal(a.rounds, b.rounds)
+        and np.array_equal(a.decision, b.decision)
+        for a, b in zip(baseline[:len(sample)], res_comp))
+
+    overhead = (min(walls_on) / min(walls_off) - 1.0) if min(walls_off) \
+        else None
+    doc = {
+        **record.new_record("trace_bench"),
+        "description": "host-side telemetry overhead A/B on the seeded "
+                       "chaos grid: fused lanes traced vs untraced, "
+                       "best-of-N walls, results bit-compared "
+                       "(tools/trace.py overhead; round 12)",
+        "generator_version": bench_batch.soak.GENERATOR_VERSION,
+        "seed": args.seed,
+        "configs": args.configs,
+        "repeats": args.repeats,
+        "legs": {
+            "untraced": {"walls_s": walls_off,
+                         "wall_s": min(walls_off)},
+            "traced": {"walls_s": walls_on, "wall_s": min(walls_on)},
+        },
+        "overhead_fraction": (round(overhead, 4)
+                              if overhead is not None else None),
+        "overhead_bound": OVERHEAD_BOUND,
+        "bit_identical": bool(identical),
+        "compacted_sample_configs": len(sample),
+        "compile_cache": record.compile_cache_block(jb),
+        "device_chain_note": (
+            "wall-only A/B; CPU XLA walls are a valid capture for the "
+            "traced-vs-untraced ratio (host-side instrumentation only), "
+            "the r5 device chain rule still applies to any kernel-time "
+            "claim (docs/PERF.md)"),
+        "trace": record.trace_block(trace_path),
+    }
+    out.write_text(json.dumps(doc, indent=1) + "\n")
+    summary = {"out": str(out),
+               "overhead_fraction": doc["overhead_fraction"],
+               "bit_identical": doc["bit_identical"],
+               "trace_events": (doc["trace"] or {}).get("events")}
+    print(json.dumps(summary))
+    ok = (identical and overhead is not None
+          and overhead <= OVERHEAD_BOUND and doc["trace"] is not None)
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p_ex = sub.add_parser("export", help="convert trace JSONL to Chrome "
+                                         "trace-event JSON (Perfetto)")
+    p_ex.add_argument("src", help="trace JSONL file or trace directory")
+    p_ex.add_argument("--chrome", action="store_true",
+                      help="Chrome trace-event format (the only format yet)")
+    p_ex.add_argument("--out", default=None)
+    p_ex.set_defaults(fn=cmd_export)
+
+    p_su = sub.add_parser("summary", help="per-span-kind "
+                                          "count/total/p50/p90/p99 digest")
+    p_su.add_argument("src", help="trace JSONL file or trace directory")
+    p_su.add_argument("--json", default=None, metavar="FILE")
+    p_su.set_defaults(fn=cmd_summary)
+
+    p_fo = sub.add_parser("follow", help="tail a live trace directory "
+                                         "(chaos --trace DIR)")
+    p_fo.add_argument("src", help="trace directory being written")
+    p_fo.add_argument("--interval", type=float, default=2.0)
+    p_fo.add_argument("--once", action="store_true",
+                      help="one pass + one status line, then exit")
+    p_fo.set_defaults(fn=cmd_follow)
+
+    p_ov = sub.add_parser("overhead",
+                          help="traced-vs-untraced A/B on the seeded chaos "
+                               "grid (the round-12 inertness artifact)")
+    p_ov.add_argument("--configs", type=int, default=280)
+    p_ov.add_argument("--seed", type=int, default=0)
+    p_ov.add_argument("--repeats", type=int, default=3)
+    p_ov.add_argument("--compacted-sample", type=int, default=40,
+                      help="configs for the untimed compacted trace leg")
+    from byzantinerandomizedconsensus_tpu.utils.rounds import default_artifact
+
+    p_ov.add_argument("--out", default=default_artifact("trace"))
+    p_ov.set_defaults(fn=cmd_overhead)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

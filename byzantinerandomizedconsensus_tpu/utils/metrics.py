@@ -9,6 +9,7 @@ hard-part 2): ``decision == 2`` marks undecided-at-cap, and such instances sit i
 from __future__ import annotations
 
 import json
+import math
 
 import numpy as np
 
@@ -24,6 +25,27 @@ def round_histogram(res: SimResult) -> np.ndarray:
 def decision_histogram(res: SimResult) -> np.ndarray:
     """(3,) int64 — counts of decisions 0, 1, and 2 (= undecided at cap)."""
     return np.bincount(res.decision, minlength=3).astype(np.int64)
+
+
+def percentiles(values, qs=(50, 90, 99)) -> list:
+    """Exact nearest-rank percentiles, one per ``q`` in ``qs`` (percent,
+    0 < q <= 100): the q-th percentile is the ceil(q·N/100)-th smallest
+    element — no interpolation, so the returned value is always an element
+    of ``values`` (int rounds stay exact ints). Empty input maps every q to
+    None. The ONE quantile implementation the trace digests (obs/trace.py),
+    ``summary``'s rounds percentiles, and the serving loop's future p50/p99
+    request-latency targets (ROADMAP #1) share."""
+    vals = sorted(np.asarray(values).ravel().tolist())
+    n = len(vals)
+    out = []
+    for q in qs:
+        if not (0 < q <= 100):
+            raise ValueError(f"percentile {q} out of range (0, 100]")
+        if n == 0:
+            out.append(None)
+            continue
+        out.append(vals[max(1, math.ceil(q * n / 100.0)) - 1])
+    return out
 
 
 def mean_max_rounds_per_chunk(rounds: np.ndarray, chunk: int) -> float | None:
@@ -101,6 +123,11 @@ def summary(res: SimResult, walls=None, device=None, chunk=None) -> dict:
         "round_cap": res.config.round_cap,
         "mean_rounds_decided": float(res.rounds[decided].mean()) if decided.any() else None,
         "max_rounds": int(res.rounds.max()) if len(res.rounds) else 0,
+        # Exact nearest-rank percentiles over ALL instances (capped ones sit
+        # at round_cap — the tail a p99 exists to expose), shared with the
+        # trace digests via the one percentiles() implementation.
+        **dict(zip(("rounds_p50", "rounds_p90", "rounds_p99"),
+                   percentiles(res.rounds, (50, 90, 99)))),
         "decision_histogram": dh.tolist(),
         "wall_s": res.wall_s,
         "instances_per_sec": res.instances_per_sec if res.wall_s else None,

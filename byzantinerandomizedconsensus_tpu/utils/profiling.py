@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import contextlib
 import pathlib
+import sys
 
 
 @contextlib.contextmanager
@@ -16,11 +17,22 @@ def trace(out_dir=None):
 
     ``None`` disables profiling (no-op), so call sites can thread a CLI flag
     straight through. Trace directories are TensorBoard-/Perfetto-loadable.
+
+    Same guarded fallback as :func:`annotate` when jax is unavailable (the
+    module's no-op contract): warn on stderr and still yield, instead of
+    dying on the import — a ``--profile DIR`` run in an interpret-mode/no-jax
+    environment must degrade to an unprofiled run, not a crash.
     """
     if out_dir is None:
         yield
         return
-    import jax
+    try:
+        import jax
+    except Exception:  # no-op fallback, same contract as annotate's
+        print(f"[profiling] jax unavailable: --profile {out_dir} disabled "
+              "(running unprofiled)", file=sys.stderr)
+        yield
+        return
 
     out = pathlib.Path(out_dir)
     out.mkdir(parents=True, exist_ok=True)

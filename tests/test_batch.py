@@ -100,6 +100,39 @@ def test_compile_cache_lru_bounded_eviction():
     s = cache.stats()
     assert s["compiles"] == 3 and s["hits"] == 2 and s["evictions"] == 1
     assert s["entries"] == 2 and s["max_entries"] == 2
+    assert s["compile_wall_s"] >= 0  # schema v1.3: build wall accounted
+
+
+def test_compile_cache_times_lazy_first_call():
+    """compile_wall_s must capture the *lazy* jit cost (round-12 satellite):
+    build() returning a callable defers the real compile to the first
+    invocation, so the cache times that first call, folds it into the
+    total, and unwraps — steady-state calls pay no timing."""
+    import time as _time
+
+    cache = CompileCache(max_entries=4)
+
+    def build():
+        def fn(x):  # "compile" on first call
+            _time.sleep(0.01)
+            return x + 1
+
+        return fn
+
+    got = cache.get("k", build)
+    assert cache.compile_wall_s < 0.005  # build itself was cheap
+    assert got(1) == 2
+    assert cache.compile_wall_s >= 0.01  # first call captured
+    wall_after_first = cache.compile_wall_s
+    # A held wrapper reference (the multi-chunk dispatch loop fetches the
+    # program ONCE and calls it per chunk) must not re-time later calls.
+    assert got(5) == 6
+    assert cache.compile_wall_s == wall_after_first
+    unwrapped = cache.get("k", build)
+    assert unwrapped is not got  # the timed wrapper was replaced...
+    assert unwrapped(2) == 3
+    assert cache.compile_wall_s == wall_after_first  # ...and timing stopped
+    assert cache.stats()["compiles"] == 1 and cache.stats()["hits"] == 1
 
 
 # ---------------------------------------------------------------------------

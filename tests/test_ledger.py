@@ -203,3 +203,51 @@ def test_census_includes_chaos_artifact():
     assert chaos["violations"] == []
     assert chaos["configs"] >= 200
     assert record.validate_record(chaos) == []
+
+
+def test_census_includes_trace_artifact():
+    """The round-12 telemetry artifact: scanned, parsed with zero errors,
+    the inertness acceptance (bit-identical + overhead within the bound)
+    on the record, and the schema-v1.3 trace-digest + compile-wall columns
+    reconstructed by the ledger."""
+    import pathlib
+
+    from byzantinerandomizedconsensus_tpu.utils.rounds import repo_root
+
+    doc = ledger.build_ledger()
+    assert doc["parse_errors"] == []
+    rows = {r["artifact"]: r for r in doc["trace_rows"]}
+    assert "artifacts/trace_r12.json" in rows, \
+        "trace_r12.json must yield trace-digest columns"
+    row = rows["artifacts/trace_r12.json"]
+    assert isinstance(row["events"], int) and row["events"] >= 1
+    assert row["span_kinds"] >= 3  # dispatch + bucket + compaction kinds
+    assert row["total_s"] > 0
+
+    # The compile-cache columns now carry the v1.3 compile wall for it.
+    cc_rows = {r["artifact"]: r for r in doc["compile_cache_rows"]}
+    assert "artifacts/trace_r12.json" in cc_rows
+    assert cc_rows["artifacts/trace_r12.json"]["compile_wall_s"] > 0
+
+    tr = json.loads(
+        (pathlib.Path(repo_root()) / "artifacts/trace_r12.json").read_text())
+    assert tr["kind"] == "trace_bench"
+    assert record.validate_record(tr) == []
+    assert tr["record_revision"] >= 3  # schema v1.3
+    assert tr["bit_identical"] is True
+    assert tr["overhead_fraction"] is not None
+    assert tr["overhead_fraction"] <= tr["overhead_bound"] == 0.02
+    assert tr["trace"]["file"] == "trace_r12.jsonl"
+    assert tr["trace"]["digest"]  # non-empty span digest on the record
+    assert "device_chain_note" in tr  # CPU-only capture, rule on record
+
+    # The committed trace file itself stays well-formed next to the record.
+    from byzantinerandomizedconsensus_tpu.obs import trace as trace_mod
+
+    jsonl = pathlib.Path(repo_root()) / "artifacts/trace_r12.jsonl"
+    assert trace_mod.validate_file(jsonl) == []
+
+    # And the report renders the v1.3 columns.
+    report = ledger.format_report(doc)
+    assert "trace-digest columns" in report
+    assert "compile wall" in report

@@ -70,3 +70,64 @@ def test_summary_triage_fields_and_timing_legs():
     import json
 
     json.dumps(s)  # every field JSON-serializable
+
+
+def test_percentiles_exact_nearest_rank():
+    """The one quantile implementation (round-12 satellite): exact
+    nearest-rank, no interpolation — int inputs yield int elements of the
+    input, never invented midpoints."""
+    vals = metrics.percentiles([4, 1, 3, 2], (50, 90, 99, 100))
+    assert vals == [2, 4, 4, 4]
+    assert all(isinstance(v, int) for v in vals)
+    # numpy int arrays come back as exact python ints too.
+    arr = np.array([7, 7, 9, 11, 30], dtype=np.int32)
+    assert metrics.percentiles(arr, (50, 99)) == [9, 30]
+    # p50 of an even count is the lower middle (nearest-rank, not the mean).
+    assert metrics.percentiles([1, 2], (50,)) == [1]
+    assert metrics.percentiles([], (50, 99)) == [None, None]
+    import pytest
+
+    with pytest.raises(ValueError, match="out of range"):
+        metrics.percentiles([1], (0,))
+
+
+def test_summary_reports_rounds_percentiles():
+    cfg = preset("config1", instances=5).validate()
+    res = SimResult(config=cfg, inst_ids=np.arange(5),
+                    rounds=np.array([1, 1, 2, 3, 9], dtype=np.int32),
+                    decision=np.array([0, 1, 1, 0, 2], dtype=np.uint8))
+    s = metrics.summary(res)
+    assert (s["rounds_p50"], s["rounds_p90"], s["rounds_p99"]) == (2, 9, 9)
+    import json
+
+    json.dumps(s)
+
+
+def test_schema_census_every_committed_artifact_validates():
+    """Schema-drift tripwire (round-12 satellite): validate_record over
+    EVERY committed artifacts/*.json and BENCH_r*.json that carries a
+    record_version head — a schema change that orphans an old artifact
+    fails here, not in some future ledger run. (The ledger's parse census
+    only checks that the JSON loads.)"""
+    import json
+    import pathlib
+
+    from byzantinerandomizedconsensus_tpu.utils.rounds import repo_root
+
+    root = pathlib.Path(repo_root())
+    files = sorted((root / "artifacts").glob("*.json")) + \
+        sorted(root.glob("BENCH_r*.json"))
+    assert files, "no committed artifacts found"
+    checked = []
+    for p in files:
+        doc = json.loads(p.read_text())
+        payload = doc.get("parsed", doc) if isinstance(doc, dict) else {}
+        if not (isinstance(payload, dict) and "record_version" in payload):
+            continue  # legacy r1-r7 shapes predate the schema head
+        problems = record.validate_record(payload)
+        assert problems == [], (p.name, problems)
+        checked.append(p.name)
+    # The v1+ era census as committed (r8-r12: ledger_r8, chaos_r9,
+    # batch_r10, compaction_r11, BENCH_r11, trace_r12): an accidentally
+    # narrowed glob must not silently pass on near-zero coverage.
+    assert len(checked) >= 5, checked

@@ -97,10 +97,26 @@ def test_chaos_smoke_subprocess_leg(tmp_path):
     real subprocess (numpy-vs-jax + oracle subsample + safety invariants) —
     zero mismatches, zero violations, zero skips. Runs under ``--jobs 2``
     (round 10): the population is pre-drawn, so the worker pool must report
-    the exact same census the sequential path would."""
+    the exact same census the sequential path would.
+
+    Round 12: the smoke also runs **traced** (``--trace`` / ``trace_dir``)
+    and asserts the whole telemetry pipeline on the result — per-worker
+    JSONL files written by the real subprocesses, coordinator lifecycle +
+    heartbeat events, the merged trace well-formed (every line parses,
+    spans properly nested per worker), the schema-v1.3 trace block bound
+    into the artifact, and ``brc-tpu trace export --chrome`` emitting
+    structurally valid trace-event JSON."""
+    import json
+    import pathlib
+
+    from byzantinerandomizedconsensus_tpu.obs import record, trace
+    from byzantinerandomizedconsensus_tpu.tools import trace as trace_tool
+
+    trace_dir = tmp_path / "tr"
     doc = soak.run_soak(8, seed=123, oracle_every=4, oracle_instances=2,
                         chaos=True, timeout_s=600, jobs=2,
                         checkpoint=str(tmp_path / "ck.json"),
+                        trace_dir=str(trace_dir),
                         progress=lambda *a: None)
     assert doc["configs"] == 8
     assert doc["chaos"] is True
@@ -112,6 +128,44 @@ def test_chaos_smoke_subprocess_leg(tmp_path):
     assert sum(doc["by_faults"].values()) == 8
     assert sum(1 for k, v in doc["by_faults"].items()
                if k != "none" and v) >= 2  # fault kinds actually exercised
+
+    # --- the traced-run telemetry assertions (round-12 CI satellite) ---
+    assert not trace.enabled()  # run_soak cleaned up the global tracer
+    merged = pathlib.Path(trace_dir) / "trace.jsonl"
+    assert merged.exists()
+    assert trace.validate_file(merged) == []  # parses + nested per worker
+    events = trace.read_events(merged)
+    kinds = {e["kind"] for e in events}
+    # Coordinator lifecycle + heartbeat, and real subprocess-worker spans
+    # (each child wrote its own trace-w<pid>.jsonl via BRC_TRACE).
+    assert {"chaos.start", "chaos.spawn", "chaos.config", "chaos.progress",
+            "chaos.done", "chaos.child.numpy", "chaos.child.jax"} <= kinds
+    assert len({e["pid"] for e in events}) >= 2  # coordinator + workers
+    heartbeats = [e for e in events if e["kind"] == "chaos.progress"]
+    assert heartbeats[-1]["attrs"]["done"] == 8
+
+    # The artifact binds the trace (schema v1.3) and still validates.
+    assert doc["trace"] is not None
+    assert doc["trace"]["file"] == "trace.jsonl"
+    assert doc["trace"]["events"] == len(events)
+    assert doc["trace"]["digest"]["chaos.config"]["count"] == 8
+    assert record.validate_record(doc) == []
+
+    # Chrome export over the merged trace: structurally valid trace-event
+    # JSON (the Perfetto-loadable form).
+    out = tmp_path / "trace.chrome.json"
+    assert trace_tool.main(["export", "--chrome", str(merged),
+                            "--out", str(out)]) == 0
+    chrome = json.loads(out.read_text())
+    assert isinstance(chrome["traceEvents"], list)
+    assert len(chrome["traceEvents"]) == len(events)
+    assert all(ev["ph"] in ("X", "i") and "ts" in ev and "name" in ev
+               for ev in chrome["traceEvents"])
+
+    # And the live follow surface reads the same directory.
+    state = trace_tool.follow(trace_dir, once=True, out=lambda *a: None)
+    assert state["progress"]["done"] == 8
+    assert state["progress"]["mismatches"] == 0
 
     # A --jobs run's checkpoint resumes (no subprocesses this time): the
     # parallel merge wrote every record under the same binding keys.

@@ -1,0 +1,360 @@
+"""Host-side telemetry pipeline (obs/trace.py; round 12).
+
+The acceptance bar has two halves. Inertness: tracing must be strictly a
+side channel — results bit-identical traced vs untraced across the
+fault x adversary x delivery grid, on both the vmapped-lane and the
+compacted-lane paths (the measured wall-overhead bound lives in
+artifacts/trace_r12.json / docs/PERF.md round 12). Fidelity: the JSONL is
+well-formed (every line parses, spans properly nested per worker), the
+digest is the exact nearest-rank percentile law, the Chrome export is
+structurally valid trace-event JSON, and the follow mode reads a live
+directory incrementally.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from byzantinerandomizedconsensus_tpu.backends import get_backend
+from byzantinerandomizedconsensus_tpu.config import (
+    DELIVERY_KINDS, FAULT_KINDS, SimConfig)
+from byzantinerandomizedconsensus_tpu.obs import record, trace
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_tracer():
+    """Every test starts and ends with tracing disabled — a leaked global
+    tracer would silently instrument unrelated tests."""
+    trace.disable()
+    yield
+    trace.disable()
+
+
+def _cfg(adv, proto, delivery, fault, n=7, f=2, seed=13, **kw):
+    base = dict(protocol=proto, n=n, f=f, instances=4, adversary=adv,
+                coin="local", seed=seed, round_cap=32, delivery=delivery,
+                faults=fault)
+    base.update(kw)
+    return SimConfig(**base).validate()
+
+
+# ---------------------------------------------------------------------------
+# the tracer itself
+
+
+def test_disabled_fast_path_is_inert():
+    assert not trace.enabled()
+    trace.event("x", a=1)  # no tracer: must be a no-op, not an error
+    cm = trace.span("y", b=2)
+    assert cm is trace.span("z")  # the shared no-op context manager
+    with cm as sp:
+        sp["post"] = 3  # writes to the discard sink go nowhere
+    assert trace.current() is None
+
+
+def test_in_memory_tracer_records_spans_and_events():
+    tr = trace.configure()  # no sink: bounded in-memory
+    with trace.span("work", stage=1) as sp:
+        sp["result"] = "ok"
+    trace.event("tick", n=2)
+    assert len(tr.events) == 2
+    span_ev = next(e for e in tr.events if e["ph"] == "X")
+    inst_ev = next(e for e in tr.events if e["ph"] == "i")
+    assert span_ev["kind"] == "work" and span_ev["dur"] >= 0
+    assert span_ev["attrs"] == {"stage": 1, "result": "ok"}
+    assert inst_ev["kind"] == "tick" and inst_ev["attrs"] == {"n": 2}
+    trace.disable()
+    assert not trace.enabled()
+
+
+def test_in_memory_tracer_bounds_memory():
+    tr = trace.configure(max_events=5)
+    for i in range(9):
+        trace.event("e", i=i)
+    assert len(tr.events) == 5 and tr.dropped == 4
+
+
+def test_file_sink_is_threadsafe_jsonl(tmp_path):
+    tr = trace.configure(tmp_path, role="threads")
+    barrier = threading.Barrier(4)  # all 4 alive at once: no ident reuse
+
+    def worker(w):
+        barrier.wait()
+        for i in range(20):
+            with trace.span("w.span", worker=w, i=i):
+                pass
+        barrier.wait()
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(4)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    trace.disable()
+    path = tmp_path / "trace-threads.jsonl"
+    events = trace.read_events(path)
+    assert len(events) == 80
+    assert trace.validate_file(path) == []
+    # 4 threads -> 4 distinct tids, each with its own properly-nested run.
+    assert len({e["tid"] for e in events}) == 4
+
+
+def test_merge_orders_worker_files_by_time(tmp_path):
+    for role, ts0 in (("w1", 10.0), ("w2", 5.0)):
+        with open(tmp_path / f"trace-{role}.jsonl", "w") as fh:
+            for k in range(3):
+                fh.write(json.dumps({"ph": "i", "kind": f"{role}.e",
+                                     "ts": ts0 + k, "pid": 1, "tid": 0})
+                         + "\n")
+    merged = trace.merge(tmp_path)
+    events = trace.read_events(merged)
+    assert [e["ts"] for e in events] == sorted(e["ts"] for e in events)
+    assert events[0]["kind"] == "w2.e" and events[-1]["kind"] == "w1.e"
+
+
+def test_validate_catches_torn_lines_and_overlap(tmp_path):
+    p = tmp_path / "trace-bad.jsonl"
+    lines = [
+        json.dumps({"ph": "X", "kind": "a", "ts": 1.0, "dur": 2.0,
+                    "pid": 1, "tid": 0}),
+        # partial overlap with "a" on the same thread: starts inside, ends
+        # outside — improper nesting.
+        json.dumps({"ph": "X", "kind": "b", "ts": 2.0, "dur": 3.0,
+                    "pid": 1, "tid": 0}),
+        "{torn json",
+        json.dumps({"ph": "?", "kind": "c", "ts": 3.0}),
+    ]
+    p.write_text("\n".join(lines) + "\n")
+    problems = trace.validate_file(p)
+    assert any("unparseable" in s for s in problems)
+    assert any("overlaps" in s for s in problems)
+    assert any("missing kind/ph" in s for s in problems)
+    # Properly nested + disjoint spans on one thread: clean.
+    good = tmp_path / "trace-good.jsonl"
+    good.write_text("\n".join(
+        json.dumps(e) for e in [
+            {"ph": "X", "kind": "parent", "ts": 1.0, "dur": 4.0,
+             "pid": 1, "tid": 0},
+            {"ph": "X", "kind": "child", "ts": 2.0, "dur": 1.0,
+             "pid": 1, "tid": 0},
+            {"ph": "X", "kind": "sibling", "ts": 6.0, "dur": 1.0,
+             "pid": 1, "tid": 0},
+        ]) + "\n")
+    assert trace.validate_file(good) == []
+
+
+def test_digest_is_exact_nearest_rank():
+    events = ([{"ph": "X", "kind": "k", "ts": 0.0, "dur": d}
+               for d in (0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0)]
+              + [{"ph": "i", "kind": "tick", "ts": 0.0}])
+    dg = trace.digest(events)
+    k = dg["k"]
+    assert k["count"] == 10 and k["total_s"] == 5.5
+    # nearest-rank on 10 values: p50 = 5th smallest, p90 = 9th, p99 = 10th.
+    assert (k["p50_s"], k["p90_s"], k["p99_s"]) == (0.5, 0.9, 1.0)
+    assert dg["tick"] == {"count": 1, "total_s": 0.0}
+
+
+def test_chrome_export_structure(tmp_path):
+    tr = trace.configure(tmp_path, role="ch")
+    with trace.span("s", a=1):
+        trace.event("e", b=2)
+    trace.disable()
+    events = trace.read_events(tmp_path / "trace-ch.jsonl")
+    doc = trace.to_chrome(events)
+    assert isinstance(doc["traceEvents"], list) and len(doc["traceEvents"]) == 2
+    for ev in doc["traceEvents"]:
+        assert ev["ph"] in ("X", "i") and isinstance(ev["name"], str)
+        assert isinstance(ev["ts"], (int, float))  # microseconds
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0
+        else:
+            assert ev["s"] == "t"
+    # instants precede their enclosing span in file order (span written at
+    # exit); chrome ts ordering is the reader's job, not the writer's.
+    out = trace.write_chrome(events, tmp_path / "t.chrome.json")
+    assert json.loads(out.read_text())["traceEvents"]
+
+
+# ---------------------------------------------------------------------------
+# inertness: the tentpole acceptance bar
+
+
+def test_tracing_inert_across_fault_adversary_delivery_grid(tmp_path):
+    """Bit-identity traced vs untraced over a covering (fault, delivery)
+    sample with rotating adversaries — vmapped lanes AND the compacted lane
+    grid (the two instrumented hot paths). The trace itself must come out
+    non-trivial and well-formed."""
+    from byzantinerandomizedconsensus_tpu.backends.compaction import (
+        CompactionPolicy)
+
+    _ADV_PROTO = (("none", "benor"), ("crash", "benor"),
+                  ("byzantine", "bracha"), ("adaptive", "bracha"))
+    cells = [(FAULT_KINDS[i], DELIVERY_KINDS[j])
+             for i, j in ((0, 0), (1, 1), (2, 3), (3, 2))]
+    cfgs = []
+    for i, (fault, delivery) in enumerate(cells):
+        adv, proto = _ADV_PROTO[i % len(_ADV_PROTO)]
+        cfgs += [_cfg(adv, proto, delivery, fault),
+                 _cfg(adv, proto, delivery, fault, f=1, seed=99,
+                      instances=6)]
+    jb = get_backend("jax")
+    base, _ = jb.run_many(cfgs)
+    base_c, _ = jb.run_many(cfgs, compaction=CompactionPolicy(width=4,
+                                                              segment=1))
+
+    trace.configure(tmp_path, role="grid")
+    traced, _ = jb.run_many(cfgs)
+    traced_c, _ = jb.run_many(cfgs, compaction=CompactionPolicy(width=4,
+                                                                segment=1))
+    trace.disable()
+
+    for a, b in zip(base + base_c, traced + traced_c):
+        np.testing.assert_array_equal(a.rounds, b.rounds)
+        np.testing.assert_array_equal(a.decision, b.decision)
+
+    path = tmp_path / "trace-grid.jsonl"
+    assert trace.validate_file(path) == []
+    kinds = {e["kind"] for e in trace.read_events(path)}
+    assert {"batch.bucket", "batch.dispatch", "compaction.segment",
+            "compaction.drain", "compaction.init"} <= kinds
+
+
+def test_compaction_spans_carry_anatomy_attrs(tmp_path):
+    """The round-11 per-trip anatomy as a queryable timeline: segment/drain
+    spans carry queue depth, retired-lane counts and per-trip rounds; the
+    refill span carries keep/take."""
+    from byzantinerandomizedconsensus_tpu.backends.compaction import (
+        CompactionPolicy)
+
+    cfgs = [_cfg("crash", "benor", "urn2", "none", seed=s, instances=8)
+            for s in (1, 2, 3)]
+    jb = get_backend("jax")
+    trace.configure(tmp_path, role="comp")
+    jb.run_many(cfgs, compaction=CompactionPolicy(width=4, segment=1))
+    trace.disable()
+    events = trace.read_events(tmp_path / "trace-comp.jsonl")
+    segs = [e for e in events
+            if e["kind"] in ("compaction.segment", "compaction.drain")]
+    assert segs, "no segment spans recorded"
+    for e in segs:
+        at = e["attrs"]
+        assert {"width", "queued", "trip_max", "useful_trips", "retired",
+                "live"} <= set(at)
+    drains = [e for e in events if e["kind"] == "compaction.drain"]
+    assert drains, "the straggler drain must be its own span kind"
+    refills = [e for e in events if e["kind"] == "compaction.refill"]
+    assert all({"keep", "take", "queued"} <= set(e["attrs"])
+               for e in refills)
+
+
+def test_compile_cache_wall_and_events(tmp_path):
+    """The satellite: CompileCache stats carry compile_wall_s (the lazy-jit
+    first-call proxy), and cache traffic lands in the trace as
+    compile/hit events."""
+    from byzantinerandomizedconsensus_tpu.backends.jax_backend import (
+        JaxBackend)
+    from byzantinerandomizedconsensus_tpu.backends import batch as batch_mod
+
+    jb = JaxBackend()  # fresh instance: stats start at zero
+    trace.configure(tmp_path, role="cc")
+    a = _cfg("none", "benor", "urn2", "none", f=2, seed=1, instances=3)
+    b = _cfg("none", "benor", "urn2", "none", f=1, seed=2, instances=3)
+    jb.run_batch([a])
+    jb.run_batch([b])  # same bucket: a cache hit
+    trace.disable()
+    s = batch_mod.compile_cache(jb).stats()
+    assert s["compiles"] >= 1 and s["hits"] >= 1
+    assert s["compile_wall_s"] > 0  # the first dispatch paid a real compile
+    kinds = [e["kind"] for e in
+             trace.read_events(tmp_path / "trace-cc.jsonl")]
+    assert "compile_cache.compile" in kinds and "compile_cache.hit" in kinds
+    # The compile event carries its wall (per-compile, not just the total).
+    ev = next(e for e in trace.read_events(tmp_path / "trace-cc.jsonl")
+              if e["kind"] == "compile_cache.compile")
+    assert ev["attrs"]["wall_s"] > 0
+
+
+# ---------------------------------------------------------------------------
+# consumer surfaces (tools/trace.py)
+
+
+def _write_sample_trace(tmp_path, role="sample"):
+    trace.configure(tmp_path, role=role)
+    trace.event("chaos.start", configs=4, seed=0, chaos=True, jobs=1)
+    for k in range(4):
+        with trace.span("chaos.config", index=k):
+            pass
+        trace.event("chaos.progress", done=k + 1, total=4, mismatches=0,
+                    violations=0, skipped=0)
+    trace.disable()
+    return tmp_path / f"trace-{role}.jsonl"
+
+
+def test_trace_cli_summary_and_export(tmp_path, capsys):
+    from byzantinerandomizedconsensus_tpu.tools import trace as trace_tool
+
+    path = _write_sample_trace(tmp_path)
+    assert trace_tool.main(["summary", str(path),
+                            "--json", str(tmp_path / "dg.json")]) == 0
+    out = capsys.readouterr().out
+    assert "chaos.config" in out and "p99" in out
+    dg = json.loads((tmp_path / "dg.json").read_text())
+    assert dg["problems"] == [] and dg["digest"]["chaos.config"]["count"] == 4
+
+    assert trace_tool.main(["export", "--chrome", str(path)]) == 0
+    out_path = path.with_suffix(".chrome.json")
+    doc = json.loads(out_path.read_text())
+    assert len(doc["traceEvents"]) == 9
+    capsys.readouterr()
+
+
+def test_trace_follow_reads_live_directory_incrementally(tmp_path):
+    from byzantinerandomizedconsensus_tpu.tools import trace as trace_tool
+
+    _write_sample_trace(tmp_path, role="w1")
+    lines = []
+    state = trace_tool.follow(tmp_path, once=True, out=lines.append)
+    assert state["events"] == 9
+    assert state["progress"]["done"] == 4
+    assert "configs 4/4" in lines[-1]
+    # Incremental: append more events, a second pass picks up ONLY the tail.
+    with open(tmp_path / "trace-w1.jsonl", "a") as fh:
+        fh.write(json.dumps({"ph": "i", "kind": "chaos.progress",
+                             "ts": 99.0, "pid": 1, "tid": 0,
+                             "attrs": {"done": 5, "total": 5,
+                                       "mismatches": 1, "violations": 0,
+                                       "skipped": 0}}) + "\n")
+    state2 = trace_tool.follow(tmp_path, once=True, out=lines.append)
+    assert state2["events"] == 10  # fresh offsets: full re-read + tail
+    assert state2["progress"]["mismatches"] == 1
+
+
+def test_cli_routes_trace_verb(tmp_path, capsys):
+    from byzantinerandomizedconsensus_tpu import cli
+
+    path = _write_sample_trace(tmp_path)
+    assert cli.main(["trace", "summary", str(path)]) == 0
+    assert "trace summary" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# schema v1.3 record binding
+
+
+def test_trace_block_and_validate_record(tmp_path):
+    path = _write_sample_trace(tmp_path)
+    blk = record.trace_block(path)
+    assert blk["file"] == path.name and blk["events"] == 9
+    assert blk["digest"]["chaos.config"]["count"] == 4
+    doc = {**record.new_record("soak"), "trace": blk}
+    assert record.validate_record(doc) == []
+    assert doc["record_revision"] >= 3
+    # Drift checks: a torn block and a digest without counts must fail.
+    assert any("trace block missing" in p for p in record.validate_record(
+        {**record.new_record("x"), "trace": {"file": "t.jsonl"}}))
+    assert any("missing 'count'" in p for p in record.validate_record(
+        {**record.new_record("x"),
+         "trace": {"file": "t", "events": 1, "digest": {"k": {}}}}))
+    # Unreadable path: None, never an exception (record assembly survives).
+    assert record.trace_block(tmp_path / "absent.jsonl") is None

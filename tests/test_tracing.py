@@ -59,6 +59,29 @@ def test_profiling_noop_and_annotate():
         assert np.arange(3).sum() == 3
 
 
+def test_trace_falls_back_without_jax(monkeypatch, tmp_path, capsys):
+    """trace(out_dir) must honor the module's no-op contract like annotate
+    does (round-12 satellite): jax unavailable -> stderr warning, still
+    yields, writes nothing — previously it imported jax unconditionally
+    whenever a directory was given and broke the promise."""
+    import builtins
+
+    real_import = builtins.__import__
+
+    def no_jax(name, *args, **kwargs):
+        if name == "jax" or name.startswith("jax."):
+            raise ImportError("jax unavailable (simulated)")
+        return real_import(name, *args, **kwargs)
+
+    monkeypatch.setattr(builtins, "__import__", no_jax)
+    ran = False
+    with profiling.trace(tmp_path / "tr"):
+        ran = True
+    assert ran
+    assert "jax unavailable" in capsys.readouterr().err
+    assert not (tmp_path / "tr").exists()  # degraded to a no-op, no artifacts
+
+
 def test_annotate_falls_back_without_jax(monkeypatch):
     """The module docstring promises a no-op fallback when profiling is
     unavailable — annotate must honor it like trace does, instead of dying
