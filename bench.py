@@ -97,6 +97,18 @@ def main() -> int:
         from byzantinerandomizedconsensus_tpu.obs import trace as _trace
 
         bench_tracer = _trace.configure(trace_dir, role="bench")
+    # BENCH_PROGRAMS=1 (round 13): the compiled-program census
+    # (obs/programs.py) — the headline program's XLA cost/memory analysis,
+    # HLO fingerprint and compile wall land in the record's schema-v1.4
+    # ``programs`` block next to the compile-cache and trace blocks.
+    # Capture happens at the warm-up compile, so the timed windows below
+    # stay census-steady-state (bit-identical results by construction).
+    bench_census = os.environ.get("BENCH_PROGRAMS", "0") not in ("", "0")
+    if bench_census:
+        from byzantinerandomizedconsensus_tpu.obs import (
+            programs as _programs)
+
+        _programs.configure()
     if not backend:
         import jax
 
@@ -207,6 +219,13 @@ def main() -> int:
 
         trace_block = _trace.finish(bench_tracer)  # flush, close, digest
 
+    programs_block = None
+    if bench_census:
+        # The v1.4 programs block from whatever the census captured this
+        # run (the per-config headline program; the bucket programs too
+        # when BENCH_COUNTERS added a counted leg).
+        programs_block = obs_record.programs_block()
+
     chunk = be._chunk_size(cfg) if hasattr(be, "_chunk_size") else None
     straggler = ({
         "chunk": chunk,
@@ -248,6 +267,8 @@ def main() -> int:
         },
         **({"compaction": compaction} if compaction is not None else {}),
         **({"trace": trace_block} if trace_block is not None else {}),
+        **({"programs": programs_block} if programs_block is not None
+           else {}),
     }))
     return 0
 

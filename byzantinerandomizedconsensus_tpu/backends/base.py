@@ -208,7 +208,19 @@ class JitChunkedBackend(SimulatorBackend):
     def _fn(self, cfg: SimConfig):
         key = self._cache_key(cfg)
         if key not in self._compiled:
-            self._compiled[key] = self._make_fn(key)
+            fn = self._make_fn(key)
+            # The per-config half of the compiled-program census
+            # (obs/programs.py, opt-in): the first call AOT-compiles and
+            # records the program's cost/memory/fingerprint anatomy — the
+            # headline bench path is a per-config program, so BENCH_PROGRAMS
+            # coverage needs this seam as well as the bucket CompileCache.
+            # Strictly inert when the census is off (fn returned unchanged).
+            from byzantinerandomizedconsensus_tpu.obs import (
+                programs as _programs)
+
+            if _programs.enabled():
+                fn = _programs.instrument(_programs.config_label(key), fn)
+            self._compiled[key] = fn
         return self._compiled[key]
 
     def run(self, cfg: SimConfig, inst_ids: Optional[np.ndarray] = None) -> SimResult:
@@ -224,7 +236,18 @@ class JitChunkedBackend(SimulatorBackend):
         # BENCH_TRACE capture shows the product path's chunk anatomy too.
         with self._device_ctx(), \
                 _trace.span("backend.run", backend=self.name, n=cfg.n,
-                            instances=int(len(ids)), chunk=int(chunk)):
+                            instances=int(len(ids)), chunk=int(chunk),
+                            dispatches=-(-len(ids) // chunk)
+                            if len(ids) else 0) as sp:
+            if _trace.enabled():
+                # The per-config census key (obs/programs.py), attached
+                # post-hoc so the untraced fast path never computes it —
+                # the roofline join (tools/programs.py) matches it against
+                # the census like the bucket paths' dispatch spans.
+                from byzantinerandomizedconsensus_tpu.obs import (
+                    programs as _programs)
+
+                sp["program"] = _programs.config_label(self._cache_key(cfg))
             rounds_out, decision_out = self._run_chunked(
                 fn, ids, chunk, self._extra_args(cfg))
         return SimResult(config=cfg, inst_ids=ids, rounds=rounds_out, decision=decision_out)

@@ -49,6 +49,7 @@ import numpy as np
 
 from byzantinerandomizedconsensus_tpu.config import SimConfig, validate_batch
 from byzantinerandomizedconsensus_tpu.models.adversaries import AdversaryModel
+from byzantinerandomizedconsensus_tpu.obs import programs as _programs
 from byzantinerandomizedconsensus_tpu.obs import trace as _trace
 from byzantinerandomizedconsensus_tpu.ops import prf
 
@@ -247,7 +248,16 @@ class CompileCache:
     compile; the one execution riding along is the standard first-call
     proxy), fold it into ``compile_wall_s``, emit the
     ``compile_cache.compile`` trace event (obs/trace.py), and then unwrap
-    so steady-state calls pay nothing."""
+    so steady-state calls pay nothing.
+
+    With the compiled-program census enabled (obs/programs.py — opt-in,
+    round 13), that same first call instead goes through the AOT
+    ``lower()``/``compile()`` stages: the census records the program's cost/
+    memory analyses, HLO fingerprint and signature, the entry is attached
+    here in ``programs`` (keyed by the cache key's label), and the cached
+    callable becomes the compiled executable itself — the same XLA program
+    the lazy jit would have built, so results are bit-identical either way
+    (tests/test_programs.py)."""
 
     def __init__(self, max_entries: int = 32):
         if max_entries < 1:
@@ -258,6 +268,11 @@ class CompileCache:
         self.hits = 0
         self.evictions = 0
         self.compile_wall_s = 0.0
+        #: census entries attached to their cache entry (label -> entry);
+        #: populated only while obs/programs is enabled. Entries survive an
+        #: LRU eviction on purpose — the census is an audit of what this
+        #: cache built, not of what it currently holds.
+        self.programs: OrderedDict = OrderedDict()
 
     def get(self, key, build):
         if key in self._entries:
@@ -290,15 +305,36 @@ class CompileCache:
             # the wrapper (the multi-chunk dispatch loop fetches it once)
             # keep calling it, and those later calls are plain execution —
             # timing them would inflate compile_wall_s and spam the trace.
-            nonlocal timed
+            nonlocal timed, fn
             if timed:
                 return fn(*args, **kw)
+            label = _key_label(key)
+            if _programs.enabled() and hasattr(fn, "lower"):
+                # Census path (opt-in): the one compile seam routes through
+                # AOT lower()/compile() so the program's anatomy is
+                # capturable; the compiled executable replaces the lazy jit
+                # wrapper (same XLA program — bit-identical results).
+                t0 = time.perf_counter()
+                out, compiled, entry = _programs.capture_call(
+                    label, fn, args, kw)
+                wall = time.perf_counter() - t0
+                timed = True
+                self.compile_wall_s += wall
+                _trace.event("compile_cache.compile", key=label,
+                             wall_s=round(build_wall + wall, 6))
+                if entry is not None:
+                    self.programs[label] = entry
+                if compiled is not None:
+                    fn = compiled
+                if self._entries.get(key) is wrapper:  # still cached: unwrap
+                    self._entries[key] = fn
+                return out
             t0 = time.perf_counter()
             out = fn(*args, **kw)
             wall = time.perf_counter() - t0
             timed = True
             self.compile_wall_s += wall
-            _trace.event("compile_cache.compile", key=_key_label(key),
+            _trace.event("compile_cache.compile", key=label,
                          wall_s=round(build_wall + wall, 6))
             if self._entries.get(key) is wrapper:  # still cached: unwrap
                 self._entries[key] = fn
@@ -417,7 +453,8 @@ def run_batch(backend, cfgs: Sequence[SimConfig], inst_ids=None,
     chunk = _chunk_instances(bucket, l_pad, max_i, backend.chunk_bytes,
                              backend.max_chunk)
     cache = compile_cache(backend)
-    fn = cache.get((bucket, l_pad, chunk),
+    cache_key = (bucket, l_pad, chunk)
+    fn = cache.get(cache_key,
                    lambda: jax.jit(partial(_run_lanes, bucket)))
 
     # Lane operands: padding lanes replicate the last config (discarded).
@@ -434,14 +471,19 @@ def run_batch(backend, cfgs: Sequence[SimConfig], inst_ids=None,
                 jnp.asarray(neffs))
 
     return _dispatch_and_collect(backend, fn, lane_ops, cfgs, ids_list,
-                                 l_pad, chunk, max_i, counters)
+                                 l_pad, chunk, max_i, counters,
+                                 program=(_key_label(cache_key)
+                                          if _trace.enabled() else None))
 
 
 def _dispatch_and_collect(backend, fn, lane_ops, cfgs, ids_list, l_pad,
-                          chunk, max_i, counters):
+                          chunk, max_i, counters, program=None):
     """Shared lane-grid executor: async-dispatch every (l_pad, chunk) id
     grid, one batched device_get, per-lane unpad/trim — the run_batch /
-    run_fused common tail."""
+    run_fused common tail. ``program`` is the compiled program's census/
+    cache label, carried on the dispatch span so a roofline join
+    (tools/programs.py) can match per-dispatch wall to per-program
+    flops/bytes."""
     import jax
     import jax.numpy as jnp
 
@@ -454,7 +496,7 @@ def _dispatch_and_collect(backend, fn, lane_ops, cfgs, ids_list, l_pad,
     pending = []
     with backend._device_ctx(), \
             _trace.span("batch.dispatch", lanes=l_pad, chunk=chunk,
-                        configs=lanes,
+                        configs=lanes, program=program,
                         occupancy=round(lanes / l_pad, 4)) as sp:
         for lo in range(0, max_i, chunk):
             grid = np.empty((l_pad, chunk), dtype=np.uint32)
@@ -813,7 +855,8 @@ def run_fused(backend, cfgs: Sequence[SimConfig], inst_ids=None,
         l_pad = lane_tier(lanes)
         chunk = _chunk_instances(bucket, l_pad, max_i, backend.chunk_bytes,
                                  backend.max_chunk)
-        fn = cache.get(("fused", bucket, l_pad, chunk),
+        cache_key = ("fused", bucket, l_pad, chunk)
+        fn = cache.get(cache_key,
                        lambda: jax.jit(partial(_run_fused_lanes, bucket)))
 
         def lc(i):
@@ -844,7 +887,8 @@ def run_fused(backend, cfgs: Sequence[SimConfig], inst_ids=None,
                          configs=len(idxs), mode="fused", lane_tier=l_pad):
             group_res = _dispatch_and_collect(
                 backend, fn, lane_ops, group, ids_list, l_pad, chunk, max_i,
-                counters=False)
+                counters=False, program=(_key_label(cache_key)
+                                         if _trace.enabled() else None))
         for j, i in enumerate(idxs):
             results[i] = group_res[j]
         occupancy.append({"bucket": bucket.label(), "configs": len(idxs),

@@ -53,8 +53,8 @@ import numpy as np
 
 from byzantinerandomizedconsensus_tpu.backends.batch import (
     ADV_CODES, COIN_CODES, FAULT_CODES, INIT_CODES, FusedBucket,
-    FusedLaneConfig, LaneConfig, ShapeBucket, _chunk_instances, _PadAdversary,
-    compile_cache, lane_tier)
+    FusedLaneConfig, LaneConfig, ShapeBucket, _chunk_instances, _key_label,
+    _PadAdversary, compile_cache, lane_tier)
 from byzantinerandomizedconsensus_tpu.obs import trace as _trace
 from byzantinerandomizedconsensus_tpu.ops import prf
 
@@ -431,6 +431,21 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
                          lambda: jax.jit(_make_segment(bucket, seg_len,
                                                        counters)))
 
+    # The census/cache labels of the three (four with the drain variant)
+    # compiled programs, precomputed ONCE so attaching them to segment spans
+    # costs nothing per trip — tools/programs.py joins these against the
+    # per-program flops/bytes census for its roofline table. None when
+    # tracing is off: the untraced fast path computes no label strings
+    # (same discipline as backends/base.py).
+    if _trace.enabled():
+        lab_init = _key_label(("compact-init", bucket, W, counters))
+        lab_refill = _key_label(("compact-refill", bucket, W, W, counters))
+        lab_seg = _key_label(("compact-seg", bucket, W, seg, counters))
+        lab_drain = _key_label(("compact-seg", bucket, W, drain_seg,
+                                counters))
+    else:
+        lab_init = lab_refill = lab_seg = lab_drain = None
+
     def block(take, F):
         """(ops, iids) operand block of F rows: the next ``take`` stream
         items, padded with row-0 repeats (inert — ``n_fill`` gates them)."""
@@ -453,7 +468,7 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
     # policy threshold (always when the grid fully drains).
     take = min(W, total)
     with _trace.span("compaction.init", width=W, fill=take,
-                     queued=total - take):
+                     queued=total - take, program=lab_init):
         ops_b, iids_b = block(take, W)
         carry = init_program()(ops_b, iids_b, jnp.int32(take))
     owner_cfg[:take] = work_cfg[:take]
@@ -467,7 +482,8 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
         drain = head >= total
         with _trace.span("compaction.drain" if drain
                          else "compaction.segment",
-                         width=W, queued=total - head) as sp:
+                         width=W, queued=total - head,
+                         program=lab_drain if drain else lab_seg) as sp:
             fn = segment_program(drain_seg if drain else seg)
             out = fn(*carry)
             carry = out[:n_carry]
@@ -503,7 +519,8 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
                 break
             continue  # queue dry: drain the stragglers, no more refills
         if free >= W * policy.refill_threshold or not live.any():
-            with _trace.span("compaction.refill", width=W) as sp:
+            with _trace.span("compaction.refill", width=W,
+                             program=lab_refill) as sp:
                 perm = np.concatenate(
                     [np.flatnonzero(live),
                      np.flatnonzero(~live)]).astype(np.int32)

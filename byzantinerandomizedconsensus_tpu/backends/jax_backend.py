@@ -221,6 +221,26 @@ class JaxBackend(JitChunkedBackend):
 
         return batch.compile_cache(self).stats()
 
+    def program_census(self) -> dict:
+        """The compiled-program census entries attached to this backend's
+        caches (obs/programs.py, opt-in; schema v1.4): the bucket
+        CompileCache's captures plus any per-config programs captured
+        through :meth:`_fn` — label → entry. Empty when the census was off
+        (``record.programs_block`` then returns None)."""
+        from byzantinerandomizedconsensus_tpu.backends import batch
+
+        out = dict(batch.compile_cache(self).programs)
+        for fn in self._compiled.values():
+            key = getattr(fn, "census_key", None)
+            if key is not None:
+                from byzantinerandomizedconsensus_tpu.obs import (
+                    programs as _programs)
+
+                census = _programs.current()
+                if census is not None and key in census.entries:
+                    out[key] = census.entries[key]
+        return out
+
     def run_compacted(self, cfg: SimConfig, inst_ids=None,
                       counters: bool = False, policy=None):
         """Decision-driven lane compaction (backends/compaction.py; docs/

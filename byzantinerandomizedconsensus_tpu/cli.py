@@ -300,6 +300,9 @@ def main(argv=None) -> int:
     sub.add_parser("ledger",
                    help="regression-chain ledger over every committed "
                         "BENCH/MULTICHIP/artifact JSON (tools/ledger.py; "
+                        "`ledger --check` is the regression sentinel — "
+                        "nonzero on wall regression or program-fingerprint "
+                        "drift; `--json` for the machine-readable verdict; "
                         "all further options pass through)")
     sub.add_parser("chaos",
                    help="chaos soak: randomized spec-§9 fault schedules, "
@@ -313,23 +316,35 @@ def main(argv=None) -> int:
     sub.add_parser("trace",
                    help="host-side telemetry consumers (tools/trace.py): "
                         "`trace export --chrome` (Perfetto), `trace "
-                        "summary` (p50/p90/p99 span digest), `trace "
+                        "summary [--top N]` (p50/p90/p99 span digest, "
+                        "ranked by total wall with --top), `trace "
                         "follow DIR` (live fleet progress), `trace "
                         "overhead` (the traced-vs-untraced A/B)")
+    sub.add_parser("programs",
+                   help="compiled-program census consumers "
+                        "(tools/programs.py): `programs dump ART` (XLA "
+                        "cost/memory + HLO fingerprints), `programs diff "
+                        "A B` (fingerprint drift), `programs roofline "
+                        "--census ART` (per-dispatch wall vs per-program "
+                        "flops/bytes), `programs census` (the "
+                        "census-on-vs-off A/B artifact)")
 
     if argv is None:
         argv = sys.argv[1:]
     if argv and argv[0] in ("accept", "slack", "product", "ledger", "chaos",
-                            "compaction", "trace"):
+                            "compaction", "trace", "programs"):
         from byzantinerandomizedconsensus_tpu.tools import (
             acceptance, bench_compaction, ledger, product, slack, soak)
+        from byzantinerandomizedconsensus_tpu.tools import (
+            programs as programs_tool)
         from byzantinerandomizedconsensus_tpu.tools import trace as trace_tool
 
         if argv[0] == "chaos":
             return soak.main(["--chaos", *argv[1:]])
         tool = {"accept": acceptance, "slack": slack,
                 "product": product, "ledger": ledger,
-                "compaction": bench_compaction, "trace": trace_tool}[argv[0]]
+                "compaction": bench_compaction, "trace": trace_tool,
+                "programs": programs_tool}[argv[0]]
         return tool.main(argv[1:])
     args = ap.parse_args(argv)
     if getattr(args, "backend", "").startswith("jax"):

@@ -2,11 +2,13 @@
 the round kernels (obs/counters.py), the unified versioned run-record schema
 every artifact-writing tool emits (obs/record.py), the host-side telemetry
 pipeline — structured trace spans/events from the orchestration seams with
-Chrome-trace export and live follow mode (obs/trace.py; round 12) — and the
-committed-artifact regression-chain ledger (tools/ledger.py). See
-docs/OBSERVABILITY.md."""
+Chrome-trace export and live follow mode (obs/trace.py; round 12) — the
+compiled-program census capturing XLA cost/memory analyses and stable HLO
+fingerprints at the compile seams (obs/programs.py; round 13) — and the
+committed-artifact regression-chain ledger with its ``--check`` regression
+sentinel (tools/ledger.py). See docs/OBSERVABILITY.md."""
 
-from byzantinerandomizedconsensus_tpu.obs import trace
+from byzantinerandomizedconsensus_tpu.obs import programs, trace
 from byzantinerandomizedconsensus_tpu.obs.counters import (
     COUNTER_SCHEMA_VERSION,
     CountersUnsupported,
@@ -27,5 +29,6 @@ __all__ = [
     "RECORD_VERSION",
     "env_fingerprint",
     "new_record",
+    "programs",
     "trace",
 ]

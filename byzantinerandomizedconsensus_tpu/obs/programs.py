@@ -1,0 +1,384 @@
+"""Compiled-program census — what XLA actually built (round 13).
+
+Everything the repo measured through round 12 is *around* the compiled
+programs: walls, spans, occupancy, cache traffic. The ≤ 8 fused programs that
+serve the whole chaos grid — and the per-config headline program — were still
+opaque: no committed record carried their FLOPs, bytes, peak device memory or
+an identity that survives a session. This module closes that gap at the one
+compile seam (backends/batch.py::CompileCache and the per-config
+``JitChunkedBackend._fn`` path): when the census is enabled, the first call
+of a cached program goes through jax's AOT ``lower()``/``compile()`` stages
+instead of the lazy-jit proxy, and the census captures
+
+- the backend's **cost analysis** (``Compiled.cost_analysis()``): flops,
+  bytes accessed, transcendentals — where the backend provides them;
+- the **memory analysis** (``Compiled.memory_analysis()``): argument /
+  output / temp / generated-code bytes, summed as ``resident_bytes`` (the
+  closest thing to peak the CPU backend exposes; TPU backends with an
+  explicit peak field get it recorded as ``peak_bytes``);
+- a **stable HLO fingerprint**: sha256 over the normalized compiled HLO text
+  (SSA value numbering and source metadata stripped — both vary run-to-run
+  while the program is the same) plus the op histogram the hash summarizes;
+- the **donation/shape signature** (``Lowered.args_info``) and the compile
+  wall.
+
+Entries are attached to the cache entry that owns them, recorded in the
+process-global census, emitted as ``program.compile`` trace events
+(obs/trace.py), and exported as the schema-v1.4 ``programs`` record block
+(obs/record.py::programs_block). Like the trace layer, the census is opt-in
+(``configure()`` or ``BRC_PROGRAMS=1``), **strictly inert when off** (one
+global check; the compile seams don't even import the analyses), and
+**bit-identical on**: the AOT-compiled executable is the same XLA program
+the lazy jit would have built, so results cannot differ
+(tests/test_programs.py pins it across the fault x adversary x delivery
+grid on the vmapped and compacted paths; artifacts/programs_r13.json
+commits the measured wall overhead).
+
+Consumers: ``brc-tpu programs`` (tools/programs.py — dump / diff /
+roofline / the census A/B) and the ``brc-tpu ledger --check`` regression
+sentinel, which compares committed fingerprints across artifacts and turns
+silent program drift into a nonzero exit.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import re
+import threading
+from collections import OrderedDict
+
+#: Environment knob: ``BRC_PROGRAMS=1`` (or any non-empty, non-"0" value)
+#: enables the census for a process via :func:`maybe_enable_from_env` —
+#: chaos workers check it like BRC_TRACE, so a census-enabled parent's
+#: exported environment reaches the whole fleet. (bench.py's own opt-in is
+#: the separate ``BENCH_PROGRAMS`` knob, which calls ``configure()``
+#: in-process.)
+PROGRAMS_ENV = "BRC_PROGRAMS"
+
+# ---------------------------------------------------------------------------
+# HLO fingerprinting
+
+#: SSA value numbering (``%name.123``) is a process-global counter: the same
+#: program lowered after a different compile history gets different suffixes.
+_SSA_SUFFIX = re.compile(r"%([A-Za-z_][\w-]*(?:\.[\w-]+)*?)\.\d+\b")
+#: The same numbering appears WITHOUT the ``%`` sigil in computation
+#: signatures (``ENTRY %main.4 (Arg_0.1: f32[8,8])``).
+_SIG_SUFFIX = re.compile(r"\b([A-Za-z_][\w-]*)\.\d+(?=:)")
+#: Source metadata (op_name/source_file/source_line) varies with call site
+#: and checkout path while the program is the same.
+_METADATA = re.compile(r",?\s*metadata=\{[^{}]*\}")
+#: Instruction opcode: the first lowercase identifier called after the
+#: ``<name> = <shape>`` head of an instruction line.
+_OPCODE = re.compile(r"=\s*(?:\([^()]*\)|[^\s(]+)\s+([a-z][\w-]*)\(")
+
+
+def normalize_hlo(text: str) -> str:
+    """The fingerprint's view of an HLO module: metadata and SSA numbering
+    stripped, whitespace canonical — what is left is the program structure
+    (ops, shapes, layouts, constants, control flow)."""
+    out = []
+    for line in text.splitlines():
+        line = _METADATA.sub("", line)
+        line = _SSA_SUFFIX.sub(r"%\1", line)
+        line = _SIG_SUFFIX.sub(r"\1", line)
+        line = line.strip()
+        if line:
+            out.append(line)
+    return "\n".join(out)
+
+
+def hlo_fingerprint(text: str) -> dict:
+    """``{"hash", "ops", "instructions"}`` of one HLO module text: a stable
+    sha256 prefix over the normalized text plus the op histogram it
+    summarizes (the human-auditable half of the identity)."""
+    norm = normalize_hlo(text)
+    ops: dict = {}
+    for line in norm.splitlines():
+        m = _OPCODE.search(line)
+        if m:
+            ops[m.group(1)] = ops.get(m.group(1), 0) + 1
+    return {
+        "hash": hashlib.sha256(norm.encode()).hexdigest()[:16],
+        "ops": dict(sorted(ops.items())),
+        "instructions": sum(ops.values()),
+    }
+
+
+# ---------------------------------------------------------------------------
+# analyses (each best-effort: a backend that provides nothing yields {})
+
+
+_COST_KEYS = (("flops", "flops"), ("transcendentals", "transcendentals"),
+              ("bytes accessed", "bytes_accessed"))
+
+
+def cost_summary(compiled) -> dict:
+    """The portable subset of ``Compiled.cost_analysis()``: flops /
+    transcendentals / total bytes accessed, as exact numbers. Backends
+    return either a dict or a one-per-device list of dicts; absent keys are
+    simply absent — the census records what the backend provides."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return {}
+    out = {}
+    for src, dst in _COST_KEYS:
+        v = ca.get(src)
+        if v is not None:
+            out[dst] = int(v) if float(v).is_integer() else float(v)
+    return out
+
+
+def memory_summary(compiled) -> dict:
+    """The portable subset of ``Compiled.memory_analysis()``: argument /
+    output / temp / generated-code bytes plus their sum (``resident_bytes``)
+    and, when the backend exposes one, the explicit ``peak_bytes``."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return {}
+    if ma is None:
+        return {}
+    out = {}
+    for attr, key in (("argument_size_in_bytes", "argument_bytes"),
+                      ("output_size_in_bytes", "output_bytes"),
+                      ("temp_size_in_bytes", "temp_bytes"),
+                      ("alias_size_in_bytes", "alias_bytes"),
+                      ("generated_code_size_in_bytes",
+                       "generated_code_bytes")):
+        v = getattr(ma, attr, None)
+        if v is not None:
+            out[key] = int(v)
+    if out:
+        out["resident_bytes"] = (out.get("argument_bytes", 0)
+                                 + out.get("output_bytes", 0)
+                                 + out.get("temp_bytes", 0))
+    for attr in ("peak_memory_in_bytes", "peak_size_in_bytes"):
+        v = getattr(ma, attr, None)
+        if v is not None:
+            out["peak_bytes"] = int(v)
+            break
+    return out
+
+
+def signature_summary(lowered) -> dict:
+    """Donation/shape signature from ``Lowered.args_info``: per-argument
+    ``dtype[shape]`` spellings (flattened pytree order) and which of them
+    are donated. The signature is what distinguishes two programs whose op
+    histograms agree but whose operand layouts don't."""
+    try:
+        import jax
+
+        infos = jax.tree_util.tree_leaves(lowered.args_info)
+        shapes = []
+        donated = []
+        for i, info in enumerate(infos):
+            dt = getattr(info, "dtype", None)
+            shape = getattr(info, "shape", None)
+            name = (getattr(dt, "name", None) or str(dt) or "?")
+            shapes.append(f"{name}[{','.join(str(d) for d in shape)}]"
+                          if shape is not None else name)
+            if getattr(info, "donated", False):
+                donated.append(i)
+        return {"num_args": len(infos), "shapes": shapes, "donated": donated}
+    except Exception:
+        return {}
+
+
+# ---------------------------------------------------------------------------
+# the census collector
+
+
+class ProgramCensus:
+    """Thread-safe collector of compiled-program entries, keyed by the
+    compile seam's human label (bucket label / per-config label). One
+    instance per process; the module-level fast path routes to it (or does
+    nothing) exactly like obs/trace.py."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.entries: OrderedDict = OrderedDict()
+        self.capture_errors = 0
+
+    def analyze(self, key: str, lowered, compiled,
+                compile_wall_s: float) -> dict:
+        """Build one census entry from an AOT (lowered, compiled) pair and
+        record it. Every analysis leg is best-effort: a backend that
+        provides nothing still yields a fingerprintable entry."""
+        entry: dict = {"key": key,
+                       "compile_wall_s": round(compile_wall_s, 6)}
+        try:
+            entry["fingerprint"] = hlo_fingerprint(compiled.as_text())
+        except Exception as e:
+            entry["fingerprint"] = None
+            entry["fingerprint_error"] = repr(e)
+        cost = cost_summary(compiled)
+        if cost:
+            entry["cost"] = cost
+        mem = memory_summary(compiled)
+        if mem:
+            entry["memory"] = mem
+        sig = signature_summary(lowered)
+        if sig:
+            entry["signature"] = sig
+        self.record(entry)
+        return entry
+
+    def record(self, entry: dict) -> None:
+        with self._lock:
+            self.entries[entry["key"]] = entry
+
+    def block(self) -> dict | None:
+        """The schema-v1.4 ``programs`` record block, or None when nothing
+        was captured (a record without the block stays a valid v1.x
+        record)."""
+        with self._lock:
+            programs = list(self.entries.values())
+        if not programs:
+            return None
+        totals: dict = {"compile_wall_s": round(
+            sum(e.get("compile_wall_s") or 0.0 for e in programs), 6)}
+        for field in ("flops", "bytes_accessed", "transcendentals"):
+            vals = [e["cost"][field] for e in programs
+                    if isinstance(e.get("cost"), dict)
+                    and field in e["cost"]]
+            if vals:
+                totals[field] = sum(vals)
+        return {"count": len(programs), "programs": programs,
+                "totals": totals}
+
+
+# ---------------------------------------------------------------------------
+# module-level fast path (mirrors obs/trace.py: one global, zero work off)
+
+
+_census: ProgramCensus | None = None
+
+
+def enabled() -> bool:
+    return _census is not None
+
+
+def current() -> ProgramCensus | None:
+    return _census
+
+
+def configure() -> ProgramCensus:
+    """Enable the census for this process (idempotent: an already-running
+    census keeps its entries — a tool enabling twice must not lose the
+    programs captured in between)."""
+    global _census
+    if _census is None:
+        _census = ProgramCensus()
+    return _census
+
+
+def disable() -> None:
+    global _census
+    _census = None
+
+
+def maybe_enable_from_env() -> ProgramCensus | None:
+    """Honor ``BRC_PROGRAMS`` (inherited from the parent environment by
+    chaos workers — tools/soak.py calls this in every child). No-op when
+    unset/``0``."""
+    val = os.environ.get(PROGRAMS_ENV, "")
+    if val and val != "0":
+        return configure()
+    return None
+
+
+def config_label(cfg) -> str:
+    """The census key for a per-config compiled program (the
+    ``JitChunkedBackend._fn`` seam) — same leading axes as a bucket label,
+    so the headline program and its bucket twin sort together in a dump.
+
+    Every axis the per-config jit closure bakes structurally must appear,
+    or two genuinely different programs would collide on one key and read
+    as fingerprint drift: f and crash_window are compile-time constants on
+    this path (unlike the batched lanes, where they are traced operands),
+    instances bounds the padded chunk shape, and the seed is baked by the
+    Pallas kernels (the xla cache key normalizes it to 0)."""
+    return (f"config/{cfg.protocol}/n{cfg.n}/f{cfg.f}/c{cfg.round_cap}/"
+            f"{cfg.delivery}/{cfg.adversary}/{cfg.coin}/{cfg.init}/"
+            f"f{cfg.faults}/w{cfg.crash_window}/i{cfg.instances}/"
+            f"s{cfg.seed}/p{cfg.pack_version}")
+
+
+def capture_call(key: str, fn, args, kwargs):
+    """The compile-seam hook: AOT-lower/compile ``fn`` for ``args``, run the
+    call on the compiled executable, and census the program.
+
+    Returns ``(out, compiled_or_None, entry_or_None)``. ``compiled`` is the
+    reusable executable the seam should cache in place of the lazy jit
+    wrapper (same XLA program — results are bit-identical by construction);
+    None means the capture failed and the call was served by ``fn`` itself,
+    with the failure counted, so the census can never break a run.
+    """
+    import time
+
+    from byzantinerandomizedconsensus_tpu.obs import trace as _trace
+
+    census = _census
+    try:
+        t0 = time.perf_counter()
+        lowered = fn.lower(*args, **kwargs)
+        compiled = lowered.compile()
+        wall = time.perf_counter() - t0
+        out = compiled(*args, **kwargs)
+    except Exception:
+        if census is not None:
+            census.capture_errors += 1
+        return fn(*args, **kwargs), None, None
+    entry = None
+    if census is not None:
+        entry = census.analyze(key, lowered, compiled, wall)
+        fp = entry.get("fingerprint") or {}
+        cost = entry.get("cost") or {}
+        _trace.event("program.compile", key=key,
+                     hash=fp.get("hash"),
+                     instructions=fp.get("instructions"),
+                     flops=cost.get("flops"),
+                     bytes_accessed=cost.get("bytes_accessed"),
+                     wall_s=round(wall, 6))
+    return out, compiled, entry
+
+
+def instrument(key: str, fn):
+    """Wrap a lazily-jitted ``fn`` so its FIRST call runs through
+    :func:`capture_call` (AOT compile + census) and later calls go straight
+    to the compiled executable. Returns ``fn`` unchanged when the census is
+    off or ``fn`` has no ``lower`` (a non-jit callable).
+
+    The AOT executable is specialized to the first call's shapes, but the
+    per-config cache this seam serves (backends/base.py ``_fn``) is keyed
+    by config alone and a later ``run`` of the same config with a smaller
+    ``inst_ids`` subset dispatches a smaller chunk — those calls fall back
+    to the original lazy jit (which recompiles transparently, exactly the
+    census-off behavior), so the census can never break a run."""
+    if _census is None or not hasattr(fn, "lower"):
+        return fn
+    target = fn
+
+    def wrapper(*args, **kwargs):
+        nonlocal target
+        if target is not fn:  # already captured: plain execution
+            try:
+                return target(*args, **kwargs)
+            except TypeError:
+                # Shape/dtype drift vs the captured call (the executable
+                # validates avals before running, so nothing executed):
+                # serve it with the lazy jit like a census-off run.
+                return fn(*args, **kwargs)
+        out, compiled, _entry = capture_call(key, fn, args, kwargs)
+        if compiled is not None:
+            target = compiled
+        return out
+
+    wrapper.census_key = key
+    return wrapper

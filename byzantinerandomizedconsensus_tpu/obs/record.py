@@ -46,8 +46,24 @@ backends/batch.py CompileCache). Same compatibility rule as v1.1/v1.2:
 ``record_version`` stays 1, the revision is declarative, and
 :func:`validate_record` checks the block shapes only when present.
 
+Schema v1.4 (round 13) adds the **programs** block (:func:`programs_block` —
+the compiled-program census, obs/programs.py): per-program XLA cost analysis
+(flops / bytes accessed / transcendentals), memory analysis (argument /
+output / temp bytes), a stable HLO fingerprint (hash + op histogram),
+donation/shape signature and compile wall, for every program the
+CompileCache (or the per-config jit path) built while the census was
+enabled. Carried by census-enabled runs (``BENCH_PROGRAMS=1`` bench runs,
+``brc-tpu programs census``). v1.4 also makes :func:`validate_record` reject
+an *unknown* ``record_revision`` (one this build does not know) by name —
+the schema-drift census (tests/test_obs_record.py) then fails on a
+from-the-future artifact instead of silently passing it. Same compatibility
+rule as v1.1–v1.3 otherwise: ``record_version`` stays 1, the revision is
+declarative, and block shapes are checked only when present.
+
 tools/ledger.py consumes both this format and the legacy r1–r7 shapes;
-:func:`validate_record` is the schema check the tier-1 tests pin.
+:func:`validate_record` is the schema check the tier-1 tests pin, and
+``brc-tpu ledger --check`` (the regression sentinel) compares the committed
+``programs`` fingerprints and wall chain across artifacts.
 """
 
 from __future__ import annotations
@@ -59,8 +75,9 @@ import numpy as np
 RECORD_VERSION = 1
 # Minor schema revisions: v1.1 (round 10) compile-cache / batch fields;
 # v1.2 (round 11) the compaction block; v1.3 (round 12) the trace block +
-# compile_wall_s in the compile-cache block.
-RECORD_REVISION = 3
+# compile_wall_s in the compile-cache block; v1.4 (round 13) the programs
+# block + the unknown-revision validate_record check.
+RECORD_REVISION = 4
 
 
 def env_fingerprint() -> dict:
@@ -231,6 +248,72 @@ def trace_block(path) -> dict | None:
         return None
 
 
+#: The fields a schema-v1.4 ``programs`` block must carry (the compiled-
+#: program census of obs/programs.py: entry count + the entry list; each
+#: entry needs at least its ``key`` and ``fingerprint``).
+PROGRAMS_BLOCK_KEYS = ("count", "programs")
+
+
+def parsed_payload(doc):
+    """The payload of a driver-captured artifact (``{"parsed": {...}}``
+    wrapper) or the document itself when it was written directly — the one
+    unwrap every artifact consumer (ledger, programs tool) shares."""
+    return doc.get("parsed", doc) if isinstance(doc, dict) else {}
+
+
+def find_blocks(doc, block_key: str, required_keys) -> list:
+    """Every ``block_key`` sub-dict of an artifact payload carrying all
+    ``required_keys``, wherever it sits (top level, per-leg, per-point):
+    (path, block) pairs. The ONE recursive walk the ledger's versioned-block
+    columns (v1.2 compaction, v1.3 trace, v1.4 programs) and the
+    ``brc-tpu programs`` consumers share — a wrapper or block-shape change
+    lands in every consumer at once."""
+    found = []
+
+    def walk(node, path):
+        if isinstance(node, dict):
+            blk = node.get(block_key)
+            if isinstance(blk, dict) and all(k in blk for k in required_keys):
+                found.append((path or ".", blk))
+            for k, v in node.items():
+                if k != block_key:
+                    walk(v, f"{path}.{k}" if path else k)
+        elif isinstance(node, list):
+            for i, v in enumerate(node):
+                walk(v, f"{path}[{i}]")
+
+    walk(parsed_payload(doc), "")
+    return found
+
+
+def programs_block(source=None) -> dict | None:
+    """The schema-v1.4 ``programs`` block: from the process-global census
+    (``source=None`` — the common case after a ``BRC_PROGRAMS`` run), a
+    :class:`~byzantinerandomizedconsensus_tpu.obs.programs.ProgramCensus`,
+    or a backend exposing ``program_census()`` (the jax backends' bucket
+    cache attachment). None when the census is off or empty — a record
+    without the block stays a valid v1.x record. Never raises."""
+    from byzantinerandomizedconsensus_tpu.obs import programs as _programs
+
+    try:
+        if source is None:
+            source = _programs.current()
+        if source is None:
+            return None
+        if hasattr(source, "block"):
+            return source.block()
+        entries = (source.program_census()
+                   if hasattr(source, "program_census") else source)
+        if not isinstance(entries, dict) or not entries:
+            return None
+        census = _programs.ProgramCensus()
+        for entry in entries.values():
+            census.record(entry)
+        return census.block()
+    except Exception:
+        return None
+
+
 def validate_record(doc: dict) -> list:
     """Schema check: returns a list of problems (empty = valid v1 record)."""
     problems = []
@@ -239,6 +322,14 @@ def validate_record(doc: dict) -> list:
     if doc.get("record_version") != RECORD_VERSION:
         problems.append(f"record_version {doc.get('record_version')!r} != "
                         f"{RECORD_VERSION}")
+    rev = doc.get("record_revision")
+    if rev is not None and (not isinstance(rev, int) or isinstance(rev, bool)
+                            or rev < 0 or rev > RECORD_REVISION):
+        # A revision from the future (or garbage) must fail BY NAME: the
+        # schema-drift census pins this message, so a build that meets an
+        # artifact it cannot understand says so instead of part-validating.
+        problems.append(f"unknown record_revision {rev!r} (this build knows "
+                        f"revisions 0..{RECORD_REVISION})")
     if not isinstance(doc.get("kind"), str) or not doc.get("kind"):
         problems.append("missing/empty 'kind'")
     env = doc.get("env")
@@ -285,4 +376,19 @@ def validate_record(doc: dict) -> list:
                     if not isinstance(entry, dict) or "count" not in entry:
                         problems.append(
                             f"trace digest entry {kind!r} missing 'count'")
+    pg = doc.get("programs")
+    if pg is not None:
+        if not isinstance(pg, dict):
+            problems.append("programs block is not a dict")
+        else:
+            for key in PROGRAMS_BLOCK_KEYS:
+                if key not in pg:
+                    problems.append(f"programs block missing {key!r}")
+            entries = pg.get("programs")
+            if isinstance(entries, list):
+                for i, entry in enumerate(entries):
+                    if not isinstance(entry, dict) or "key" not in entry \
+                            or "fingerprint" not in entry:
+                        problems.append(f"programs entry {i} missing "
+                                        "'key'/'fingerprint'")
     return problems

@@ -20,10 +20,28 @@ discipline prescribes (utils/timing.regression_verdict; docs/PERF.md):
   tier-1 assertion — tests/test_ledger.py — so artifact-format drift fails
   loudly instead of silently un-auditing a round).
 
+Round 13 adds the **regression sentinel** (``brc-tpu ledger --check``): the
+mechanical form of the r5 device-chain rule, runnable in CI and on the first
+TPU session. It recomputes the wall chain and compares the committed
+compiled-program fingerprints (schema v1.4 ``programs`` blocks,
+obs/programs.py) across artifacts, and exits nonzero when
+
+- a chain link's authoritative ratio (``vs_prev_round_device`` when both
+  ends have device legs, else ``vs_prev_round``) drops below
+  ``1 - timing.REGRESSION_THRESHOLD`` — cross-platform wall links are
+  *skipped with a named reason* instead of judged (a CPU wall is not
+  comparable to a TPU wall: exactly the r5 rule, mechanized);
+- a recomputed ratio disagrees with what the artifact recorded at capture
+  time (the chain changed under us);
+- the same program key carries different HLO fingerprint hashes on the same
+  platform across committed artifacts (silent program drift).
+
 CLI: ``brc-tpu ledger`` (or ``python -m
-byzantinerandomizedconsensus_tpu.tools.ledger``); ``--json FILE`` also writes
-the machine-readable record (kind="ledger"). Exit code 0 iff zero parse
-errors.
+byzantinerandomizedconsensus_tpu.tools.ledger``); ``--json`` prints the
+machine-readable record (kind="ledger", sentinel verdict included) to stdout
+instead of the human table, ``--json FILE`` writes it next to the table.
+Exit code 0 iff zero parse errors — and, with ``--check``, iff the sentinel
+verdict is clean too.
 """
 
 from __future__ import annotations
@@ -46,8 +64,11 @@ def _round_of(name: str):
 
 def _parsed(doc):
     """The payload of a driver-captured artifact ({"parsed": {...}} wrapper)
-    or the document itself when it was written directly."""
-    return doc.get("parsed", doc) if isinstance(doc, dict) else {}
+    or the document itself when it was written directly (the shared
+    obs/record.py unwrap)."""
+    from byzantinerandomizedconsensus_tpu.obs import record as _record
+
+    return _record.parsed_payload(doc)
 
 
 def _bench_entry(name: str, doc) -> dict:
@@ -137,25 +158,12 @@ def _compile_cache_of(doc):
 
 def _blocks_of(doc, block_key: str, required_keys) -> list:
     """Every ``block_key`` sub-dict of an artifact carrying all
-    ``required_keys``, wherever it sits (top level, per-leg, per-point):
-    (path, block) pairs — the one recursive walk the v1.2 compaction and
-    v1.3 trace columns (and any future versioned block) share."""
-    found = []
+    ``required_keys`` — the shared obs/record.py walk (v1.2 compaction,
+    v1.3 trace, v1.4 programs columns, and the programs tool's consumers
+    all read blocks through it)."""
+    from byzantinerandomizedconsensus_tpu.obs import record as _record
 
-    def walk(node, path):
-        if isinstance(node, dict):
-            blk = node.get(block_key)
-            if isinstance(blk, dict) and all(k in blk for k in required_keys):
-                found.append((path or ".", blk))
-            for k, v in node.items():
-                if k != block_key:
-                    walk(v, f"{path}.{k}" if path else k)
-        elif isinstance(node, list):
-            for i, v in enumerate(node):
-                walk(v, f"{path}[{i}]")
-
-    walk(_parsed(doc), "")
-    return found
+    return _record.find_blocks(doc, block_key, required_keys)
 
 
 def _compaction_rows_of(name: str, doc) -> list:
@@ -196,6 +204,111 @@ def _trace_rows_of(name: str, doc) -> list:
             "total_s": round(total, 4),
         })
     return rows
+
+
+def _programs_rows_of(name: str, doc) -> list:
+    """Schema-v1.4 ``programs`` blocks of one artifact: one row per
+    captured program (artifact, path, key, fingerprint hash, flops, bytes,
+    compile wall) — the ledger's census columns AND the sentinel's
+    fingerprint-drift evidence."""
+    from byzantinerandomizedconsensus_tpu.obs import record as _record
+
+    env = _parsed(doc).get("env") if isinstance(_parsed(doc), dict) else None
+    platform = env.get("platform") if isinstance(env, dict) else None
+    rows = []
+    for path, blk in _blocks_of(doc, "programs", _record.PROGRAMS_BLOCK_KEYS):
+        for entry in blk.get("programs") or []:
+            if not isinstance(entry, dict):
+                continue
+            fp = entry.get("fingerprint")
+            cost = entry.get("cost") if isinstance(entry.get("cost"),
+                                                   dict) else {}
+            rows.append({
+                "artifact": name,
+                "path": path,
+                "key": entry.get("key"),
+                "hash": fp.get("hash") if isinstance(fp, dict) else None,
+                "instructions": (fp.get("instructions")
+                                 if isinstance(fp, dict) else None),
+                "flops": cost.get("flops"),
+                "bytes_accessed": cost.get("bytes_accessed"),
+                "compile_wall_s": entry.get("compile_wall_s"),
+                "platform": platform,
+            })
+    return rows
+
+
+def sentinel_verdict(bench: dict, wall_chain: list,
+                     programs_rows: list) -> dict:
+    """The ``--check`` verdict: wall-chain regressions past
+    ``timing.REGRESSION_THRESHOLD`` (device-ratio preferred, cross-platform
+    wall links skipped by the r5 rule), recomputed-vs-recorded drift, and
+    per-platform program-fingerprint drift. Pure function of the ledger's
+    own reconstruction so tests can feed it fabricated chains."""
+    failures = []
+    checked = []
+    skipped = []
+    for link in wall_chain:
+        name = f"r{link['from_round']}->r{link['to_round']}"
+        a = bench.get(link["from_round"], {})
+        b = bench.get(link["to_round"], {})
+        if link.get("recorded_vs_prev_round") is not None \
+                and link.get("agrees_with_recorded") is False:
+            failures.append(
+                f"{name}: recomputed vs_prev_round {link.get('vs_prev_round')}"
+                f" disagrees with recorded {link['recorded_vs_prev_round']} — "
+                "the committed chain changed under us")
+        if "vs_prev_round_device" in link:
+            ratio, signal = link["vs_prev_round_device"], \
+                "vs_prev_round_device"
+        elif link.get("regression_signal") == "vs_prev_round":
+            pa, pb = a.get("platform"), b.get("platform")
+            if pa and pb and pa != pb:
+                skipped.append(
+                    f"{name}: wall ratio not comparable across platforms "
+                    f"({pa} -> {pb}) — r5 device-chain rule; re-run on the "
+                    "device of record")
+                continue
+            ratio, signal = link.get("vs_prev_round"), "vs_prev_round"
+        else:
+            skipped.append(f"{name}: no authoritative signal "
+                           f"({link.get('regression_signal', link.get('error', '?'))})")
+            continue
+        checked.append({"link": name, "signal": signal, "ratio": ratio})
+        if ratio is not None and ratio < 1.0 - timing.REGRESSION_THRESHOLD:
+            failures.append(
+                f"{name}: {signal} {ratio} below "
+                f"{round(1.0 - timing.REGRESSION_THRESHOLD, 2)} — wall "
+                "regression past timing.REGRESSION_THRESHOLD")
+
+    # Fingerprint drift: the same program key must hash identically on the
+    # same platform, wherever it was committed. Cross-platform differences
+    # are expected (different backends build different programs) and are
+    # exactly what the first TPU census will legitimately add.
+    by_key: dict = {}
+    for row in programs_rows:
+        if row.get("key") is None or row.get("hash") is None:
+            continue
+        by_key.setdefault((row["key"], row.get("platform")), {}).setdefault(
+            row["hash"], []).append(f"{row['artifact']}[{row['path']}]")
+    compared = 0
+    for (key, platform), hashes in sorted(by_key.items()):
+        if sum(len(v) for v in hashes.values()) > 1:
+            compared += 1
+        if len(hashes) > 1:
+            detail = "; ".join(f"{h} in {', '.join(sorted(refs))}"
+                               for h, refs in sorted(hashes.items()))
+            failures.append(
+                f"fingerprint drift for {key!r} on platform "
+                f"{platform or '?'}: {detail}")
+    return {
+        "threshold": timing.REGRESSION_THRESHOLD,
+        "links_checked": checked,
+        "links_skipped": skipped,
+        "fingerprints": {"programs": len(by_key), "compared": compared},
+        "failures": failures,
+        "ok": not failures,
+    }
 
 
 def build_ledger(root=None) -> dict:
@@ -329,6 +442,13 @@ def build_ledger(root=None) -> dict:
     for name, doc in sorted(docs.items()):
         trace_rows.extend(_trace_rows_of(name, doc))
 
+    # ---- compiled-program census columns (schema v1.4, round 13): every
+    # committed artifact carrying a programs block, one row per program —
+    # plus the sentinel verdict computed over chain + fingerprints.
+    programs_rows = []
+    for name, doc in sorted(docs.items()):
+        programs_rows.extend(_programs_rows_of(name, doc))
+
     from byzantinerandomizedconsensus_tpu.obs import record
 
     return {
@@ -341,9 +461,11 @@ def build_ledger(root=None) -> dict:
         "compile_cache_rows": compile_cache_rows,
         "compaction_rows": compaction_rows,
         "trace_rows": trace_rows,
+        "programs_rows": programs_rows,
         "bench_rounds": {str(r): bench[r] for r in rounds_seen},
         "wall_chain": chain,
         "device_chain": device_chain,
+        "sentinel": sentinel_verdict(bench, chain, programs_rows),
         "multichip_rounds": {str(r): multichip[r] for r in sorted(multichip)},
         "artifact_round_evidence": {
             str(r): evidence[r] for r in sorted(evidence)},
@@ -421,6 +543,28 @@ def format_report(doc: dict) -> str:
                 f"  {row['artifact']}[{row['path']}]: {row['file']}, "
                 f"{row['events']} events, {row['span_kinds']} span kinds, "
                 f"{row['total_s']} s total")
+    # Present only once an artifact carries the v1.4 programs block.
+    if doc.get("programs_rows"):
+        lines.append("compiled-program census columns (schema v1.4 — "
+                     "artifact: key hash flops/bytes):")
+        for row in doc["programs_rows"]:
+            lines.append(
+                f"  {row['artifact']}: {row['key']} "
+                f"[{row['hash']}] flops {row['flops']}, "
+                f"bytes {row['bytes_accessed']}")
+    sent = doc.get("sentinel")
+    if sent is not None:
+        lines.append(
+            f"sentinel: {'OK' if sent['ok'] else 'FAIL'} — "
+            f"{len(sent['links_checked'])} chain links checked, "
+            f"{len(sent['links_skipped'])} skipped (r5 rule / no signal), "
+            f"{sent['fingerprints']['programs']} program fingerprints, "
+            f"{len(sent['failures'])} failures "
+            f"(threshold {sent['threshold']})")
+        for s in sent["links_skipped"]:
+            lines.append(f"  skipped: {s}")
+        for f in sent["failures"]:
+            lines.append(f"  SENTINEL FAIL: {f}")
     return "\n".join(lines)
 
 
@@ -428,18 +572,34 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--root", default=None,
                     help="repo root to scan (default: this checkout)")
-    ap.add_argument("--json", default=None, metavar="FILE",
-                    help="also write the machine-readable ledger record")
+    ap.add_argument("--json", nargs="?", const="-", default=None,
+                    metavar="FILE",
+                    help="machine-readable output: bare --json prints the "
+                         "ledger record (sentinel verdict included) to "
+                         "stdout INSTEAD of the human table; --json FILE "
+                         "writes it next to the table")
+    ap.add_argument("--check", action="store_true",
+                    help="regression sentinel: exit nonzero on wall-chain "
+                         "regression past timing.REGRESSION_THRESHOLD, "
+                         "recorded-vs-recomputed drift, or program-"
+                         "fingerprint drift (the mechanical r5 rule)")
     args = ap.parse_args(argv)
 
     doc = build_ledger(args.root)
-    print(format_report(doc))
-    if args.json:
-        out = pathlib.Path(args.json)
-        out.parent.mkdir(parents=True, exist_ok=True)
-        out.write_text(json.dumps(doc, indent=1) + "\n")
-        print(f"wrote {out}")
-    return 1 if doc["parse_errors"] else 0
+    if args.json == "-":
+        print(json.dumps(doc, indent=1))
+    else:
+        print(format_report(doc))
+        if args.json:
+            out = pathlib.Path(args.json)
+            out.parent.mkdir(parents=True, exist_ok=True)
+            out.write_text(json.dumps(doc, indent=1) + "\n")
+            print(f"wrote {out}")
+    if doc["parse_errors"]:
+        return 1
+    if args.check and not doc["sentinel"]["ok"]:
+        return 2
+    return 0
 
 
 if __name__ == "__main__":

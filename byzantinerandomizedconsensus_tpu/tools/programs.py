@@ -1,0 +1,422 @@
+"""``brc-tpu programs`` — consumers of the compiled-program census
+(obs/programs.py; round 13).
+
+Four verbs:
+
+- ``dump SRC`` — render the schema-v1.4 ``programs`` block(s) of an artifact
+  (or of a census JSON written by ``census``) as a table: program key, HLO
+  fingerprint hash, instruction count, flops, bytes accessed, resident
+  bytes, compile wall. ``--json`` re-emits the rows machine-readably.
+- ``diff A B`` — compare two artifacts' censuses by program key: programs
+  added/removed, fingerprint hash drift, flops/bytes deltas. Exit nonzero on
+  hash drift — the interactive twin of ``brc-tpu ledger --check``.
+- ``roofline --census ART [--trace JSONL]`` — the predicted-vs-measured
+  join: per-dispatch wall from the round-12 trace spans (``batch.dispatch``
+  / ``compaction.segment``/``.drain`` / ``backend.run``, matched by their
+  ``program`` attr) against the census's per-program flops/bytes — yielding
+  dispatches, wall, arithmetic intensity (flops/byte) and achieved
+  GFLOP/s / GB/s per program. The default trace file is the one the
+  artifact's own ``trace`` block names, resolved next to the artifact.
+- ``census`` — the round-13 A/B + artifact producer: the seeded chaos grid
+  (tools/bench_batch.chaos_grid) through the fused lanes census-on vs
+  census-off, best-of-N walls each, results bit-compared, plus an untimed
+  compacted + per-config sample so the committed census covers all three
+  compile seams; emits a schema-v1.4 run record (kind="programs_census",
+  programs + trace + compile-cache blocks) — committed as
+  ``artifacts/programs_r13.json`` (+ ``programs_r13.jsonl``, the trace the
+  roofline verb joins against). Exit 0 iff bit-identical, overhead within
+  bounds, and the census is non-empty.
+
+    python -m byzantinerandomizedconsensus_tpu.tools.programs census \
+        --configs 280 --out artifacts/programs_r13.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+from byzantinerandomizedconsensus_tpu.obs import programs as _programs
+from byzantinerandomizedconsensus_tpu.obs import trace as _trace
+
+#: The acceptance bound on steady-state census overhead over the seeded
+#: chaos grid (ISSUE 8, same bound as the round-12 trace layer): census-on
+#: wall / census-off wall - 1 must stay within this. Capture cost itself is
+#: compile-time-only and reported separately (``capture_wall_s``).
+OVERHEAD_BOUND = 0.02
+
+#: Span kinds whose ``program`` attr names a census key (the roofline join).
+_DISPATCH_KINDS = ("batch.dispatch", "backend.run", "compaction.init",
+                   "compaction.segment", "compaction.drain",
+                   "compaction.refill")
+
+
+def _programs_of(path) -> dict:
+    """{program key: entry} over every programs block of one artifact —
+    read through the shared ``obs/record.find_blocks`` walk (the same one
+    the ledger's versioned-block columns use)."""
+    from byzantinerandomizedconsensus_tpu.obs import record
+
+    doc = json.loads(pathlib.Path(path).read_text())
+    out: dict = {}
+    for _path, blk in record.find_blocks(doc, "programs",
+                                         record.PROGRAMS_BLOCK_KEYS):
+        for entry in blk.get("programs") or []:
+            if isinstance(entry, dict) and entry.get("key"):
+                out[entry["key"]] = entry
+    return out
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "?"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{n} B"
+        n /= 1024
+    return f"{n}"
+
+
+def _entry_row(entry: dict) -> str:
+    fp = entry.get("fingerprint") or {}
+    cost = entry.get("cost") or {}
+    mem = entry.get("memory") or {}
+    return (f"  {entry.get('key')}\n"
+            f"    hash {fp.get('hash', '?')}  "
+            f"{fp.get('instructions', '?')} instructions, "
+            f"flops {cost.get('flops', '?')}, "
+            f"bytes {_fmt_bytes(cost.get('bytes_accessed'))}, "
+            f"transcendentals {cost.get('transcendentals', '?')}, "
+            f"resident {_fmt_bytes(mem.get('resident_bytes'))}, "
+            f"compile {entry.get('compile_wall_s', '?')} s")
+
+
+def cmd_dump(args) -> int:
+    try:
+        entries = _programs_of(args.src)
+    except (OSError, ValueError) as e:
+        print(f"cannot read {args.src!r}: {e}", file=sys.stderr)
+        return 2
+    if not entries:
+        print(f"{args.src}: no programs block (census-off run, or a "
+              "pre-v1.4 artifact)", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps({"programs": list(entries.values())}, indent=1))
+        return 0
+    print(f"compiled-program census — {len(entries)} program(s) "
+          f"({args.src})")
+    for entry in entries.values():
+        print(_entry_row(entry))
+        if args.ops:
+            ops = (entry.get("fingerprint") or {}).get("ops") or {}
+            top = sorted(ops.items(), key=lambda kv: -kv[1])[:args.ops]
+            print("    ops: " + ", ".join(f"{k}x{v}" for k, v in top))
+    return 0
+
+
+def cmd_diff(args) -> int:
+    try:
+        a, b = _programs_of(args.a), _programs_of(args.b)
+    except (OSError, ValueError) as e:
+        print(f"cannot read census: {e}", file=sys.stderr)
+        return 2
+    added = sorted(set(b) - set(a))
+    removed = sorted(set(a) - set(b))
+    drifted = []
+    for key in sorted(set(a) & set(b)):
+        fa = (a[key].get("fingerprint") or {}).get("hash")
+        fb = (b[key].get("fingerprint") or {}).get("hash")
+        if fa != fb:
+            drifted.append((key, fa, fb))
+    print(f"census diff {args.a} -> {args.b}: "
+          f"{len(added)} added, {len(removed)} removed, "
+          f"{len(drifted)} fingerprint drift(s)")
+    for key in added:
+        print(f"  + {key}")
+    for key in removed:
+        print(f"  - {key}")
+    for key, fa, fb in drifted:
+        ca = (a[key].get("cost") or {})
+        cb = (b[key].get("cost") or {})
+        print(f"  ~ {key}: hash {fa} -> {fb}, "
+              f"flops {ca.get('flops', '?')} -> {cb.get('flops', '?')}, "
+              f"bytes {ca.get('bytes_accessed', '?')} -> "
+              f"{cb.get('bytes_accessed', '?')}")
+    return 1 if drifted else 0
+
+
+# ---------------------------------------------------------------------------
+# roofline — join per-dispatch wall (trace spans) with per-program cost
+
+
+def roofline_rows(entries: dict, events) -> list:
+    """One row per census program that the trace dispatched: dispatches,
+    wall, flops/bytes per dispatch, arithmetic intensity, achieved rates.
+    ``batch.dispatch`` spans cover ``dispatches`` program executions each
+    (the async chunk loop); every other dispatch kind is one execution."""
+    walls: dict = {}
+    counts: dict = {}
+    for ev in events:
+        if ev.get("ph") != "X" or ev.get("kind") not in _DISPATCH_KINDS:
+            continue
+        key = (ev.get("attrs") or {}).get("program")
+        if not key:
+            continue
+        walls[key] = walls.get(key, 0.0) + float(ev.get("dur", 0.0))
+        # dispatches=0 is a real count (an empty run), not absence: only a
+        # missing attr defaults to one execution per span.
+        n = (ev.get("attrs") or {}).get("dispatches")
+        counts[key] = counts.get(key, 0) + (1 if n is None else int(n))
+    rows = []
+    for key, wall in sorted(walls.items(), key=lambda kv: -kv[1]):
+        entry = entries.get(key)
+        cost = (entry or {}).get("cost") or {}
+        flops, byts = cost.get("flops"), cost.get("bytes_accessed")
+        n = counts.get(key, 0)
+        row = {"key": key, "dispatches": n, "wall_s": round(wall, 4),
+               "in_census": entry is not None,
+               "flops_per_dispatch": flops, "bytes_per_dispatch": byts}
+        if flops is not None and byts:
+            row["intensity_flops_per_byte"] = round(flops / byts, 4)
+        if wall > 0 and flops is not None:
+            row["gflops_per_s"] = round(flops * n / wall / 1e9, 4)
+        if wall > 0 and byts is not None:
+            row["gbytes_per_s"] = round(byts * n / wall / 1e9, 4)
+        rows.append(row)
+    return rows
+
+
+def cmd_roofline(args) -> int:
+    try:
+        entries = _programs_of(args.census)
+        doc = json.loads(pathlib.Path(args.census).read_text())
+    except (OSError, ValueError) as e:
+        print(f"cannot read census {args.census!r}: {e}", file=sys.stderr)
+        return 2
+    trace_path = args.trace
+    if trace_path is None:
+        # The artifact's own trace block names the file, committed by
+        # convention next to the record (same binding the ledger uses).
+        from byzantinerandomizedconsensus_tpu.obs import record
+
+        tr = record.parsed_payload(doc).get("trace") or {}
+        if tr.get("file"):
+            trace_path = pathlib.Path(args.census).parent / tr["file"]
+    if trace_path is None:
+        print("no --trace given and the census artifact binds no trace "
+              "block", file=sys.stderr)
+        return 2
+    try:
+        events = _trace.read_events(trace_path)
+    except (OSError, ValueError) as e:
+        print(f"cannot read trace {trace_path!r}: {e}", file=sys.stderr)
+        return 2
+    rows = roofline_rows(entries, events)
+    if args.json:
+        print(json.dumps({"rows": rows}, indent=1))
+        return 0
+    print(f"roofline join — {len(rows)} dispatched program(s), "
+          f"{len(entries)} in census ({args.census} x {trace_path})")
+    for row in rows:
+        print(f"  {row['key']}\n"
+              f"    {row['dispatches']} dispatch(es), {row['wall_s']} s wall"
+              + (f", {row['intensity_flops_per_byte']} flops/byte"
+                 if "intensity_flops_per_byte" in row else "")
+              + (f", {row['gflops_per_s']} GFLOP/s"
+                 if "gflops_per_s" in row else "")
+              + (f", {row['gbytes_per_s']} GB/s"
+                 if "gbytes_per_s" in row else "")
+              + ("" if row["in_census"] else "  [NOT IN CENSUS]"))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# census — the round-13 A/B + artifact producer
+
+
+def cmd_census(args) -> int:
+    import numpy as np
+
+    from byzantinerandomizedconsensus_tpu.backends.compaction import (
+        CompactionPolicy)
+    from byzantinerandomizedconsensus_tpu.backends.jax_backend import (
+        JaxBackend)
+    from byzantinerandomizedconsensus_tpu.obs import record
+    from byzantinerandomizedconsensus_tpu.tools import bench_batch
+    from byzantinerandomizedconsensus_tpu.utils.devices import (
+        ensure_live_backend)
+
+    if args.repeats < 1:
+        print("census needs --repeats >= 1 (the A/B has no walls without "
+              "timed runs)", file=sys.stderr)
+        return 2
+    ensure_live_backend()
+    cfgs = bench_batch.chaos_grid(args.configs, args.seed)
+    out = pathlib.Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    trace_path = out.with_suffix(".jsonl")
+    trace_path.unlink(missing_ok=True)
+
+    # Two FRESH backend instances so each leg owns its compile cache: the
+    # A/B measures steady state (capture cost is compile-time-only and the
+    # timing discipline keeps compiles out of timed windows anyway).
+    be_off = JaxBackend()
+    be_on = JaxBackend()
+
+    print(f"warm-up (census off): fused grid of {len(cfgs)} configs...",
+          flush=True)
+    baseline, _ = be_off.run_fused(cfgs)
+
+    _programs.configure()
+    _trace.configure(path=trace_path)
+    print("warm-up (census ON, traced): capturing program anatomy...",
+          flush=True)
+    t0 = time.perf_counter()
+    res_on_first, _rep = be_on.run_fused(cfgs)
+    capture_wall = time.perf_counter() - t0
+    # The untimed compacted + per-config samples: the committed census must
+    # cover the compaction programs (init/refill/segment/drain) and the
+    # per-config seam too, not just the fused dispatch programs.
+    sample = cfgs[:args.compacted_sample]
+    res_comp, _rep2 = be_on.run_fused(sample, compaction=CompactionPolicy(
+        width=64, segment=1))
+    res_percfg = [be_on.run(c) for c in cfgs[:args.per_config_sample]]
+    _trace.disable()
+
+    identical = all(
+        np.array_equal(a.rounds, b.rounds)
+        and np.array_equal(a.decision, b.decision)
+        for a, b in zip(baseline, res_on_first))
+    identical = identical and all(
+        np.array_equal(a.rounds, b.rounds)
+        and np.array_equal(a.decision, b.decision)
+        for a, b in zip(baseline[:len(sample)], res_comp))
+    identical = identical and all(
+        np.array_equal(a.rounds, b.rounds)
+        and np.array_equal(a.decision, b.decision)
+        for a, b in zip(baseline[:len(res_percfg)], res_percfg))
+
+    def timed(be):
+        t0 = time.perf_counter()
+        results, _ = be.run_fused(cfgs)
+        return time.perf_counter() - t0, results
+
+    walls_off, walls_on = [], []
+    for rep in range(args.repeats):
+        w_off, _res = timed(be_off)
+        w_on, res_on = timed(be_on)  # census still enabled: the on path
+        walls_off.append(round(w_off, 3))
+        walls_on.append(round(w_on, 3))
+        identical = identical and all(
+            np.array_equal(a.rounds, b.rounds)
+            and np.array_equal(a.decision, b.decision)
+            for a, b in zip(baseline, res_on))
+        print(f"repeat {rep}: census-off {w_off:.2f} s, "
+              f"census-on {w_on:.2f} s, bit_identical={identical}",
+              flush=True)
+
+    overhead = (min(walls_on) / min(walls_off) - 1.0) if min(walls_off) \
+        else None
+    programs_block = record.programs_block()
+    census = _programs.current()
+    doc = {
+        **record.new_record("programs_census"),
+        "description": "compiled-program census A/B on the seeded chaos "
+                       "grid: fused lanes census-on vs census-off, "
+                       "best-of-N walls, results bit-compared; census "
+                       "covers the fused, compacted and per-config compile "
+                       "seams (tools/programs.py; round 13)",
+        "generator_version": bench_batch.soak.GENERATOR_VERSION,
+        "seed": args.seed,
+        "configs": args.configs,
+        "repeats": args.repeats,
+        "legs": {
+            "census_off": {"walls_s": walls_off, "wall_s": min(walls_off)},
+            "census_on": {"walls_s": walls_on, "wall_s": min(walls_on)},
+        },
+        "overhead_fraction": (round(overhead, 4)
+                              if overhead is not None else None),
+        "overhead_bound": OVERHEAD_BOUND,
+        "bit_identical": bool(identical),
+        "capture_wall_s": round(capture_wall, 2),
+        "capture_errors": census.capture_errors if census else None,
+        "compacted_sample_configs": len(sample),
+        "per_config_sample_configs": len(res_percfg),
+        "programs": programs_block,
+        "compile_cache": record.compile_cache_block(be_on),
+        "device_chain_note": (
+            "wall-only A/B; CPU XLA walls are a valid capture for the "
+            "census-on-vs-off ratio (host-side instrumentation only) and "
+            "CPU cost/memory analyses are a valid program anatomy for THIS "
+            "platform's programs — the r5 device chain rule still applies "
+            "to any kernel-time claim, and the TPU census is a fresh "
+            "fingerprint family, not a drift (docs/PERF.md)"),
+        "trace": record.trace_block(trace_path),
+    }
+    _programs.disable()
+    out.write_text(json.dumps(doc, indent=1) + "\n")
+    summary = {"out": str(out),
+               "programs": (programs_block or {}).get("count"),
+               "overhead_fraction": doc["overhead_fraction"],
+               "bit_identical": doc["bit_identical"]}
+    print(json.dumps(summary))
+    ok = (identical and overhead is not None
+          and overhead <= OVERHEAD_BOUND and programs_block is not None)
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p_du = sub.add_parser("dump", help="render an artifact's schema-v1.4 "
+                                       "programs block as a table")
+    p_du.add_argument("src", help="artifact JSON carrying a programs block")
+    p_du.add_argument("--json", action="store_true")
+    p_du.add_argument("--ops", type=int, default=0, metavar="N",
+                      help="also print each program's top-N HLO op counts")
+    p_du.set_defaults(fn=cmd_dump)
+
+    p_di = sub.add_parser("diff", help="compare two censuses by program "
+                                       "key; exit nonzero on hash drift")
+    p_di.add_argument("a")
+    p_di.add_argument("b")
+    p_di.set_defaults(fn=cmd_diff)
+
+    p_ro = sub.add_parser("roofline",
+                          help="join per-dispatch wall (trace spans) with "
+                               "per-program flops/bytes")
+    p_ro.add_argument("--census", required=True,
+                      help="artifact JSON carrying the programs block")
+    p_ro.add_argument("--trace", default=None,
+                      help="trace JSONL with program-attributed dispatch "
+                           "spans (default: the file the artifact's trace "
+                           "block names, next to the artifact)")
+    p_ro.add_argument("--json", action="store_true")
+    p_ro.set_defaults(fn=cmd_roofline)
+
+    p_ce = sub.add_parser("census",
+                          help="census-on-vs-off A/B on the seeded chaos "
+                               "grid (the round-13 artifact)")
+    p_ce.add_argument("--configs", type=int, default=280)
+    p_ce.add_argument("--seed", type=int, default=0)
+    p_ce.add_argument("--repeats", type=int, default=3)
+    p_ce.add_argument("--compacted-sample", type=int, default=40,
+                      help="configs for the untimed compacted census leg")
+    p_ce.add_argument("--per-config-sample", type=int, default=2,
+                      help="configs for the untimed per-config-seam leg")
+    from byzantinerandomizedconsensus_tpu.utils.rounds import default_artifact
+
+    p_ce.add_argument("--out", default=default_artifact("programs"))
+    p_ce.set_defaults(fn=cmd_census)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -491,6 +491,13 @@ def run_child(cfg_dict: dict, oracle_n: int, inject=None) -> dict:
     # this child appends to its own trace-w<pid>.jsonl; the coordinator
     # merges every worker file after the run (obs/trace.py).
     _trace.maybe_enable_from_env()
+    # Compiled-program census (BRC_PROGRAMS; obs/programs.py): with both
+    # envs set, this child's program.compile events — fingerprint, flops,
+    # bytes per compiled program — land in its worker trace file and ride
+    # the coordinator's merge.
+    from byzantinerandomizedconsensus_tpu.obs import programs as _programs
+
+    _programs.maybe_enable_from_env()
     cfg = SimConfig(**cfg_dict).validate()
     from byzantinerandomizedconsensus_tpu.models import invariants
     from byzantinerandomizedconsensus_tpu.utils.devices import (

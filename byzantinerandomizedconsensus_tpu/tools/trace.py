@@ -87,7 +87,16 @@ def cmd_summary(args) -> int:
     problems = _trace.validate_events(events)
     lines = [f"trace summary — {len(events)} events, "
              f"{len(dg)} kinds, {len(problems)} problems"]
-    for kind, entry in dg.items():
+    items = list(dg.items())
+    if args.top is not None:
+        # Ranked mode: the kinds that cost the most wall first (total span
+        # seconds, count-only instants last), truncated to N — the "where
+        # did the run go" view; percentiles stay the one
+        # utils/metrics.percentiles law inside the digest.
+        items.sort(key=lambda kv: (-kv[1]["total_s"], kv[0]))
+        dropped = max(0, len(items) - args.top)
+        items = items[:args.top]
+    for kind, entry in items:
         if "p50_s" in entry:
             lines.append(
                 f"  {kind}: {entry['count']} spans, "
@@ -95,6 +104,9 @@ def cmd_summary(args) -> int:
                 f"p90 {entry['p90_s']} s, p99 {entry['p99_s']} s")
         else:
             lines.append(f"  {kind}: {entry['count']} events")
+    if args.top is not None and dropped:
+        lines.append(f"  ... {dropped} more kind(s) below the top "
+                     f"{args.top} (by total wall)")
     for p in problems:
         lines.append(f"  PROBLEM: {p}")
     print("\n".join(lines))
@@ -316,6 +328,10 @@ def main(argv=None) -> int:
                                           "count/total/p50/p90/p99 digest")
     p_su.add_argument("src", help="trace JSONL file or trace directory")
     p_su.add_argument("--json", default=None, metavar="FILE")
+    p_su.add_argument("--top", type=int, default=None, metavar="N",
+                      help="rank kinds by total span wall (descending) and "
+                           "show only the top N (default: every kind, "
+                           "alphabetical)")
     p_su.set_defaults(fn=cmd_summary)
 
     p_fo = sub.add_parser("follow", help="tail a live trace directory "

@@ -38,6 +38,13 @@ DEFAULT_REPEATS = 5
 # verdicts must key on device-busy time instead.
 NOISY_WALLS_SPREAD = 0.3
 
+# The explain-or-noise bound on the authoritative ratio (VERDICT r2 #4 /
+# docs/PERF.md): tunnel variance is ±10-15%, so |ratio - 1| > 0.15 is a real
+# change that must be explained in PERF.md — and what the ledger's
+# regression sentinel (`brc-tpu ledger --check`) fails on mechanically when
+# a committed chain link drops below 1 - REGRESSION_THRESHOLD.
+REGRESSION_THRESHOLD = 0.15
+
 
 def timed_best_of(be, cfg, repeats: int = DEFAULT_REPEATS):
     """(result, walls) — warmed, ``repeats`` timed full runs of ``cfg``.

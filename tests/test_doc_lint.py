@@ -1,0 +1,105 @@
+"""Observability doc lint (round-13 satellite, tier-1).
+
+docs/OBSERVABILITY.md is the contract for every telemetry surface the repo
+emits: span/event kinds (obs/trace.py call sites) and versioned record-block
+keys (obs/record.py). Schema growth has so far been caught by hand-written
+per-round tests; this lint makes the catch mechanical — a new ``_trace.span(
+"new.kind", ...)`` call or a new ``*_BLOCK_KEYS`` field that is not
+documented fails tier-1, so the docs cannot silently fall behind the code.
+"""
+
+import pathlib
+import re
+
+import byzantinerandomizedconsensus_tpu as pkg
+from byzantinerandomizedconsensus_tpu.utils.rounds import repo_root
+
+_PKG_DIR = pathlib.Path(pkg.__file__).parent
+
+#: Direct span/event emissions: ``_trace.span("kind", ...)`` /
+#: ``_trace.event("kind", ...)`` — including the conditional-expression
+#: form ``span("a.b" if cond else "c.d", ...)``.
+_EMIT = re.compile(
+    r"(?:_trace|trace)\.(?:span|event)\(\s*\n?\s*"
+    r"\"([a-z0-9_.]+)\""
+    r"(?:\s+if\s+\w+\s*\n?\s*else\s+\"([a-z0-9_.]+)\")?")
+
+
+def _source_files():
+    files = [p for p in _PKG_DIR.rglob("*.py")]
+    files.append(pathlib.Path(repo_root()) / "bench.py")
+    return files
+
+
+def emitted_span_kinds() -> set:
+    kinds = set()
+    for p in _source_files():
+        for m in _EMIT.finditer(p.read_text()):
+            kinds.update(k for k in m.groups() if k)
+    return kinds
+
+
+def test_span_kind_census_is_nontrivial_and_complete():
+    """The regex harvest must see the known seams — if a refactor moves the
+    call sites out of its reach, this assert fails before the doc check
+    can silently pass on an empty set."""
+    kinds = emitted_span_kinds()
+    for expected in ("batch.dispatch", "batch.bucket", "backend.run",
+                     "compile_cache.compile", "compile_cache.hit",
+                     "compile_cache.evict", "compaction.init",
+                     "compaction.segment", "compaction.drain",
+                     "compaction.refill", "compact.run", "program.compile",
+                     "chaos.start", "chaos.progress", "chaos.skip",
+                     "chaos.child.jax"):
+        assert expected in kinds, (expected, sorted(kinds))
+    assert len(kinds) >= 20
+
+
+def test_every_emitted_span_kind_is_documented():
+    doc = (pathlib.Path(repo_root()) / "docs/OBSERVABILITY.md").read_text()
+    # The doc spells families compactly ("`compile_cache.compile` / `.hit`
+    # / `.evict`", "`chaos.start` / `.spawn` / ..."): a kind counts as
+    # documented when its full name appears, or its family head appears in
+    # backticked dotted form AND its tail appears as a backticked `.suffix`
+    # shorthand — anything looser lets an undocumented kind ride a word
+    # that merely occurs in prose.
+    missing = []
+    for kind in sorted(emitted_span_kinds()):
+        if kind in doc:
+            continue
+        head, _, tail = kind.rpartition(".")
+        if head and f"`{head}." in doc and f"`.{tail}`" in doc:
+            continue
+        missing.append(kind)
+    assert missing == [], (
+        f"span/event kinds emitted by the code but absent from "
+        f"docs/OBSERVABILITY.md: {missing} — document them in the "
+        "instrumented-seams table (§3c/§3d)")
+
+
+def test_every_record_block_key_is_documented():
+    """Every versioned record block name and every required field of the
+    *_BLOCK_KEYS registries (obs/record.py) must appear in
+    docs/OBSERVABILITY.md — the mechanical form of the per-round schema
+    sections."""
+    from byzantinerandomizedconsensus_tpu.obs import record
+
+    doc = (pathlib.Path(repo_root()) / "docs/OBSERVABILITY.md").read_text()
+    blocks = {
+        "compile_cache": ("compiles", "hits", "evictions"),
+        "compaction": record.COMPACTION_BLOCK_KEYS,
+        "trace": record.TRACE_BLOCK_KEYS,
+        "programs": record.PROGRAMS_BLOCK_KEYS,
+        "counters": ("supported", "totals"),
+    }
+    missing = []
+    for block, keys in blocks.items():
+        if f'"{block}"' not in doc and f"`{block}`" not in doc \
+                and f"**{block}" not in doc and f"{block} block" not in doc:
+            missing.append(block)
+        for key in keys:
+            if key not in doc:
+                missing.append(f"{block}.{key}")
+    assert missing == [], (
+        f"record blocks/keys emitted by obs/record.py but absent from "
+        f"docs/OBSERVABILITY.md: {missing}")

@@ -144,6 +144,184 @@ def test_ledger_json_out(tmp_path, capsys):
     capsys.readouterr()
 
 
+def test_ledger_json_stdout_mode(capsys):
+    """Round-13 satellite: bare --json prints the machine-readable record
+    (sentinel verdict included) INSTEAD of the human table."""
+    assert ledger.main(["--json"]) == 0
+    out = capsys.readouterr().out
+    doc = json.loads(out)  # stdout IS the record — no table around it
+    assert doc["kind"] == "ledger"
+    assert isinstance(doc["sentinel"]["ok"], bool)
+    assert "flight-recorder ledger" not in out
+
+
+def test_sentinel_passes_on_committed_artifact_set(capsys):
+    """`brc-tpu ledger --check` — the regression sentinel — must be green
+    on the repo as committed, with the r5->r11 wall link SKIPPED by the
+    mechanical device-chain rule (a CPU wall is not comparable to the r5
+    TPU anchor), not judged."""
+    assert ledger.main(["--check"]) == 0
+    capsys.readouterr()
+    doc = ledger.build_ledger()
+    sent = doc["sentinel"]
+    assert sent["ok"] and sent["failures"] == []
+    assert sent["threshold"] == 0.15  # timing.REGRESSION_THRESHOLD
+    assert any("r5->r11" in s and "not comparable across platforms" in s
+               for s in sent["links_skipped"])
+    # The consecutive TPU links were actually checked, not skipped.
+    checked = {c["link"] for c in sent["links_checked"]}
+    assert {"r1->r2", "r2->r3", "r3->r4", "r4->r5"} <= checked
+
+
+def _fake_repo(tmp_path, benches=(), artifacts=()):
+    tmp_path.mkdir(parents=True, exist_ok=True)
+    for rnd, parsed in benches:
+        (tmp_path / f"BENCH_r0{rnd}.json").write_text(
+            json.dumps({"parsed": parsed}))
+    art = tmp_path / "artifacts"
+    art.mkdir(exist_ok=True)
+    for name, doc in artifacts:
+        (art / name).write_text(json.dumps(doc))
+    return tmp_path
+
+
+def _bench_parsed(value, platform="tpu", vs_prev=None, walls=(1.0, 1.01)):
+    parsed = {"value": value,
+              "detail": {"walls_s": list(walls), "platform": platform}}
+    if vs_prev is not None:
+        parsed["vs_prev_round"] = vs_prev
+    return parsed
+
+
+def test_sentinel_flags_injected_wall_regression(tmp_path, capsys):
+    """An injected same-platform wall regression past
+    timing.REGRESSION_THRESHOLD exits nonzero under --check — and a
+    cross-platform drop of any size is skipped, not flagged (the r5 rule)."""
+    root = _fake_repo(tmp_path, benches=[
+        (1, _bench_parsed(100.0)),
+        (2, _bench_parsed(50.0, vs_prev=0.5)),       # real regression
+        (3, _bench_parsed(1.0, platform="cpu")),      # cross-platform: skip
+    ])
+    assert ledger.main(["--root", str(root)]) == 0   # census still parses
+    assert ledger.main(["--check", "--root", str(root)]) == 2
+    out = capsys.readouterr().out
+    assert "SENTINEL FAIL" in out
+    doc = ledger.build_ledger(root)
+    sent = doc["sentinel"]
+    assert not sent["ok"]
+    assert any("r1->r2" in f and "wall regression past "
+               "timing.REGRESSION_THRESHOLD" in f for f in sent["failures"])
+    assert any("r2->r3" in s and "not comparable" in s
+               for s in sent["links_skipped"])
+    # The 0.5 recomputed ratio AGREES with the recorded one, so only the
+    # threshold failure fires, not a recorded-drift failure too.
+    assert len(sent["failures"]) == 1
+
+    # Recorded-vs-recomputed drift is its own failure: same chain, but the
+    # artifact claims a ratio the walls don't support.
+    root2 = _fake_repo(tmp_path / "drift", benches=[
+        (1, _bench_parsed(100.0)),
+        (2, _bench_parsed(95.0, vs_prev=1.9)),
+    ])
+    sent2 = ledger.build_ledger(root2)["sentinel"]
+    assert any("disagrees with recorded" in f for f in sent2["failures"])
+    capsys.readouterr()
+
+
+def _programs_doc(key, hash_, platform="cpu"):
+    return {"record_version": 1, "record_revision": 4, "kind": "x",
+            "env": {"package": "0", "python": "3", "numpy": "1",
+                    "platform": platform},
+            "programs": {"count": 1, "programs": [
+                {"key": key, "fingerprint": {"hash": hash_, "ops": {},
+                                             "instructions": 1}}]}}
+
+
+def test_sentinel_flags_injected_fingerprint_drift(tmp_path, capsys):
+    """The same program key hashing differently on the same platform across
+    committed artifacts exits nonzero under --check; the same key differing
+    across PLATFORMS is expected (a TPU census is a fresh fingerprint
+    family) and passes."""
+    root = _fake_repo(tmp_path, artifacts=[
+        ("a_r1.json", _programs_doc("fused/bracha/n40/urn2/p1", "aaaa")),
+        ("b_r2.json", _programs_doc("fused/bracha/n40/urn2/p1", "bbbb")),
+    ])
+    assert ledger.main(["--root", str(root)]) == 0  # drift is not a parse error
+    assert ledger.main(["--check", "--root", str(root)]) == 2
+    assert "fingerprint drift" in capsys.readouterr().out
+    sent = ledger.build_ledger(root)["sentinel"]
+    assert any("fingerprint drift" in f and "aaaa" in f and "bbbb" in f
+               for f in sent["failures"])
+
+    # Same key, different platform: no drift.
+    root2 = _fake_repo(tmp_path / "xplat", artifacts=[
+        ("a_r1.json", _programs_doc("fused/bracha/n40/urn2/p1", "aaaa",
+                                    platform="cpu")),
+        ("b_r2.json", _programs_doc("fused/bracha/n40/urn2/p1", "cccc",
+                                    platform="tpu")),
+    ])
+    assert ledger.main(["--check", "--root", str(root2)]) == 0
+    capsys.readouterr()
+
+
+def test_census_includes_programs_artifact():
+    """The round-13 compiled-program census artifact: scanned, parsed with
+    zero errors, bit-identity + overhead acceptance on the record, the
+    schema-v1.4 program rows reconstructed by the ledger, and its
+    fingerprints feeding the sentinel without drift."""
+    import pathlib
+
+    from byzantinerandomizedconsensus_tpu.utils.rounds import repo_root
+
+    doc = ledger.build_ledger()
+    assert doc["parse_errors"] == []
+    rows = [r for r in doc["programs_rows"]
+            if r["artifact"] == "artifacts/programs_r13.json"]
+    assert rows, "programs_r13.json must yield census columns"
+    for r in rows:
+        assert r["key"] and r["hash"]
+        assert isinstance(r["flops"], (int, float)) and r["flops"] > 0
+        assert r["platform"] == "cpu"
+    # The fused chaos-grid program family is present (the <= 8-program claim
+    # is per (protocol, delivery, tier) — at least one fused key).
+    assert any(r["key"].startswith("fused/") for r in rows)
+    assert any(r["key"].startswith("compact-") for r in rows)
+    assert doc["sentinel"]["ok"], doc["sentinel"]["failures"]
+
+    pg = json.loads(
+        (pathlib.Path(repo_root())
+         / "artifacts/programs_r13.json").read_text())
+    assert pg["kind"] == "programs_census"
+    assert record.validate_record(pg) == []
+    assert pg["record_revision"] >= 4  # schema v1.4
+    assert pg["bit_identical"] is True
+    assert pg["overhead_fraction"] is not None
+    assert pg["overhead_fraction"] <= pg["overhead_bound"] == 0.02
+    assert pg["programs"]["count"] >= 3
+    assert pg["trace"]["file"] == "programs_r13.jsonl"
+    assert "device_chain_note" in pg  # CPU-only capture, rule on record
+
+    # The committed trace next to it is well-formed and program-attributed
+    # (the roofline join surface).
+    from byzantinerandomizedconsensus_tpu.obs import trace as trace_mod
+    from byzantinerandomizedconsensus_tpu.tools import (
+        programs as programs_tool)
+
+    jsonl = pathlib.Path(repo_root()) / "artifacts/programs_r13.jsonl"
+    assert trace_mod.validate_file(jsonl) == []
+    entries = programs_tool._programs_of(
+        pathlib.Path(repo_root()) / "artifacts/programs_r13.json")
+    rows = programs_tool.roofline_rows(entries,
+                                       trace_mod.read_events(jsonl))
+    assert rows and any(r["in_census"] and r.get("gflops_per_s")
+                        for r in rows)
+
+    # And the report renders the v1.4 columns + the sentinel line.
+    report = ledger.format_report(ledger.build_ledger())
+    assert "compiled-program census columns" in report
+    assert "sentinel: OK" in report
+
+
 def test_census_includes_compaction_artifact():
     """The round-11 lane-compaction A/B artifact: scanned, parsed with zero
     errors, bit-identity recorded on every compacted leg, and the

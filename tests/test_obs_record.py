@@ -36,6 +36,31 @@ def test_validate_record_catches_drift():
     assert any("totals" in p for p in record.validate_record(bad_counters))
 
 
+def test_validate_record_rejects_unknown_revision():
+    """Schema v1.4: a record_revision this build does not know (from the
+    future, or garbage) must fail BY NAME — the schema-drift census then
+    catches a half-understood artifact instead of part-validating it."""
+    future = {**record.new_record("x"),
+              "record_revision": record.RECORD_REVISION + 1}
+    problems = record.validate_record(future)
+    assert any(p.startswith("unknown record_revision") for p in problems), \
+        problems
+    assert any(f"0..{record.RECORD_REVISION}" in p for p in problems)
+    for bad in ("4", 4.5, True, -1):
+        assert any("unknown record_revision" in p for p in
+                   record.validate_record({**record.new_record("x"),
+                                           "record_revision": bad})), bad
+    # Every revision this build knows — including the legacy implied-v1
+    # absence — stays valid.
+    for ok in (None, 0, 1, 2, 3, record.RECORD_REVISION):
+        doc = record.new_record("x")
+        if ok is None:
+            doc.pop("record_revision")
+        else:
+            doc["record_revision"] = ok
+        assert record.validate_record(doc) == [], ok
+
+
 def test_timing_block_maps_suspect_to_error():
     """Absence-of-signal device 0.0s must land as errors (VERDICT r5 weak #1),
     real measurements as device_busy_s — the one mapping every tool shares."""
@@ -127,7 +152,10 @@ def test_schema_census_every_committed_artifact_validates():
         problems = record.validate_record(payload)
         assert problems == [], (p.name, problems)
         checked.append(p.name)
-    # The v1+ era census as committed (r8-r12: ledger_r8, chaos_r9,
-    # batch_r10, compaction_r11, BENCH_r11, trace_r12): an accidentally
-    # narrowed glob must not silently pass on near-zero coverage.
-    assert len(checked) >= 5, checked
+    # The v1+ era census as committed (r8-r13: ledger_r8, chaos_r9,
+    # batch_r10, compaction_r11, BENCH_r11, trace_r12, programs_r13): an
+    # accidentally narrowed glob must not silently pass on near-zero
+    # coverage — and the v1.4 artifact must be in the checked set, so the
+    # unknown-revision check above provably ran against a revision-4 head.
+    assert len(checked) >= 6, checked
+    assert "programs_r13.json" in checked, checked

@@ -1,0 +1,386 @@
+"""Compiled-program census (obs/programs.py; round 13).
+
+The acceptance bar mirrors the trace layer's: census-on runs must be
+bit-identical to census-off across the fault x adversary x delivery grid on
+the vmapped AND compacted paths (the measured wall-overhead bound lives in
+artifacts/programs_r13.json), the HLO fingerprint must be stable against
+the two known sources of spurious drift (SSA renumbering, source metadata),
+and the consumer surfaces (schema-v1.4 programs block, `brc-tpu programs`
+dump/diff/roofline, the ledger sentinel's fingerprint columns) must round-
+trip what the census captured.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from byzantinerandomizedconsensus_tpu.backends import batch as batch_mod
+from byzantinerandomizedconsensus_tpu.backends.jax_backend import JaxBackend
+from byzantinerandomizedconsensus_tpu.config import (
+    DELIVERY_KINDS, FAULT_KINDS, SimConfig)
+from byzantinerandomizedconsensus_tpu.obs import programs, record, trace
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_census():
+    """Every test starts and ends with the census (and tracer) disabled —
+    a leaked global would silently AOT-compile unrelated tests' programs."""
+    programs.disable()
+    trace.disable()
+    yield
+    programs.disable()
+    trace.disable()
+
+
+def _cfg(adv, proto, delivery, fault, n=7, f=2, seed=13, **kw):
+    base = dict(protocol=proto, n=n, f=f, instances=4, adversary=adv,
+                coin="local", seed=seed, round_cap=32, delivery=delivery,
+                faults=fault)
+    base.update(kw)
+    return SimConfig(**base).validate()
+
+
+# ---------------------------------------------------------------------------
+# the fingerprint
+
+
+_HLO_A = """HloModule jit_f, entry_computation_layout={(f32[4]{0})->f32[4]{0}}
+
+ENTRY %main.7 (Arg_0.1: f32[4]) -> f32[4] {
+  %Arg_0.1 = f32[4]{0} parameter(0), metadata={op_name="x" source_file="/a/b.py" source_line=3}
+  ROOT %sine.2 = f32[4]{0} sine(f32[4]{0} %Arg_0.1), metadata={op_name="jit(f)/sin"}
+}
+"""
+
+# The same program after a different compile history: SSA suffixes moved,
+# metadata points at another checkout path.
+_HLO_A2 = """HloModule jit_f, entry_computation_layout={(f32[4]{0})->f32[4]{0}}
+
+ENTRY %main.961 (Arg_0.44: f32[4]) -> f32[4] {
+  %Arg_0.44 = f32[4]{0} parameter(0), metadata={op_name="x" source_file="/elsewhere/b.py" source_line=9}
+  ROOT %sine.45 = f32[4]{0} sine(f32[4]{0} %Arg_0.44), metadata={op_name="jit(f)/sin"}
+}
+"""
+
+_HLO_B = _HLO_A.replace("sine", "cosine")
+
+
+def test_fingerprint_stable_against_renumbering_and_metadata():
+    fa, fa2 = programs.hlo_fingerprint(_HLO_A), programs.hlo_fingerprint(
+        _HLO_A2)
+    assert fa["hash"] == fa2["hash"]
+    assert fa["ops"] == {"parameter": 1, "sine": 1}
+    assert fa["instructions"] == 2
+    # A genuinely different program must hash differently.
+    assert programs.hlo_fingerprint(_HLO_B)["hash"] != fa["hash"]
+
+
+def test_normalize_strips_metadata_and_ssa_only():
+    norm = programs.normalize_hlo(_HLO_A)
+    assert "metadata" not in norm and "source_file" not in norm
+    assert "%Arg_0 = f32[4]{0} parameter(0)" in norm
+    # Constants and layouts survive normalization (they ARE the program).
+    assert programs.normalize_hlo("  %c.1 = f32[] constant(0.5)\n") \
+        == "%c = f32[] constant(0.5)"
+
+
+def test_fingerprint_stable_across_real_compile_histories():
+    import jax
+    import jax.numpy as jnp
+
+    def make():
+        return jax.jit(lambda x: jnp.sin(x) @ x)
+
+    args = (jnp.ones((4, 4)),)
+    c1 = make().lower(*args).compile()
+    for k in range(3):  # pollute the global SSA/name counters
+        jax.jit(lambda x: x + k).lower(jnp.ones(3)).compile()
+    c2 = make().lower(*args).compile()
+    f1 = programs.hlo_fingerprint(c1.as_text())
+    f2 = programs.hlo_fingerprint(c2.as_text())
+    assert f1["hash"] == f2["hash"] and f1["instructions"] >= 2
+
+
+# ---------------------------------------------------------------------------
+# the capture seams
+
+
+def test_disabled_census_is_inert():
+    assert not programs.enabled()
+    import jax
+
+    fn = jax.jit(lambda x: x + 1)
+    assert programs.instrument("k", fn) is fn  # no wrap when off
+    assert record.programs_block() is None
+
+
+def test_census_captures_bucket_programs_and_attaches_to_cache():
+    jb = JaxBackend()  # fresh instance: its bucket cache starts empty
+    census = programs.configure()
+    tr = trace.configure()  # in-memory: catch the program.compile events
+    a = _cfg("none", "benor", "urn2", "none", f=2, seed=1, instances=3)
+    b = _cfg("none", "benor", "urn2", "none", f=1, seed=2, instances=3)
+    res_a = jb.run_batch([a])
+    jb.run_batch([b])  # same bucket: a cache hit, no second capture
+    trace.disable()
+
+    assert len(census.entries) == 1 and census.capture_errors == 0
+    (key, entry), = census.entries.items()
+    assert entry["fingerprint"]["hash"] and entry["fingerprint"]["ops"]
+    assert entry["cost"]["flops"] > 0
+    assert entry["cost"]["bytes_accessed"] > 0
+    assert entry["memory"]["resident_bytes"] > 0
+    assert entry["signature"]["num_args"] >= 5  # keys/fs/wins/neffs/ids
+    assert entry["compile_wall_s"] > 0
+    # Attached to the cache entry AND visible through the backend accessor.
+    cache = batch_mod.compile_cache(jb)
+    assert cache.programs[key] is entry
+    assert jb.program_census()[key] is entry
+    # The compile seam emitted the census trace event with the identity.
+    ev = next(e for e in tr.events if e["kind"] == "program.compile")
+    assert ev["attrs"]["hash"] == entry["fingerprint"]["hash"]
+    assert ev["attrs"]["flops"] == entry["cost"]["flops"]
+    # Results came from the AOT executable — compare against a census-off
+    # backend for bit-identity.
+    off = JaxBackend()
+    programs.disable()
+    ref = off.run_batch([a])
+    np.testing.assert_array_equal(res_a[0].rounds, ref[0].rounds)
+    np.testing.assert_array_equal(res_a[0].decision, ref[0].decision)
+
+
+def test_census_covers_per_config_seam():
+    jb = JaxBackend()
+    census = programs.configure()
+    cfg = _cfg("crash", "benor", "urn2", "none", instances=3)
+    res = jb.run(cfg)
+    keys = list(census.entries)
+    assert any(k.startswith("config/benor/n7/") for k in keys), keys
+    programs.disable()
+    ref = JaxBackend().run(cfg)
+    np.testing.assert_array_equal(res.rounds, ref.rounds)
+    np.testing.assert_array_equal(res.decision, ref.decision)
+
+
+def test_census_survives_shape_drift_on_per_config_path():
+    """The AOT executable captured on the first call is shape-specialized,
+    but the per-config cache is keyed by config alone — a later run of the
+    SAME config with a smaller inst_ids subset dispatches a smaller chunk
+    and must fall back to the lazy jit instead of crashing ('the census can
+    never break a run')."""
+    jb = JaxBackend()
+    programs.configure()
+    cfg = _cfg("none", "benor", "urn2", "none", instances=8)
+    full = jb.run(cfg)                      # captures at chunk=8
+    sub = jb.run(cfg, np.arange(2))         # chunk=2: shape drift
+    programs.disable()
+    ref = JaxBackend().run(cfg)
+    np.testing.assert_array_equal(full.rounds, ref.rounds)
+    np.testing.assert_array_equal(sub.rounds, ref.rounds[:2])
+    np.testing.assert_array_equal(sub.decision, ref.decision[:2])
+
+
+def test_census_inert_across_fault_adversary_delivery_grid():
+    """The tentpole acceptance bar: census-on bit-identical to census-off
+    over a covering (fault, delivery) sample with rotating adversaries, on
+    the vmapped AND compacted paths — and the census must come out covering
+    the dispatch + compaction program families."""
+    from byzantinerandomizedconsensus_tpu.backends.compaction import (
+        CompactionPolicy)
+
+    _ADV_PROTO = (("none", "benor"), ("crash", "benor"),
+                  ("byzantine", "bracha"), ("adaptive", "bracha"))
+    cells = [(FAULT_KINDS[i], DELIVERY_KINDS[j])
+             for i, j in ((0, 0), (1, 1), (2, 3), (3, 2))]
+    cfgs = []
+    for i, (fault, delivery) in enumerate(cells):
+        adv, proto = _ADV_PROTO[i % len(_ADV_PROTO)]
+        cfgs += [_cfg(adv, proto, delivery, fault),
+                 _cfg(adv, proto, delivery, fault, f=1, seed=99,
+                      instances=6)]
+    off = JaxBackend()
+    base, _ = off.run_many(cfgs)
+    base_c, _ = off.run_many(cfgs, compaction=CompactionPolicy(width=4,
+                                                               segment=1))
+
+    on = JaxBackend()
+    census = programs.configure()
+    traced, _ = on.run_many(cfgs)
+    traced_c, _ = on.run_many(cfgs, compaction=CompactionPolicy(width=4,
+                                                                segment=1))
+
+    for a, b in zip(base + base_c, traced + traced_c):
+        np.testing.assert_array_equal(a.rounds, b.rounds)
+        np.testing.assert_array_equal(a.decision, b.decision)
+
+    assert census.capture_errors == 0
+    keys = list(census.entries)
+    assert any("compact-seg/" in k for k in keys)
+    assert any("compact-init/" in k for k in keys)
+    assert any(not k.startswith(("compact-", "config/")) for k in keys)
+    # Every entry is identity-complete: fingerprint + cost on this backend.
+    for entry in census.entries.values():
+        assert entry["fingerprint"]["hash"]
+        assert entry["cost"]["flops"] > 0
+
+
+# ---------------------------------------------------------------------------
+# schema v1.4
+
+
+def test_programs_block_and_validate_record():
+    census = programs.configure()
+    census.record({"key": "k1", "compile_wall_s": 0.5,
+                   "fingerprint": {"hash": "abc", "ops": {"add": 1},
+                                   "instructions": 1},
+                   "cost": {"flops": 10, "bytes_accessed": 4}})
+    census.record({"key": "k2", "compile_wall_s": 0.25,
+                   "fingerprint": {"hash": "def", "ops": {},
+                                   "instructions": 0}})
+    blk = record.programs_block()
+    assert blk["count"] == 2
+    assert blk["totals"]["flops"] == 10
+    assert blk["totals"]["compile_wall_s"] == 0.75
+    doc = {**record.new_record("programs_census"), "programs": blk}
+    assert record.validate_record(doc) == []
+    assert doc["record_revision"] == 4
+
+    # Drift checks: a torn block and an identity-free entry must fail.
+    assert any("programs block missing" in p for p in record.validate_record(
+        {**record.new_record("x"), "programs": {"count": 1}}))
+    assert any("'key'/'fingerprint'" in p for p in record.validate_record(
+        {**record.new_record("x"),
+         "programs": {"count": 1, "programs": [{"cost": {}}]}}))
+
+
+def test_programs_block_from_backend_and_empty_sources():
+    assert record.programs_block() is None  # census off
+    census = programs.configure()
+    assert record.programs_block() is None  # on but empty
+    census.record({"key": "k", "compile_wall_s": 0.0,
+                   "fingerprint": {"hash": "h", "ops": {},
+                                   "instructions": 0}})
+    assert record.programs_block()["count"] == 1
+    assert record.programs_block({"k": census.entries["k"]})["count"] == 1
+    assert record.programs_block({}) is None
+
+
+# ---------------------------------------------------------------------------
+# consumer surfaces (tools/programs.py)
+
+
+def _sample_artifact(tmp_path, name="census.json", key="prog/a",
+                     hash_="aaaa", flops=1000, trace_file=None):
+    blk = {"count": 1, "programs": [{
+        "key": key, "compile_wall_s": 1.0,
+        "fingerprint": {"hash": hash_, "ops": {"add": 2, "while": 1},
+                        "instructions": 3},
+        "cost": {"flops": flops, "bytes_accessed": 500,
+                 "transcendentals": 7},
+        "memory": {"resident_bytes": 2048}}],
+        "totals": {"flops": flops}}
+    doc = {**record.new_record("programs_census"), "programs": blk}
+    if trace_file:
+        doc["trace"] = {"file": trace_file, "events": 2, "digest": {}}
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    return p
+
+
+def test_programs_dump_and_diff(tmp_path, capsys):
+    from byzantinerandomizedconsensus_tpu.tools import (
+        programs as programs_tool)
+
+    a = _sample_artifact(tmp_path, "a.json", hash_="aaaa")
+    b = _sample_artifact(tmp_path, "b.json", hash_="bbbb", flops=2000)
+    assert programs_tool.main(["dump", str(a), "--ops", "2"]) == 0
+    out = capsys.readouterr().out
+    assert "prog/a" in out and "aaaa" in out and "addx2" in out
+
+    # Same key, different hash: drift — nonzero, with both hashes named.
+    assert programs_tool.main(["diff", str(a), str(b)]) == 1
+    out = capsys.readouterr().out
+    assert "aaaa -> bbbb" in out and "1000 -> 2000" in out
+    assert programs_tool.main(["diff", str(a), str(a)]) == 0
+    capsys.readouterr()
+
+    # No census block: dump says so and fails distinguishably.
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps(record.new_record("bench")))
+    assert programs_tool.main(["dump", str(empty)]) == 1
+    capsys.readouterr()
+
+
+def test_programs_roofline_joins_trace_spans(tmp_path, capsys):
+    from byzantinerandomizedconsensus_tpu.tools import (
+        programs as programs_tool)
+
+    art = _sample_artifact(tmp_path, "c.json", key="prog/a",
+                           trace_file="c.jsonl")
+    events = [
+        {"ph": "X", "kind": "batch.dispatch", "ts": 1.0, "dur": 2.0,
+         "pid": 1, "tid": 0, "attrs": {"program": "prog/a",
+                                       "dispatches": 4}},
+        {"ph": "X", "kind": "compaction.segment", "ts": 4.0, "dur": 1.0,
+         "pid": 1, "tid": 0, "attrs": {"program": "prog/other"}},
+        {"ph": "X", "kind": "batch.bucket", "ts": 0.0, "dur": 9.0,
+         "pid": 1, "tid": 0, "attrs": {"program": "prog/a"}},  # not a dispatch kind
+    ]
+    (tmp_path / "c.jsonl").write_text(
+        "\n".join(json.dumps(e) for e in events) + "\n")
+    assert programs_tool.main(["roofline", "--census", str(art),
+                               "--json"]) == 0
+    rows = {r["key"]: r for r in
+            json.loads(capsys.readouterr().out)["rows"]}
+    row = rows["prog/a"]
+    assert row["dispatches"] == 4 and row["wall_s"] == 2.0
+    assert row["gflops_per_s"] == round(1000 * 4 / 2.0 / 1e9, 4)
+    assert row["intensity_flops_per_byte"] == 2.0
+    assert row["in_census"]
+    # A dispatched program missing from the census is flagged, not dropped.
+    assert rows["prog/other"]["in_census"] is False
+
+
+def test_programs_census_smoke(tmp_path, capsys):
+    """The tier-1 form of the round-13 A/B: a small seeded grid, one
+    repeat, artifact written and self-validating, exit 0 (bit-identical,
+    overhead bound trivially met at this scale is NOT asserted — only the
+    record shape and the bit-identity/census-nonempty gates)."""
+    from byzantinerandomizedconsensus_tpu.tools import (
+        programs as programs_tool)
+
+    out = tmp_path / "programs_smoke.json"
+    rc = programs_tool.main([
+        "census", "--configs", "4", "--repeats", "1",
+        "--compacted-sample", "2", "--per-config-sample", "1",
+        "--out", str(out)])
+    capsys.readouterr()
+    doc = json.loads(out.read_text())
+    assert record.validate_record(doc) == []
+    assert doc["kind"] == "programs_census"
+    assert doc["bit_identical"] is True
+    assert doc["programs"]["count"] >= 2
+    assert doc["capture_errors"] == 0
+    assert doc["trace"] is not None and doc["trace"]["events"] > 0
+    # program.compile events landed in the bound trace.
+    assert "program.compile" in doc["trace"]["digest"]
+    # The A/B gates: rc 0 unless the tiny grid's walls were degenerate —
+    # bit-identity and a non-empty census are the load-bearing assertions.
+    assert rc in (0, 1)
+    # The committed-artifact convention: the trace JSONL sits next to the
+    # record under the record's own name.
+    assert (tmp_path / "programs_smoke.jsonl").exists()
+    # And the roofline verb joins the two as committed.
+    assert programs_tool.main(["roofline", "--census", str(out),
+                               "--json"]) == 0
+    rows = json.loads(capsys.readouterr().out)["rows"]
+    assert rows and any(r["in_census"] for r in rows)
+
+
+def test_cli_routes_programs_verb(tmp_path, capsys):
+    from byzantinerandomizedconsensus_tpu import cli
+
+    art = _sample_artifact(tmp_path)
+    assert cli.main(["programs", "dump", str(art)]) == 0
+    assert "compiled-program census" in capsys.readouterr().out

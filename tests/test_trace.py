@@ -309,6 +309,39 @@ def test_trace_cli_summary_and_export(tmp_path, capsys):
     capsys.readouterr()
 
 
+def test_trace_summary_top_ranks_by_total_wall(tmp_path, capsys):
+    """Round-13 satellite: --top N sorts kinds by total span wall
+    (descending, instants last) and truncates, naming what it dropped."""
+    from byzantinerandomizedconsensus_tpu.tools import trace as trace_tool
+
+    trace.configure(tmp_path, role="top")
+    events = [("slow.kind", 0.5), ("slow.kind", 0.4),
+              ("mid.kind", 0.3), ("fast.kind", 0.01)]
+    path = tmp_path / "trace-top.jsonl"
+    trace.disable()
+    with open(path, "w") as fh:
+        ts = 0.0
+        for kind, dur in events:
+            fh.write(json.dumps({"ph": "X", "kind": kind, "ts": ts,
+                                 "dur": dur, "pid": 1, "tid": 0}) + "\n")
+            ts += dur + 1.0
+        fh.write(json.dumps({"ph": "i", "kind": "a.tick", "ts": ts,
+                             "pid": 1, "tid": 0}) + "\n")
+    assert trace_tool.main(["summary", str(path), "--top", "2"]) == 0
+    out = capsys.readouterr().out
+    body = [l for l in out.splitlines()[1:] if l.startswith("  ")]
+    # Ranked: biggest total first, count-only instants below every span,
+    # and the truncation is announced.
+    assert body[0].startswith("  slow.kind") and "total 0.9 s" in body[0]
+    assert body[1].startswith("  mid.kind")
+    assert "fast.kind" not in out and "a.tick" not in out
+    assert "2 more kind(s) below the top 2" in out
+    # Default stays the full unranked (alphabetical) dump.
+    assert trace_tool.main(["summary", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "fast.kind" in out and "a.tick" in out
+
+
 def test_trace_follow_reads_live_directory_incrementally(tmp_path):
     from byzantinerandomizedconsensus_tpu.tools import trace as trace_tool
 
