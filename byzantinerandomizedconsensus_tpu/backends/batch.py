@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import threading
 import time
 from collections import OrderedDict
 from functools import partial
@@ -257,13 +258,23 @@ class CompileCache:
     here in ``programs`` (keyed by the cache key's label), and the cached
     callable becomes the compiled executable itself — the same XLA program
     the lazy jit would have built, so results are bit-identical either way
-    (tests/test_programs.py)."""
+    (tests/test_programs.py).
+
+    Thread safety (round 14): the serving loop calls ``get`` from its
+    dispatcher thread while request/monitor threads read ``stats()`` — all
+    LRU-dict mutation, counter updates and the census ``programs`` attach
+    happen under one reentrant lock. Lookup *and* ``build()`` stay under the
+    lock on purpose: ``build`` returns a lazy ``jax.jit`` wrapper in
+    microseconds, so serializing it costs nothing and guarantees one entry
+    per key; the expensive XLA compile runs in ``_timed_first_call`` under a
+    per-entry lock instead, so a compile never blocks unrelated hits."""
 
     def __init__(self, max_entries: int = 32):
         if max_entries < 1:
             raise ValueError("CompileCache needs max_entries >= 1")
         self.max_entries = max_entries
         self._entries: OrderedDict = OrderedDict()
+        self._lock = threading.RLock()
         self.compiles = 0
         self.hits = 0
         self.evictions = 0
@@ -275,87 +286,103 @@ class CompileCache:
         self.programs: OrderedDict = OrderedDict()
 
     def get(self, key, build):
-        if key in self._entries:
-            self._entries.move_to_end(key)
-            self.hits += 1
-            _trace.event("compile_cache.hit", key=_key_label(key))
-            return self._entries[key]
-        t0 = time.perf_counter()
-        fn = build()
-        wall = time.perf_counter() - t0
-        self.compiles += 1
-        self.compile_wall_s += wall
-        if callable(fn):
-            fn = self._timed_first_call(key, fn, wall)
-        else:
-            _trace.event("compile_cache.compile", key=_key_label(key),
-                         wall_s=round(wall, 6))
-        self._entries[key] = fn
-        while len(self._entries) > self.max_entries:
-            old_key, _ = self._entries.popitem(last=False)
-            self.evictions += 1
-            _trace.event("compile_cache.evict", key=_key_label(old_key))
-        return fn
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                _trace.event("compile_cache.hit", key=_key_label(key))
+                return self._entries[key]
+            t0 = time.perf_counter()
+            fn = build()
+            wall = time.perf_counter() - t0
+            self.compiles += 1
+            self.compile_wall_s += wall
+            if callable(fn):
+                fn = self._timed_first_call(key, fn, wall)
+            else:
+                _trace.event("compile_cache.compile", key=_key_label(key),
+                             wall_s=round(wall, 6))
+            self._entries[key] = fn
+            while len(self._entries) > self.max_entries:
+                old_key, _ = self._entries.popitem(last=False)
+                self.evictions += 1
+                _trace.event("compile_cache.evict", key=_key_label(old_key))
+            return fn
 
     def _timed_first_call(self, key, fn, build_wall: float):
         timed = False
+        first = threading.Lock()  # one real XLA compile, however many callers
 
         def wrapper(*args, **kw):
             # Only the FIRST invocation is the compile; callers that hold
             # the wrapper (the multi-chunk dispatch loop fetches it once)
             # keep calling it, and those later calls are plain execution —
             # timing them would inflate compile_wall_s and spam the trace.
+            # Concurrent first callers serialize on the per-entry lock (the
+            # loser executes plain once the winner's compile lands); the
+            # cache-wide lock is NOT held across the compile, so a slow
+            # compile in one bucket never stalls hits in another.
             nonlocal timed, fn
             if timed:
                 return fn(*args, **kw)
-            label = _key_label(key)
-            if _programs.enabled() and hasattr(fn, "lower"):
-                # Census path (opt-in): the one compile seam routes through
-                # AOT lower()/compile() so the program's anatomy is
-                # capturable; the compiled executable replaces the lazy jit
-                # wrapper (same XLA program — bit-identical results).
+            with first:
+                if timed:
+                    return fn(*args, **kw)
+                label = _key_label(key)
+                if _programs.enabled() and hasattr(fn, "lower"):
+                    # Census path (opt-in): the one compile seam routes
+                    # through AOT lower()/compile() so the program's anatomy
+                    # is capturable; the compiled executable replaces the
+                    # lazy jit wrapper (same XLA program — bit-identical
+                    # results).
+                    t0 = time.perf_counter()
+                    out, compiled, entry = _programs.capture_call(
+                        label, fn, args, kw)
+                    wall = time.perf_counter() - t0
+                    if compiled is not None:
+                        fn = compiled
+                    timed = True
+                    with self._lock:
+                        self.compile_wall_s += wall
+                        if entry is not None:
+                            self.programs[label] = entry
+                        if self._entries.get(key) is wrapper:  # unwrap
+                            self._entries[key] = fn
+                    _trace.event("compile_cache.compile", key=label,
+                                 wall_s=round(build_wall + wall, 6))
+                    return out
                 t0 = time.perf_counter()
-                out, compiled, entry = _programs.capture_call(
-                    label, fn, args, kw)
+                out = fn(*args, **kw)
                 wall = time.perf_counter() - t0
                 timed = True
-                self.compile_wall_s += wall
+                with self._lock:
+                    self.compile_wall_s += wall
+                    if self._entries.get(key) is wrapper:  # unwrap
+                        self._entries[key] = fn
                 _trace.event("compile_cache.compile", key=label,
                              wall_s=round(build_wall + wall, 6))
-                if entry is not None:
-                    self.programs[label] = entry
-                if compiled is not None:
-                    fn = compiled
-                if self._entries.get(key) is wrapper:  # still cached: unwrap
-                    self._entries[key] = fn
                 return out
-            t0 = time.perf_counter()
-            out = fn(*args, **kw)
-            wall = time.perf_counter() - t0
-            timed = True
-            self.compile_wall_s += wall
-            _trace.event("compile_cache.compile", key=label,
-                         wall_s=round(build_wall + wall, 6))
-            if self._entries.get(key) is wrapper:  # still cached: unwrap
-                self._entries[key] = fn
-            return out
 
         return wrapper
 
     def __len__(self):
-        return len(self._entries)
+        with self._lock:
+            return len(self._entries)
 
     def stats(self) -> dict:
         """The run-record ``compile_cache`` block (obs/record.py v1.1;
-        ``compile_wall_s`` since schema v1.3)."""
-        return {
-            "compiles": self.compiles,
-            "hits": self.hits,
-            "evictions": self.evictions,
-            "entries": len(self._entries),
-            "max_entries": self.max_entries,
-            "compile_wall_s": round(self.compile_wall_s, 6),
-        }
+        ``compile_wall_s`` since schema v1.3). Safe from any thread — the
+        serving loop reads it per request to prove zero steady-state
+        recompiles."""
+        with self._lock:
+            return {
+                "compiles": self.compiles,
+                "hits": self.hits,
+                "evictions": self.evictions,
+                "entries": len(self._entries),
+                "max_entries": self.max_entries,
+                "compile_wall_s": round(self.compile_wall_s, 6),
+            }
 
 
 def _run_lanes(bucket: ShapeBucket, keys, fs, wins, neffs, inst_ids):

@@ -47,6 +47,7 @@ with the next config's, keeping occupancy high across config boundaries.
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Optional, Sequence
 
 import numpy as np
@@ -119,6 +120,75 @@ class CompactionPolicy:
         """The run-record ``policy`` sub-block (obs/record.py schema v1.2)."""
         return {"width": self.width, "segment": self.segment,
                 "refill_threshold": self.refill_threshold}
+
+
+class WorkFeed:
+    """Externally-fed work queue for :func:`run_bucket` — the serving seam
+    (round 14, closing round 11's open leg (b)).
+
+    The offline path hands ``run_bucket`` a closed list of configs; a server
+    cannot. A ``WorkFeed`` lets requests arrive *while the lane grid is
+    flying*: ``push(cfg)`` from any thread enqueues a config (with an opaque
+    ``token`` the retirement callback hands back), ``run_bucket`` splices
+    newly arrived items into its host work stream at every segment boundary,
+    and freed lanes refill from them exactly like queued offline work —
+    placement never enters a draw (spec §2 coordinates), so served results
+    stay bit-identical to the offline path.
+
+    Two program-stability rules keep the steady state recompile-free:
+    ``run_bucket`` pins the grid width to the policy's lane tier (never
+    shrinking to the momentary queue length), and the drain program is
+    compiled once at ``round_cap_ceiling`` — ``push`` rejects configs whose
+    cap exceeds it, so no late request can mint a new program key.
+    """
+
+    def __init__(self, round_cap_ceiling: int = 128):
+        if round_cap_ceiling < 1:
+            raise ValueError(
+                f"round_cap_ceiling={round_cap_ceiling} out of range (>= 1)")
+        self.round_cap_ceiling = int(round_cap_ceiling)
+        self._items: list = []
+        self._cv = threading.Condition()
+        self._closed = False
+
+    def push(self, cfg, ids=None, token=None) -> None:
+        """Enqueue one config (its instances become queued lane work).
+        ``ids`` defaults to the config's full instance range; ``token`` is
+        returned verbatim to ``on_retire`` when the config completes."""
+        if cfg.round_cap > self.round_cap_ceiling:
+            raise ValueError(
+                f"round_cap={cfg.round_cap} exceeds the feed ceiling "
+                f"{self.round_cap_ceiling}: the drain program is compiled "
+                "once per bucket at the ceiling, so admission must reject "
+                "or re-route larger caps")
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("push on a closed WorkFeed")
+            self._items.append((cfg, ids, token))
+            self._cv.notify_all()
+
+    def close(self) -> None:
+        """No more pushes; run_bucket drains what remains and returns."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    def closed(self) -> bool:
+        return self._closed
+
+    def pull(self, block: bool = False):
+        """Everything pushed since the last pull: a list of
+        ``(cfg, ids, token)`` items, ``[]`` when nothing is pending, or
+        ``None`` once the feed is closed *and* drained. ``block=True`` waits
+        for items or close — the idle server parks here."""
+        with self._cv:
+            while block and not self._items and not self._closed:
+                self._cv.wait()
+            if not self._items:
+                return None if self._closed else []
+            out = self._items
+            self._items = []
+            return out
 
 
 def _lane_cfg(bucket, op):
@@ -354,12 +424,22 @@ class _StaticCfgView:
 
 
 def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
-               counters: bool = False, progress=None):
+               counters: bool = False, progress=None, feed=None,
+               on_retire=None):
     """Run every instance of every config of ONE bucket through the
     compacted lane grid. Returns ``(results, docs_or_None, stats)`` with
     ``results`` per-config SimResults bit-identical to the per-chunk path and
     ``stats`` the run-record ``compaction`` block payload (occupancy,
     wasted-lane-rounds, refills).
+
+    ``feed`` (a :class:`WorkFeed`) opens the queue to the outside: configs
+    pushed from other threads join the work stream at segment boundaries and
+    refill freed lanes mid-flight — the serving loop's admission path. The
+    grid width is then pinned to the policy tier and the drain length to the
+    feed's cap ceiling so steady state compiles nothing new. ``on_retire``
+    is called as ``on_retire(token, SimResult)`` the moment a config's last
+    instance retires — replies stream out per request, not at grid end
+    (tokens for the initial ``cfgs`` are their list indices).
     """
     import jax
     import jax.numpy as jnp
@@ -373,8 +453,80 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
             "fused compacted lanes have no counter leg: the counter schema "
             "is a static function of the fault kind, which is lane data "
             "here (same rule as run_fused)")
+    if counters and feed is not None:
+        raise _c.CountersUnsupported(
+            "the externally-fed lane grid has no counter leg: serving "
+            "replies carry (rounds, decision) only")
 
-    total = sum(len(ids) for ids in ids_list)
+    cfgs = list(cfgs)
+    ids_list = list(ids_list)
+    tokens = list(range(len(cfgs)))
+    remaining = [len(ids) for ids in ids_list]
+    rounds_out = [np.zeros(len(ids), dtype=np.int32) for ids in ids_list]
+    dec_out = [np.zeros(len(ids), dtype=np.uint8) for ids in ids_list]
+    total = sum(remaining)
+
+    # The shared work stream: configs in input order, flattened to parallel
+    # (config index, row position, instance id) arrays with a head pointer.
+    # Queue order never enters any draw (spec §2 coordinates).
+    if cfgs:
+        work_cfg = np.concatenate([np.full(len(ids), ci, dtype=np.int32)
+                                   for ci, ids in enumerate(ids_list)])
+        work_pos = np.concatenate([np.arange(len(ids), dtype=np.int64)
+                                   for ids in ids_list])
+        work_iid = np.concatenate([np.asarray(ids, dtype=np.uint32)
+                                   for ids in ids_list])
+        cfg_rows = [_host_op_row(bucket, c) for c in cfgs]
+        op_mat = {k: np.stack([row[k] for row in cfg_rows])
+                  for k in cfg_rows[0]}  # (n_cfgs, ...) per operand
+    else:
+        work_cfg = np.empty(0, dtype=np.int32)
+        work_pos = np.empty(0, dtype=np.int64)
+        work_iid = np.empty(0, dtype=np.uint32)
+        op_mat = {}
+
+    def _ingest(block=False):
+        """Splice newly arrived feed items into the host work stream.
+        Returns False once the feed is closed and drained."""
+        nonlocal work_cfg, work_pos, work_iid, total
+        items = feed.pull(block=block)
+        if items is None:
+            return False
+        for cfg, ids, token in items:
+            cfg = cfg.validate()
+            ids = (np.asarray(backend._resolve_inst_ids(cfg, None))
+                   if ids is None else np.asarray(ids))
+            ci = len(cfgs)
+            cfgs.append(cfg)
+            ids_list.append(ids)
+            tokens.append(token if token is not None else ci)
+            remaining.append(len(ids))
+            rounds_out.append(np.zeros(len(ids), dtype=np.int32))
+            dec_out.append(np.zeros(len(ids), dtype=np.uint8))
+            row = _host_op_row(bucket, cfg)
+            for k in row:
+                v = np.asarray(row[k])[None]
+                op_mat[k] = (np.concatenate([op_mat[k], v])
+                             if k in op_mat else v)
+            work_cfg = np.concatenate(
+                [work_cfg, np.full(len(ids), ci, dtype=np.int32)])
+            work_pos = np.concatenate(
+                [work_pos, np.arange(len(ids), dtype=np.int64)])
+            work_iid = np.concatenate(
+                [work_iid, np.asarray(ids, dtype=np.uint32)])
+            total += len(ids)
+            if on_retire is not None and len(ids) == 0:
+                on_retire(tokens[ci], SimResult(
+                    config=cfg, inst_ids=ids, rounds=rounds_out[ci],
+                    decision=dec_out[ci]))
+        return True
+
+    if feed is not None:
+        # Block for the first work item so the grid never spins empty; a
+        # feed closed before any push degenerates to the offline empty run.
+        _ingest(block=total == 0)
+
+    head = 0
     if total == 0:
         results = [SimResult(config=c, inst_ids=i,
                              rounds=np.empty(0, dtype=np.int32),
@@ -390,33 +542,23 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
                                "wasted_lane_fraction": None,
                                "policy": policy.doc()}
 
-    base = policy.width or _chunk_instances(
-        bucket, 1, total, backend.chunk_bytes, backend.max_chunk)
-    W = min(lane_tier(base), lane_tier(total))
-
-    # The shared work stream: configs in input order, flattened to parallel
-    # (config index, row position, instance id) arrays with a head pointer.
-    # Queue order never enters any draw (spec §2 coordinates).
-    work_cfg = np.concatenate([np.full(len(ids), ci, dtype=np.int32)
-                               for ci, ids in enumerate(ids_list)])
-    work_pos = np.concatenate([np.arange(len(ids), dtype=np.int64)
-                               for ids in ids_list])
-    work_iid = np.concatenate([np.asarray(ids, dtype=np.uint32)
-                               for ids in ids_list])
-    head = 0
-    cfg_rows = [_host_op_row(bucket, c) for c in cfgs]
-    op_mat = {k: np.stack([row[k] for row in cfg_rows])
-              for k in cfg_rows[0]}  # (n_cfgs, ...) per operand
     n_counters = len(_c.counter_names(cfgs[0])) if counters else 0
-
-    rounds_out = [np.zeros(len(ids), dtype=np.int32) for ids in ids_list]
-    dec_out = [np.zeros(len(ids), dtype=np.uint8) for ids in ids_list]
     acc_out = ([np.zeros((len(ids), n_counters, 2), dtype=np.uint32)
                 for ids in ids_list] if counters else None)
 
+    base = policy.width or _chunk_instances(
+        bucket, 1, total, backend.chunk_bytes, backend.max_chunk)
+    # Feed mode pins W to the policy tier: shrinking to the momentary queue
+    # length would mint per-arrival program keys and recompile at steady
+    # state; offline keeps the round-11 total-shrink (small grids, small
+    # programs).
+    W = (lane_tier(base) if feed is not None
+         else min(lane_tier(base), lane_tier(total)))
+
     cache = compile_cache(backend)
     seg = policy.segment
-    drain_seg = max(seg, max(int(c.round_cap) for c in cfgs))
+    drain_seg = (max(seg, feed.round_cap_ceiling) if feed is not None
+                 else max(seg, max(int(c.round_cap) for c in cfgs)))
 
     def init_program():
         return cache.get(("compact-init", bucket, W, counters),
@@ -478,8 +620,10 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
     while True:
         # The per-trip wall the round-11 anatomy reconstructed by hand is
         # now this span's duration; drain trips get their own kind so the
-        # straggler tail is directly queryable in the digest.
-        drain = head >= total
+        # straggler tail is directly queryable in the digest. An open feed
+        # suppresses drain mode: short segments keep the grid responsive to
+        # arrivals; the long drain dispatch waits for close().
+        drain = head >= total and (feed is None or feed.closed())
         with _trace.span("compaction.drain" if drain
                          else "compaction.segment",
                          width=W, queued=total - head,
@@ -498,12 +642,20 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
             prev_r = np.asarray(r_h, dtype=np.int64)
             retire = np.asarray(fin_h, dtype=bool) & (owner_cfg >= 0)
             for ci in np.unique(owner_cfg[retire]):
+                ci = int(ci)
                 sel = retire & (owner_cfg == ci)
                 rows = owner_pos[sel]
                 rounds_out[ci][rows] = rounds_h[sel]
                 dec_out[ci][rows] = dec_h[sel]
                 if counters:
                     acc_out[ci][rows] = fetch[4][sel]
+                remaining[ci] -= int(sel.sum())
+                if on_retire is not None and remaining[ci] == 0:
+                    # Stream the finished request out NOW — the serving
+                    # loop's reply path; the grid keeps flying.
+                    on_retire(tokens[ci], SimResult(
+                        config=cfgs[ci], inst_ids=ids_list[ci],
+                        rounds=rounds_out[ci], decision=dec_out[ci]))
             owner_cfg[retire] = -1
             live = owner_cfg >= 0
             free = W - int(live.sum())
@@ -514,9 +666,14 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
         if progress is not None:
             progress(f"compaction segment {segments}: {W - free}/{W} live, "
                      f"{total - head} queued")
-        if head >= total:
-            if not live.any():
+        if feed is not None:
+            _ingest()  # arrivals during the dispatch join the queue
+        if head >= total and not live.any():
+            # Grid idle. Offline that is the end; a live feed parks here
+            # (blocking pull) until new work arrives or the feed closes.
+            if feed is None or not _ingest(block=True):
                 break
+        if head >= total:
             continue  # queue dry: drain the stragglers, no more refills
         if free >= W * policy.refill_threshold or not live.any():
             with _trace.span("compaction.refill", width=W,

@@ -328,13 +328,28 @@ def main(argv=None) -> int:
                         "--census ART` (per-dispatch wall vs per-program "
                         "flops/bytes), `programs census` (the "
                         "census-on-vs-off A/B artifact)")
+    sub.add_parser("serve",
+                   help="always-on consensus service (serve/server.py): "
+                        "stdlib-HTTP front end over continuous-batching "
+                        "fused lane grids, streamed schema-v1.5 replies, "
+                        "zero steady-state recompiles (all further options "
+                        "pass through)")
+    sub.add_parser("loadgen",
+                   help="seeded open-loop load generator for the service "
+                        "(tools/loadgen.py): Poisson arrivals over a "
+                        "heterogeneous population, emits the serving "
+                        "artifact with p50/p99 latency + sustained "
+                        "configs/sec + the zero-recompile pin")
 
     if argv is None:
         argv = sys.argv[1:]
     if argv and argv[0] in ("accept", "slack", "product", "ledger", "chaos",
-                            "compaction", "trace", "programs"):
+                            "compaction", "trace", "programs", "serve",
+                            "loadgen"):
+        from byzantinerandomizedconsensus_tpu.serve import server as serve_tool
         from byzantinerandomizedconsensus_tpu.tools import (
-            acceptance, bench_compaction, ledger, product, slack, soak)
+            acceptance, bench_compaction, ledger, loadgen, product, slack,
+            soak)
         from byzantinerandomizedconsensus_tpu.tools import (
             programs as programs_tool)
         from byzantinerandomizedconsensus_tpu.tools import trace as trace_tool
@@ -344,7 +359,8 @@ def main(argv=None) -> int:
         tool = {"accept": acceptance, "slack": slack,
                 "product": product, "ledger": ledger,
                 "compaction": bench_compaction, "trace": trace_tool,
-                "programs": programs_tool}[argv[0]]
+                "programs": programs_tool, "serve": serve_tool,
+                "loadgen": loadgen}[argv[0]]
         return tool.main(argv[1:])
     args = ap.parse_args(argv)
     if getattr(args, "backend", "").startswith("jax"):

@@ -60,6 +60,17 @@ from-the-future artifact instead of silently passing it. Same compatibility
 rule as v1.1–v1.3 otherwise: ``record_version`` stays 1, the revision is
 declarative, and block shapes are checked only when present.
 
+Schema v1.5 (round 14) adds the **serve** block (:func:`serve_block` — the
+consensus-as-a-service loop, serve/server.py + tools/loadgen.py): the
+arrival seed and admission policy of an open-loop serving run, request
+count, p50/p99 request latency (off the one quantile implementation,
+``metrics.percentiles``), sustained configs/sec, time-to-first-result, and
+``steady_state_compiles`` — the compile-cache delta after warm-up, whose
+pinned value 0 is the round's claim. Carried by ``artifacts/serve_r14.json``
+and any future serving artifact. Same compatibility rule as v1.1–v1.4:
+``record_version`` stays 1, the revision is declarative, and the block
+shape is checked only when present.
+
 tools/ledger.py consumes both this format and the legacy r1–r7 shapes;
 :func:`validate_record` is the schema check the tier-1 tests pin, and
 ``brc-tpu ledger --check`` (the regression sentinel) compares the committed
@@ -76,8 +87,9 @@ RECORD_VERSION = 1
 # Minor schema revisions: v1.1 (round 10) compile-cache / batch fields;
 # v1.2 (round 11) the compaction block; v1.3 (round 12) the trace block +
 # compile_wall_s in the compile-cache block; v1.4 (round 13) the programs
-# block + the unknown-revision validate_record check.
-RECORD_REVISION = 4
+# block + the unknown-revision validate_record check; v1.5 (round 14) the
+# serve block (open-loop serving latency/throughput + steady-state compiles).
+RECORD_REVISION = 5
 
 
 def env_fingerprint() -> dict:
@@ -314,6 +326,28 @@ def programs_block(source=None) -> dict | None:
         return None
 
 
+#: The fields a schema-v1.5 ``serve`` block must carry (the open-loop
+#: serving accounting of serve/server.py + tools/loadgen.py: who generated
+#: the traffic, how it was admitted, and what the service delivered).
+SERVE_BLOCK_KEYS = ("arrival_seed", "admission_policy", "requests",
+                    "latency_ms", "throughput_cps",
+                    "time_to_first_result_ms", "steady_state_compiles")
+
+
+def serve_block(stats: dict | None) -> dict | None:
+    """The schema-v1.5 ``serve`` block from a serving-run stats dict
+    (tools/loadgen.py / serve/server.py). None in, None out — a record
+    without the block stays a valid v1.x record. Latencies are milliseconds
+    (requests retire in the single-digit-ms to seconds range; seconds would
+    bury the p50 in decimals), throughput is configs/sec."""
+    if stats is None:
+        return None
+    return {k: stats.get(k) for k in
+            (SERVE_BLOCK_KEYS + ("warmup_compiles", "warmup_requests",
+                                 "duration_s", "population"))
+            if k in stats}
+
+
 def validate_record(doc: dict) -> list:
     """Schema check: returns a list of problems (empty = valid v1 record)."""
     problems = []
@@ -376,6 +410,19 @@ def validate_record(doc: dict) -> list:
                     if not isinstance(entry, dict) or "count" not in entry:
                         problems.append(
                             f"trace digest entry {kind!r} missing 'count'")
+    sv = doc.get("serve")
+    if sv is not None:
+        if not isinstance(sv, dict):
+            problems.append("serve block is not a dict")
+        else:
+            for key in SERVE_BLOCK_KEYS:
+                if key not in sv:
+                    problems.append(f"serve block missing {key!r}")
+            lat = sv.get("latency_ms")
+            if lat is not None and isinstance(lat, dict):
+                for q in ("p50", "p99"):
+                    if q not in lat:
+                        problems.append(f"serve latency_ms missing {q!r}")
     pg = doc.get("programs")
     if pg is not None:
         if not isinstance(pg, dict):

@@ -1,0 +1,8 @@
+"""Consensus-as-a-service (round 14): the always-on continuous-batching
+server over fused compacted lane grids. See serve/server.py for the
+architecture and docs/SERVING.md for the operator's view."""
+
+from byzantinerandomizedconsensus_tpu.serve.admission import (  # noqa: F401
+    admit, bucket_of)
+from byzantinerandomizedconsensus_tpu.serve.server import (  # noqa: F401
+    ConsensusServer, ServeRequest, serve_http)

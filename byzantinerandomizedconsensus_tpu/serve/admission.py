@@ -1,0 +1,72 @@
+"""Admission control for the consensus service (round 14).
+
+A request enters the server as a payload — a :class:`SimConfig`, or a plain
+dict of SimConfig field names (the HTTP front-end's JSON body). Admission is
+the one seam where it becomes trusted work:
+
+1. **validate** — the payload goes through the existing
+   ``SimConfig``/``validate()`` path, the same checks every CLI entry point
+   applies. Unknown fields and out-of-range values are rejected here, before
+   anything is queued.
+2. **bound** — the server pins a ``round_cap`` ceiling (the drain-segment
+   length of the steady-state lane grid, serve/server.py); a config whose
+   cap exceeds it would force a new drain program and break the
+   zero-steady-state-recompiles claim, so it is rejected at admission, not
+   discovered at dispatch.
+3. **bucket** — the admitted config maps to its fused shape bucket
+   (:class:`~byzantinerandomizedconsensus_tpu.backends.batch.FusedBucket`),
+   the key under which the server coalesces heterogeneous requests into one
+   compacted lane grid (``run_fused(compaction=...)``'s admission law).
+
+Every admitted request emits a ``serve.admit`` trace event
+(docs/OBSERVABILITY.md §3e) carrying the bucket label, so a live
+``brc-tpu trace follow`` shows what the admission map is doing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from byzantinerandomizedconsensus_tpu.backends.batch import FusedBucket
+from byzantinerandomizedconsensus_tpu.config import SimConfig
+from byzantinerandomizedconsensus_tpu.obs import trace as _trace
+
+#: The payload keys a dict request may carry — exactly the SimConfig fields.
+REQUEST_FIELDS = tuple(f.name for f in dataclasses.fields(SimConfig))
+
+
+def admit(payload, round_cap_ceiling: int | None = None) -> SimConfig:
+    """Validate a request payload into a :class:`SimConfig` or raise.
+
+    ``payload`` is a SimConfig or a dict of SimConfig fields. Raises
+    ``ValueError`` on unknown fields, invalid configs, or a ``round_cap``
+    above ``round_cap_ceiling`` (when given); ``TypeError`` on anything
+    else. Emits a ``serve.admit`` event on success.
+    """
+    if isinstance(payload, SimConfig):
+        cfg = payload
+    elif isinstance(payload, dict):
+        unknown = sorted(set(payload) - set(REQUEST_FIELDS))
+        if unknown:
+            raise ValueError(
+                f"unknown request field(s) {unknown}; "
+                f"a request carries SimConfig fields: {REQUEST_FIELDS}")
+        cfg = SimConfig(**payload)
+    else:
+        raise TypeError(
+            f"request payload is {type(payload).__name__}, "
+            "not a SimConfig or dict")
+    cfg.validate()
+    if round_cap_ceiling is not None and cfg.round_cap > round_cap_ceiling:
+        raise ValueError(
+            f"round_cap={cfg.round_cap} exceeds the service ceiling "
+            f"{round_cap_ceiling}; a longer cap would force a new drain "
+            "program (zero steady-state recompiles is a service guarantee)")
+    _trace.event("serve.admit", bucket=bucket_of(cfg).label(),
+                 instances=int(cfg.instances))
+    return cfg
+
+
+def bucket_of(cfg: SimConfig) -> FusedBucket:
+    """The fused shape bucket a request coalesces under (admission law)."""
+    return FusedBucket.of(cfg)

@@ -1,0 +1,408 @@
+"""Consensus-as-a-service: the always-on continuous-batching server.
+
+``brc-tpu serve`` turns the batch CLI's substrate — fused shape buckets
+(backends/batch.py), the decision-driven compacted lane grid
+(backends/compaction.py), the thread-safe ``CompileCache`` — into a
+long-running service:
+
+- **admission** (serve/admission.py) validates each request through the
+  existing ``SimConfig``/``validate()`` path and maps it to its
+  :class:`FusedBucket`;
+- a single **dispatcher thread** owns one active lane grid at a time. The
+  active bucket's :class:`~byzantinerandomizedconsensus_tpu.backends
+  .compaction.WorkFeed` is the continuous-batching seam: same-bucket
+  requests push straight into the feed and refill freed lanes mid-flight;
+  a request for a *different* bucket closes the feed, the grid drains its
+  stragglers (compiled drain program, no recompile), and the dispatcher
+  rotates to the next pending bucket FIFO;
+- each request's reply **streams back as it retires** (``on_retire``), not
+  at grid end: the reply is a schema-v1.5 run record (obs/record.py)
+  carrying the config provenance, per-instance rounds/decisions, and the
+  request latency;
+- the grid's programs are pinned by policy tier + the feed's ``round_cap``
+  ceiling, so after one warm-up pass per bucket the ``CompileCache``
+  compiles **nothing** at steady state — the round-14 artifact's claim
+  (tools/loadgen.py proves it; ``BRC_COMPILATION_CACHE`` additionally
+  persists the XLA programs across server restarts).
+
+Graceful shutdown (``shutdown(drain=True)``, also ``with`` exit): the stop
+flag closes the active feed, the grid drains in-flight lanes, and every
+pending bucket is dispatched to completion before the thread joins — no
+request is ever lost. ``drain=False`` fails queued-but-undispatched
+requests with a shutdown error instead (in-flight lanes still drain; the
+lane grid has no mid-segment abort).
+
+Trace spans (docs/OBSERVABILITY.md §3e): ``serve.request`` per submitted
+request (the live-follow heartbeat), ``serve.admit`` at admission,
+``serve.dispatch`` per bucket grid, ``serve.reply`` per streamed reply.
+
+The optional stdlib-HTTP front end (``serve_http`` / ``brc-tpu serve``)
+adds no dependencies: POST /submit (JSON SimConfig fields) → request id,
+GET /result/<id> → the reply record, POST /run → submit-and-wait,
+GET /stats and GET /healthz for monitoring.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Optional
+
+from byzantinerandomizedconsensus_tpu.backends import batch as _batch
+from byzantinerandomizedconsensus_tpu.backends import compaction as _compaction
+from byzantinerandomizedconsensus_tpu.obs import record as _record
+from byzantinerandomizedconsensus_tpu.obs import trace as _trace
+from byzantinerandomizedconsensus_tpu.serve import admission as _admission
+
+DEFAULT_ROUND_CAP_CEILING = 128
+
+
+class ServeRequest:
+    """One in-flight request: the admitted config, its timing, and the
+    reply record once the last instance retires. ``wait()`` blocks the
+    submitting thread until then."""
+
+    __slots__ = ("id", "cfg", "bucket", "t_submit", "t_reply", "result",
+                 "record", "error", "done")
+
+    def __init__(self, rid: str, cfg, bucket):
+        self.id = rid
+        self.cfg = cfg
+        self.bucket = bucket
+        self.t_submit = time.perf_counter()
+        self.t_reply: Optional[float] = None
+        self.result = None
+        self.record: Optional[dict] = None
+        self.error: Optional[str] = None
+        self.done = threading.Event()
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        if self.t_reply is None:
+            return None
+        return self.t_reply - self.t_submit
+
+    def wait(self, timeout: Optional[float] = None) -> dict:
+        """Block until the reply record is ready and return it. Raises
+        ``TimeoutError`` on timeout, ``RuntimeError`` if the request
+        failed (dispatch error or non-drain shutdown)."""
+        if not self.done.wait(timeout):
+            raise TimeoutError(f"request {self.id} not done after "
+                               f"{timeout}s")
+        if self.error is not None:
+            raise RuntimeError(f"request {self.id} failed: {self.error}")
+        return self.record
+
+
+class ConsensusServer:
+    """The in-process service. ``submit()`` is thread-safe; replies stream
+    through each request's ``wait()`` (and the optional ``on_reply``
+    callback, called from the dispatcher thread)."""
+
+    def __init__(self, backend: str = "jax", policy=None,
+                 round_cap_ceiling: int = DEFAULT_ROUND_CAP_CEILING,
+                 on_reply=None):
+        from byzantinerandomizedconsensus_tpu.backends.base import get_backend
+
+        self._backend = get_backend(backend)
+        self._backend_name = backend
+        self._policy = (policy or _compaction.CompactionPolicy(
+            width=64, segment=1)).validate()
+        self._ceiling = int(round_cap_ceiling)
+        self._on_reply = on_reply
+        self._cv = threading.Condition()
+        # bucket -> [ServeRequest] queued while another bucket holds the grid
+        self._pending: dict = {}
+        # (bucket, WorkFeed, [ServeRequest]) while a grid is resident
+        self._active = None
+        self._stop = False
+        self._drain_on_stop = True
+        self._counter = 0
+        self._submitted = 0
+        self._replied = 0
+        self._failed = 0
+        self._thread: Optional[threading.Thread] = None
+        # The persistent XLA compilation cache (BRC_COMPILATION_CACHE) keeps
+        # warm-up compiles across server restarts, not just across requests.
+        _batch.maybe_enable_cache_from_env()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ConsensusServer":
+        if self._thread is not None:
+            raise RuntimeError("server already started")
+        self._thread = threading.Thread(target=self._loop,
+                                        name="brc-serve-dispatch",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def __enter__(self) -> "ConsensusServer":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.shutdown(drain=True)
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = None) -> None:
+        """Stop the service. ``drain=True`` (the default, and the ``with``
+        semantics) dispatches every queued bucket to completion first — no
+        request is lost. ``drain=False`` fails queued-but-undispatched
+        requests; the active grid still drains its in-flight lanes."""
+        with self._cv:
+            self._stop = True
+            self._drain_on_stop = drain
+            if not drain:
+                for reqs in self._pending.values():
+                    for req in reqs:
+                        self._fail(req, "server shutdown before dispatch")
+                self._pending.clear()
+            if self._active is not None:
+                self._active[1].close()
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, payload) -> ServeRequest:
+        """Admit a request payload and queue it. Returns the
+        :class:`ServeRequest` handle; ``handle.wait()`` blocks for the
+        reply record. Raises on invalid payloads or a stopped server."""
+        cfg = _admission.admit(payload, round_cap_ceiling=self._ceiling)
+        bucket = _admission.bucket_of(cfg)
+        with self._cv:
+            if self._stop:
+                raise RuntimeError("server is shutting down")
+            self._counter += 1
+            req = ServeRequest(f"r{self._counter:06d}", cfg, bucket)
+            self._submitted += 1
+            _trace.event("serve.request", id=req.id, bucket=bucket.label(),
+                         instances=int(cfg.instances))
+            placed = False
+            if self._active is not None and self._active[0] == bucket:
+                try:
+                    self._active[1].push(cfg, token=req)
+                    self._active[2].append(req)
+                    placed = True
+                except RuntimeError:
+                    # the feed closed under us (rotation/shutdown race):
+                    # the request queues for the bucket's next grid
+                    placed = False
+            if not placed:
+                self._pending.setdefault(bucket, []).append(req)
+                if self._active is not None and self._active[0] != bucket:
+                    # rotation: the resident grid stops refilling, drains
+                    # its stragglers, and yields to this bucket
+                    self._active[1].close()
+            self._cv.notify_all()
+        return req
+
+    # -- dispatcher --------------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._stop and not self._pending:
+                    self._cv.wait()
+                if not self._pending:
+                    return  # stopped and drained
+                bucket = next(iter(self._pending))
+                reqs = self._pending.pop(bucket)
+                feed = _compaction.WorkFeed(round_cap_ceiling=self._ceiling)
+                # seed before the feed is visible to submitters: a rotation
+                # close cannot land mid-seed
+                for req in reqs:
+                    feed.push(req.cfg, token=req)
+                run_reqs = list(reqs)
+                self._active = (bucket, feed, run_reqs)
+                # keep the feed open only when this bucket is the sole
+                # claimant and the server is live — otherwise seed-and-drain
+                if self._stop or self._pending:
+                    feed.close()
+            try:
+                with _trace.span("serve.dispatch", bucket=bucket.label(),
+                                 seeded=len(reqs)):
+                    _compaction.run_bucket(
+                        self._backend, bucket, [], [], policy=self._policy,
+                        feed=feed, on_retire=self._retire)
+            except Exception as e:  # noqa: BLE001 — a grid failure must
+                # fail its requests, never kill the dispatcher
+                feed.close()
+                with self._cv:
+                    for req in run_reqs:
+                        if not req.done.is_set():
+                            self._fail(req, f"dispatch error: {e!r}")
+            with self._cv:
+                self._active = None
+                self._cv.notify_all()
+
+    def _retire(self, req: ServeRequest, result) -> None:
+        req.t_reply = time.perf_counter()
+        req.result = result
+        req.record = self._reply_record(req, result)
+        with self._cv:
+            self._replied += 1
+        _trace.event("serve.reply", id=req.id, bucket=req.bucket.label(),
+                     latency_s=round(req.latency_s, 6))
+        req.done.set()
+        if self._on_reply is not None:
+            self._on_reply(req)
+
+    def _fail(self, req: ServeRequest, why: str) -> None:
+        req.error = why
+        self._failed += 1
+        req.done.set()
+
+    def _reply_record(self, req: ServeRequest, result) -> dict:
+        """The schema-v1.5 reply document streamed back per request."""
+        doc = _record.new_record("serve_reply", config=req.cfg)
+        doc["request_id"] = req.id
+        doc["bucket"] = req.bucket.label()
+        doc["inst_ids"] = [int(i) for i in result.inst_ids]
+        doc["rounds"] = [int(r) for r in result.rounds]
+        doc["decision"] = [int(d) for d in result.decision]
+        doc["latency_s"] = round(req.latency_s, 6)
+        return doc
+
+    # -- monitoring --------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._cv:
+            active = self._active[0].label() if self._active else None
+            pending = {b.label(): len(v) for b, v in self._pending.items()}
+            out = {
+                "submitted": self._submitted,
+                "replied": self._replied,
+                "failed": self._failed,
+                "active_bucket": active,
+                "pending": pending,
+                "policy": self._policy.doc(),
+                "round_cap_ceiling": self._ceiling,
+            }
+        out["compile_cache"] = _batch.compile_cache(self._backend).stats()
+        return out
+
+    def compile_count(self) -> int:
+        """Compiles so far — the loadgen's zero-steady-state probe."""
+        return int(_batch.compile_cache(self._backend).stats()["compiles"])
+
+
+# -- stdlib HTTP front end -------------------------------------------------
+
+
+def serve_http(server: ConsensusServer, host: str = "127.0.0.1",
+               port: int = 8787):
+    """Wrap a started :class:`ConsensusServer` in a stdlib HTTP endpoint
+    (no new dependencies). Returns the ``ThreadingHTTPServer``; the caller
+    owns ``serve_forever``/``shutdown``."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    requests: dict = {}
+    lock = threading.Lock()
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet: the trace is the log
+            pass
+
+        def _reply(self, code: int, doc: dict) -> None:
+            body = json.dumps(doc).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _read_payload(self):
+            length = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(length) if length else b"{}"
+            return json.loads(raw.decode() or "{}")
+
+        def do_GET(self):  # noqa: N802 — stdlib handler name
+            if self.path == "/healthz":
+                return self._reply(200, {"ok": True})
+            if self.path == "/stats":
+                return self._reply(200, server.stats())
+            if self.path.startswith("/result/"):
+                rid = self.path[len("/result/"):]
+                with lock:
+                    req = requests.get(rid)
+                if req is None:
+                    return self._reply(404, {"error": f"unknown id {rid!r}"})
+                if not req.done.is_set():
+                    return self._reply(202, {"id": rid, "done": False})
+                if req.error is not None:
+                    return self._reply(500, {"id": rid, "error": req.error})
+                return self._reply(200, req.record)
+            return self._reply(404, {"error": f"unknown path {self.path!r}"})
+
+        def do_POST(self):  # noqa: N802 — stdlib handler name
+            if self.path not in ("/submit", "/run"):
+                return self._reply(404,
+                                   {"error": f"unknown path {self.path!r}"})
+            try:
+                payload = self._read_payload()
+                req = server.submit(payload)
+            except (ValueError, TypeError, json.JSONDecodeError) as e:
+                return self._reply(400, {"error": str(e)})
+            except RuntimeError as e:
+                return self._reply(503, {"error": str(e)})
+            with lock:
+                requests[req.id] = req
+            if self.path == "/submit":
+                return self._reply(200, {"id": req.id, "done": False})
+            try:
+                return self._reply(200, req.wait(timeout=300.0))
+            except Exception as e:  # timeout / failed dispatch
+                return self._reply(500, {"id": req.id, "error": str(e)})
+
+    return ThreadingHTTPServer((host, port), Handler)
+
+
+def main(argv=None) -> int:
+    """``brc-tpu serve`` — run the HTTP service until interrupted."""
+    import argparse
+
+    from byzantinerandomizedconsensus_tpu.utils import devices as _devices
+
+    ap = argparse.ArgumentParser(
+        prog="brc-tpu serve",
+        description="Always-on consensus service: continuous-batching over "
+                    "fused compacted lane grids, streamed schema-v1.5 "
+                    "replies, zero steady-state recompiles.")
+    ap.add_argument("--backend", default="jax",
+                    help="simulator backend (default jax)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8787)
+    ap.add_argument("--policy", default="width=64,segment=1",
+                    help="compaction policy spec (CompactionPolicy.parse)")
+    ap.add_argument("--round-cap-ceiling", type=int,
+                    default=DEFAULT_ROUND_CAP_CEILING,
+                    help="max admitted round_cap; pins the drain program")
+    ap.add_argument("--trace-dir", default=None,
+                    help="write a serve trace JSONL under this directory")
+    args = ap.parse_args(argv)
+
+    if args.trace_dir:
+        _trace.configure(out_dir=args.trace_dir, role="serve")
+    _devices.ensure_live_backend()
+    policy = _compaction.CompactionPolicy.parse(args.policy)
+    with ConsensusServer(backend=args.backend, policy=policy,
+                         round_cap_ceiling=args.round_cap_ceiling) as srv:
+        httpd = serve_http(srv, host=args.host, port=args.port)
+        print(f"brc-tpu serve: listening on http://{args.host}:{args.port} "
+              f"(policy {policy.doc()}, cap ceiling "
+              f"{args.round_cap_ceiling})")
+        try:
+            httpd.serve_forever()
+        except KeyboardInterrupt:
+            print("brc-tpu serve: draining and shutting down")
+        finally:
+            httpd.shutdown_requested = True
+            httpd.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

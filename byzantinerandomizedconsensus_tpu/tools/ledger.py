@@ -238,6 +238,29 @@ def _programs_rows_of(name: str, doc) -> list:
     return rows
 
 
+def _serve_rows_of(name: str, doc) -> list:
+    """Schema-v1.5 ``serve`` blocks of one artifact: (path, requests,
+    p50/p99 latency, throughput, time-to-first-result, steady-state
+    compiles) rows for the ledger's serve latency/throughput columns."""
+    from byzantinerandomizedconsensus_tpu.obs import record as _record
+
+    rows = []
+    for path, sv in _blocks_of(doc, "serve", _record.SERVE_BLOCK_KEYS):
+        lat = sv.get("latency_ms")
+        lat = lat if isinstance(lat, dict) else {}
+        rows.append({
+            "artifact": name,
+            "path": path,
+            "requests": sv.get("requests"),
+            "p50_ms": lat.get("p50"),
+            "p99_ms": lat.get("p99"),
+            "throughput_cps": sv.get("throughput_cps"),
+            "time_to_first_result_ms": sv.get("time_to_first_result_ms"),
+            "steady_state_compiles": sv.get("steady_state_compiles"),
+        })
+    return rows
+
+
 def sentinel_verdict(bench: dict, wall_chain: list,
                      programs_rows: list) -> dict:
     """The ``--check`` verdict: wall-chain regressions past
@@ -449,6 +472,12 @@ def build_ledger(root=None) -> dict:
     for name, doc in sorted(docs.items()):
         programs_rows.extend(_programs_rows_of(name, doc))
 
+    # ---- serve latency/throughput columns (schema v1.5, round 14): every
+    # committed artifact carrying an open-loop serving block.
+    serve_rows = []
+    for name, doc in sorted(docs.items()):
+        serve_rows.extend(_serve_rows_of(name, doc))
+
     from byzantinerandomizedconsensus_tpu.obs import record
 
     return {
@@ -462,6 +491,7 @@ def build_ledger(root=None) -> dict:
         "compaction_rows": compaction_rows,
         "trace_rows": trace_rows,
         "programs_rows": programs_rows,
+        "serve_rows": serve_rows,
         "bench_rounds": {str(r): bench[r] for r in rounds_seen},
         "wall_chain": chain,
         "device_chain": device_chain,
@@ -552,6 +582,18 @@ def format_report(doc: dict) -> str:
                 f"  {row['artifact']}: {row['key']} "
                 f"[{row['hash']}] flops {row['flops']}, "
                 f"bytes {row['bytes_accessed']}")
+    # Present only once an artifact carries the v1.5 serve block.
+    if doc.get("serve_rows"):
+        lines.append("serve latency/throughput columns (schema v1.5 — "
+                     "artifact[path]: requests p50/p99 cps ttfr "
+                     "steady-state compiles):")
+        for row in doc["serve_rows"]:
+            lines.append(
+                f"  {row['artifact']}[{row['path']}]: "
+                f"{row['requests']} requests, p50 {row['p50_ms']} ms, "
+                f"p99 {row['p99_ms']} ms, {row['throughput_cps']} cfg/s, "
+                f"ttfr {row['time_to_first_result_ms']} ms, "
+                f"{row['steady_state_compiles']} steady-state compiles")
     sent = doc.get("sentinel")
     if sent is not None:
         lines.append(

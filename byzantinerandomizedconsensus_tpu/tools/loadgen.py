@@ -1,0 +1,406 @@
+"""Open-loop load generator for the consensus service (round 14).
+
+Drives an in-process :class:`~byzantinerandomizedconsensus_tpu.serve.server
+.ConsensusServer` with a *seeded, reproducible* request stream and emits the
+round-14 serving artifact (``artifacts/serve_r14.json``): p50/p99 request
+latency (the one quantile implementation, ``metrics.percentiles``),
+sustained configs/sec, time-to-first-result, and the compile-cache delta
+proving **zero recompiles at steady state**.
+
+The stream is open-loop (arrivals do not wait for completions): seeded
+Poisson gaps (``rng.expovariate``) over a heterogeneous population —
+
+- ~50% **chaos-like schedules**: ``soak.random_config(rng, chaos=True)``,
+  the full semantic surface with the spec-§9 fault axis;
+- ~30% **keys-model validation traffic**: small-n, adversary-free keys
+  configs, the short interactive requests a validation consumer sends;
+- ~20% **fat-tailed adversarial shapes**: lying adversaries at the large
+  end of the soak range with heavy instance counts and the longest admitted
+  ``round_cap`` — the requests that stress lane recycling.
+
+Determinism pin (tests/test_loadgen.py): the stream is a pure function of
+``(GENERATOR_VERSION, seed, requests, rate)`` — two runs at the same seed
+produce byte-identical streams (``stream_digest``), and every served reply
+is bit-identical to the per-config offline path (the full differential runs
+inside this tool; a mismatch is a nonzero exit, never a footnote).
+
+Phases:
+
+1. **warm-up** — per distinct bucket in the stream, a burst sized to force
+   every steady-state program (init, segment, refill, drain) to compile,
+   chained bucket-to-bucket so each rotation's drain leg compiles too;
+2. **burst leg** — the whole population submitted at once: sustained
+   configs/sec at capacity (the number compared against the round-10
+   offline fused path);
+3. **open-loop leg** — the population re-submitted on the Poisson
+   schedule: per-request latency percentiles + time-to-first-result;
+4. **steady-state check** — the compile counter after phases 2–3 minus the
+   warm-up snapshot; the artifact pins it and the exit code enforces 0;
+5. **offline leg** — ``run_fused`` over the same population (best-of
+   walls), the round-10 comparison; then the per-config numpy
+   differential.
+
+The committed artifact::
+
+    python -m byzantinerandomizedconsensus_tpu.tools.loadgen \\
+        --requests 200 --seed 14 --rate 4 --trace \\
+        --out artifacts/serve_r14.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import hashlib
+import json
+import pathlib
+import random
+import sys
+import threading
+import time
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu.backends import compaction as _compaction
+from byzantinerandomizedconsensus_tpu.config import (
+    DELIVERY_KINDS, SimConfig)
+from byzantinerandomizedconsensus_tpu.obs import record
+from byzantinerandomizedconsensus_tpu.obs import trace as _trace
+from byzantinerandomizedconsensus_tpu.tools import soak
+from byzantinerandomizedconsensus_tpu.utils import metrics
+from byzantinerandomizedconsensus_tpu.utils.rounds import default_artifact
+
+# Bumped whenever the draw sequence below changes shape: a serving
+# artifact's request stream is reproducible only by
+# (generator_version, seed, requests, rate) together.
+GENERATOR_VERSION = 1
+
+#: The admitted round_cap ceiling (mirrors serve/server.py): every
+#: population draw stays at or under it by construction.
+ROUND_CAP_CEILING = 128
+
+_MIX = (("chaos", 0.5), ("keys", 0.3), ("fat_tail", 0.2))
+
+
+def _keys_config(rng: random.Random) -> SimConfig:
+    """Small-n keys-model validation traffic: adversary-free, short caps."""
+    protocol = rng.choice(("benor", "bracha"))
+    n = rng.randrange(4, 12)
+    fmax = soak._f_ceiling(protocol, "none", n)
+    return SimConfig(
+        protocol=protocol, n=n, f=rng.randrange(0, fmax + 1),
+        instances=rng.randrange(4, 17), adversary="none",
+        coin=rng.choice(("local", "shared")),
+        init=rng.choice(("random", "all0", "all1", "split")),
+        seed=rng.randrange(1 << 32),
+        round_cap=rng.choice((32, 64)), delivery="keys").validate()
+
+
+def _fat_tail_config(rng: random.Random) -> SimConfig:
+    """Lying adversaries, heavy instance counts, the longest admitted cap."""
+    n = rng.randrange(16, soak.MAX_SOAK_N + 1)
+    adversary = rng.choice(("byzantine", "adaptive", "adaptive_min"))
+    fmax = soak._f_ceiling("bracha", adversary, n)
+    return SimConfig(
+        protocol="bracha", n=n, f=rng.randrange(1, fmax + 1),
+        instances=rng.choice((32, 48, 64, 96, 128)), adversary=adversary,
+        coin=rng.choice(("local", "shared")),
+        init=rng.choice(("random", "all0", "all1", "split")),
+        seed=rng.randrange(1 << 32),
+        round_cap=ROUND_CAP_CEILING,
+        delivery=rng.choice(DELIVERY_KINDS)).validate()
+
+
+def request_stream(requests: int, seed: int, rate: float) -> list:
+    """The seeded open-loop request stream: ``[(arrival_s, SimConfig)]``.
+
+    A pure function of its arguments (plus GENERATOR_VERSION): one
+    ``random.Random(seed)`` drives both the Poisson gaps and the population
+    draws, so the stream reproduces byte-for-byte."""
+    rng = random.Random(seed)
+    t = 0.0
+    out = []
+    for _ in range(requests):
+        t += rng.expovariate(rate)
+        u = rng.random()
+        if u < _MIX[0][1]:
+            cfg = soak.random_config(rng, chaos=True)
+        elif u < _MIX[0][1] + _MIX[1][1]:
+            cfg = _keys_config(rng)
+        else:
+            cfg = _fat_tail_config(rng)
+        out.append((t, cfg))
+    return out
+
+
+def stream_digest(stream) -> str:
+    """sha256 over the canonical JSON of the stream — the byte-for-byte
+    determinism pin (arrival times AND configs)."""
+    doc = [[round(t, 9), dataclasses.asdict(cfg)] for t, cfg in stream]
+    blob = json.dumps(doc, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def _warm_bucket_config(bucket, seq: int) -> SimConfig:
+    """A representative config of ``bucket`` for the warm-up burst: enough
+    instances to overflow the grid width (forcing the refill program) and
+    the ceiling cap (so rotation closes catch live lanes → drain program)."""
+    n = min(7, bucket.n_pad)
+    return SimConfig(
+        protocol=bucket.protocol, n=n, f=1, instances=32,
+        adversary="none", coin="local", init="random", seed=1000 + seq,
+        round_cap=ROUND_CAP_CEILING, delivery=bucket.delivery).validate()
+
+
+def warm_up(server, buckets, burst: int = 6) -> list:
+    """Compile every steady-state program for every bucket: per bucket a
+    same-bucket burst (init + segment + refill), each next bucket's burst
+    rotating the previous grid closed mid-flight (drain). The final grid is
+    rotated closed by re-submitting the first bucket. Returns the handles
+    (caller waits)."""
+    handles = []
+    seq = 0
+    for bucket in buckets:
+        for _ in range(burst):
+            handles.append(server.submit(_warm_bucket_config(bucket, seq)))
+            seq += 1
+    if buckets:
+        # one more first-bucket request closes the last bucket's grid the
+        # same way the inter-bucket rotations did
+        handles.append(server.submit(_warm_bucket_config(buckets[0], seq)))
+    return handles
+
+
+def _latency_ms(handles) -> list:
+    return [h.latency_s * 1000.0 for h in handles]
+
+
+def _leg_metrics(handles, t0: float, t_first_reply, t_last_reply) -> dict:
+    lats = _latency_ms(handles)
+    p50, p99 = metrics.percentiles(lats, (50, 99))
+    span = (t_last_reply - t0) if t_last_reply else None
+    return {
+        "requests": len(handles),
+        "latency_ms": {"p50": round(p50, 3), "p99": round(p99, 3),
+                       "mean": round(float(np.mean(lats)), 3)},
+        "throughput_cps": (round(len(handles) / span, 3)
+                           if span and span > 0 else None),
+        "time_to_first_result_ms": (round((t_first_reply - t0) * 1000.0, 3)
+                                    if t_first_reply else None),
+        "duration_s": round(span, 3) if span else None,
+    }
+
+
+def _drive(server, stream, open_loop: bool) -> dict:
+    """Submit the stream (at its arrival schedule, or all at once) and wait
+    for every reply. Returns the leg metrics + the reply handles."""
+    t_first_reply = [None]
+    t_last_reply = [None]
+    lock = threading.Lock()
+
+    def on_done(_req):
+        now = time.perf_counter()
+        with lock:
+            if t_first_reply[0] is None:
+                t_first_reply[0] = now
+            t_last_reply[0] = now
+
+    server._on_reply = on_done
+    t0 = time.perf_counter()
+    handles = []
+    for arrival, cfg in stream:
+        if open_loop:
+            delay = t0 + arrival - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+        handles.append(server.submit(cfg))
+    for h in handles:
+        h.wait(timeout=1800.0)
+    server._on_reply = None
+    leg = _leg_metrics(handles, t0, t_first_reply[0], t_last_reply[0])
+    leg["mode"] = "open_loop" if open_loop else "burst"
+    return leg, handles
+
+
+def _offline_fused_leg(backend_name: str, cfgs, reps: int) -> dict:
+    """The round-10 comparison: the same population through the offline
+    batched ``run_fused`` path (grid barrier, no serving), best-of walls."""
+    from byzantinerandomizedconsensus_tpu.backends.base import get_backend
+
+    be = get_backend(backend_name)
+    walls = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        be.run_fused(cfgs)
+        walls.append(time.perf_counter() - t0)
+    best = min(walls)
+    return {"mode": "offline_run_fused", "reps": reps,
+            "walls_s": [round(w, 3) for w in walls],
+            "wall_s": round(best, 3),
+            "throughput_cps": round(len(cfgs) / best, 3)}
+
+
+def _differential(cfgs, handles) -> dict:
+    """Every served reply vs the per-config offline path (numpy backend),
+    bit-for-bit. Mismatches are counted, never swallowed."""
+    from byzantinerandomizedconsensus_tpu.backends.base import get_backend
+
+    be = get_backend("numpy")
+    mismatches = []
+    for cfg, h in zip(cfgs, handles):
+        ref = be.run(cfg)
+        if (h.record["rounds"] != [int(r) for r in ref.rounds]
+                or h.record["decision"] != [int(d) for d in ref.decision]):
+            mismatches.append({"request_id": h.id,
+                               "config": dataclasses.asdict(cfg)})
+    return {"backend": "numpy", "configs": len(cfgs),
+            "mismatches": len(mismatches), "detail": mismatches[:10]}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="brc-tpu loadgen",
+        description="Seeded open-loop load generator for brc-tpu serve: "
+                    "drives an in-process server and emits the serving "
+                    "artifact (latency percentiles, sustained configs/sec, "
+                    "zero steady-state recompiles).")
+    ap.add_argument("--requests", type=int, default=200)
+    ap.add_argument("--seed", type=int, default=14)
+    ap.add_argument("--rate", type=float, default=4.0,
+                    help="open-loop Poisson arrival rate, requests/sec")
+    ap.add_argument("--backend", default="jax")
+    ap.add_argument("--policy", default="width=64,segment=1",
+                    help="compaction policy spec (CompactionPolicy.parse)")
+    ap.add_argument("--reps", type=int, default=2,
+                    help="offline-leg timing repetitions (best-of)")
+    ap.add_argument("--out", default=None,
+                    help=f"artifact path (default {default_artifact('serve')})")
+    ap.add_argument("--trace", action="store_true",
+                    help="write the serve trace JSONL next to the artifact")
+    ap.add_argument("--no-offline", action="store_true",
+                    help="skip the offline run_fused comparison leg")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny fast run (CI): 24 requests, 1 rep")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        args.requests = min(args.requests, 24)
+        args.reps = 1
+
+    from byzantinerandomizedconsensus_tpu.serve.server import ConsensusServer
+    from byzantinerandomizedconsensus_tpu.utils import devices as _devices
+
+    out = pathlib.Path(args.out or default_artifact("serve"))
+    out.parent.mkdir(parents=True, exist_ok=True)
+    trace_path = out.with_suffix(".jsonl")
+    if args.trace:
+        _trace.configure(path=trace_path)
+
+    _devices.ensure_live_backend()
+    policy = _compaction.CompactionPolicy.parse(args.policy)
+    stream = request_stream(args.requests, args.seed, args.rate)
+    digest = stream_digest(stream)
+    cfgs = [cfg for _, cfg in stream]
+    buckets = []
+    for cfg in cfgs:
+        from byzantinerandomizedconsensus_tpu.serve import admission
+        b = admission.bucket_of(cfg)
+        if b not in buckets:
+            buckets.append(b)
+    print(f"loadgen: {args.requests} requests, seed {args.seed}, "
+          f"rate {args.rate}/s, {len(buckets)} fused buckets, "
+          f"digest {digest[:12]}…")
+
+    server = ConsensusServer(backend=args.backend, policy=policy,
+                             round_cap_ceiling=ROUND_CAP_CEILING)
+    with server:
+        t_warm0 = time.perf_counter()
+        warm_handles = warm_up(server, buckets)
+        for h in warm_handles:
+            h.wait(timeout=1800.0)
+        warm_s = time.perf_counter() - t_warm0
+        warmup_compiles = server.compile_count()
+        print(f"loadgen: warm-up {len(warm_handles)} requests, "
+              f"{warmup_compiles} compiles, {warm_s:.1f}s")
+
+        burst_leg, _burst_handles = _drive(server, stream, open_loop=False)
+        print(f"loadgen: burst leg {burst_leg['throughput_cps']} cfg/s "
+              f"(p50 {burst_leg['latency_ms']['p50']}ms)")
+
+        open_leg, open_handles = _drive(server, stream, open_loop=True)
+        print(f"loadgen: open-loop leg p50 {open_leg['latency_ms']['p50']}ms "
+              f"p99 {open_leg['latency_ms']['p99']}ms")
+
+        steady_compiles = server.compile_count() - warmup_compiles
+        server_stats = server.stats()
+
+    differential = _differential(cfgs, open_handles)
+    offline_leg = (None if args.no_offline
+                   else _offline_fused_leg(args.backend, cfgs, args.reps))
+
+    serve_stats = {
+        "arrival_seed": args.seed,
+        "admission_policy": {"mode": "fused-compaction",
+                             "policy": policy.doc(),
+                             "round_cap_ceiling": ROUND_CAP_CEILING},
+        "requests": args.requests,
+        "latency_ms": open_leg["latency_ms"],
+        "throughput_cps": burst_leg["throughput_cps"],
+        "time_to_first_result_ms": open_leg["time_to_first_result_ms"],
+        "steady_state_compiles": steady_compiles,
+        "warmup_compiles": warmup_compiles,
+        "warmup_requests": len(warm_handles),
+        "duration_s": open_leg["duration_s"],
+        "population": {"buckets": len(buckets),
+                       "mix": {k: w for k, w in _MIX}},
+    }
+
+    doc = {
+        **record.new_record(
+            "serve",
+            description="Open-loop serving run: seeded Poisson arrivals "
+                        "over a heterogeneous population through the "
+                        "continuous-batching consensus service."),
+        "generator_version": GENERATOR_VERSION,
+        "seed": args.seed,
+        "rate": args.rate,
+        "requests": args.requests,
+        "stream_digest": digest,
+        "serve": record.serve_block(serve_stats),
+        "legs": {"burst": burst_leg, "open_loop": open_leg,
+                 **({"offline_fused": offline_leg} if offline_leg else {})},
+        "differential": differential,
+        "server": {k: server_stats[k] for k in
+                   ("submitted", "replied", "failed", "policy",
+                    "round_cap_ceiling")},
+        "compile_cache": server_stats["compile_cache"],
+    }
+    if offline_leg:
+        doc["summary"] = {
+            "serve_vs_offline_cps": round(
+                burst_leg["throughput_cps"]
+                / offline_leg["throughput_cps"], 3),
+        }
+    if args.trace:
+        _trace.disable()
+        blk = record.trace_block(trace_path)
+        if blk is not None:
+            doc["trace"] = blk
+
+    problems = record.validate_record(doc)
+    if problems:
+        print(f"loadgen: INVALID RECORD: {problems}", file=sys.stderr)
+        return 3
+    out.write_text(json.dumps(doc, indent=1) + "\n")
+    print(f"loadgen: wrote {out}")
+    print(f"loadgen: steady-state compiles {steady_compiles}, "
+          f"differential mismatches {differential['mismatches']}")
+    if differential["mismatches"]:
+        return 1
+    if steady_compiles:
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

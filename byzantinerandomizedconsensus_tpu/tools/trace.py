@@ -139,6 +139,13 @@ def _follow_consume(state: dict, ev: dict) -> None:
         state["live"] = attrs.get("live")
     elif kind == "chaos.skip":
         state["skips"] += 1
+    elif kind == "serve.request":
+        # The server's liveness heartbeat: an always-on service has no
+        # done/total to converge on, but every admitted request proves the
+        # admission path is moving.
+        state["serve_requests"] += 1
+    elif kind == "serve.reply":
+        state["serve_replies"] += 1
 
 
 def _follow_render(state: dict) -> str:
@@ -153,6 +160,9 @@ def _follow_render(state: dict) -> str:
              f"compiles {state['compiles']}"]
     if state.get("queue") is not None:
         parts.append(f"queue {state['queue']} (live {state.get('live')})")
+    if state.get("serve_requests"):
+        parts.append(f"serve {state['serve_replies']}/"
+                     f"{state['serve_requests']} replied")
     return "[trace] " + " | ".join(parts)
 
 
@@ -165,7 +175,8 @@ def follow(trace_dir, interval: float = 2.0, once: bool = False,
     trace_dir = pathlib.Path(trace_dir)
     offsets: dict = {}
     state = {"events": 0, "compiles": 0, "skips": 0, "progress": None,
-             "queue": None, "live": None, "total": None}
+             "queue": None, "live": None, "total": None,
+             "serve_requests": 0, "serve_replies": 0}
     ticks = 0
     while True:
         # Per-worker files only: a post-run merged trace.jsonl duplicates

@@ -50,9 +50,10 @@ def test_span_kind_census_is_nontrivial_and_complete():
                      "compaction.segment", "compaction.drain",
                      "compaction.refill", "compact.run", "program.compile",
                      "chaos.start", "chaos.progress", "chaos.skip",
-                     "chaos.child.jax"):
+                     "chaos.child.jax", "serve.request", "serve.admit",
+                     "serve.dispatch", "serve.reply"):
         assert expected in kinds, (expected, sorted(kinds))
-    assert len(kinds) >= 20
+    assert len(kinds) >= 24
 
 
 def test_every_emitted_span_kind_is_documented():
@@ -90,6 +91,7 @@ def test_every_record_block_key_is_documented():
         "compaction": record.COMPACTION_BLOCK_KEYS,
         "trace": record.TRACE_BLOCK_KEYS,
         "programs": record.PROGRAMS_BLOCK_KEYS,
+        "serve": record.SERVE_BLOCK_KEYS,
         "counters": ("supported", "totals"),
     }
     missing = []

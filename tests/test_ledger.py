@@ -429,3 +429,47 @@ def test_census_includes_trace_artifact():
     report = ledger.format_report(doc)
     assert "trace-digest columns" in report
     assert "compile wall" in report
+
+
+def test_census_includes_serve_artifact():
+    """The round-14 serving artifact: scanned, parsed with zero errors, the
+    zero-steady-state-recompile pin and the full differential on the
+    record, and the schema-v1.5 serve latency/throughput columns
+    reconstructed by the ledger."""
+    import pathlib
+
+    from byzantinerandomizedconsensus_tpu.utils.rounds import repo_root
+
+    doc = ledger.build_ledger()
+    assert doc["parse_errors"] == []
+    rows = {r["artifact"]: r for r in doc["serve_rows"]}
+    assert "artifacts/serve_r14.json" in rows, \
+        "serve_r14.json must yield serve latency/throughput columns"
+    row = rows["artifacts/serve_r14.json"]
+    assert isinstance(row["requests"], int) and row["requests"] >= 200
+    assert row["p50_ms"] is not None and row["p50_ms"] > 0
+    assert row["p99_ms"] is not None and row["p99_ms"] >= row["p50_ms"]
+    assert row["throughput_cps"] > 0
+    assert row["steady_state_compiles"] == 0  # the round-14 claim
+
+    sv = json.loads(
+        (pathlib.Path(repo_root()) / "artifacts/serve_r14.json").read_text())
+    assert sv["kind"] == "serve"
+    assert record.validate_record(sv) == []
+    assert sv["record_revision"] >= 5  # schema v1.5
+    assert sv["differential"]["mismatches"] == 0
+    assert sv["differential"]["configs"] == sv["requests"]
+    assert sv["serve"]["steady_state_compiles"] == 0
+    assert sv["serve"]["warmup_compiles"] > 0  # warm-up did compile
+    assert sv["stream_digest"]  # the determinism pin rides the record
+
+    # The committed trace file stays well-formed next to the record.
+    from byzantinerandomizedconsensus_tpu.obs import trace as trace_mod
+
+    jsonl = pathlib.Path(repo_root()) / "artifacts/serve_r14.jsonl"
+    assert trace_mod.validate_file(jsonl) == []
+
+    # And the report renders the v1.5 columns.
+    report = ledger.format_report(doc)
+    assert "serve latency/throughput columns" in report
+    assert "steady-state compiles" in report

@@ -1,0 +1,68 @@
+"""Loadgen determinism pin (round-14 satellite, tier-1).
+
+The request stream must be a pure function of (generator_version, seed,
+requests, rate): two runs at the same seed reproduce the identical stream
+byte-for-byte (arrival times AND configs), and serving the stream returns
+results bit-identical to the offline batched path over the same configs.
+"""
+
+import dataclasses
+
+from byzantinerandomizedconsensus_tpu.backends.base import get_backend
+from byzantinerandomizedconsensus_tpu.backends.compaction import (
+    CompactionPolicy)
+from byzantinerandomizedconsensus_tpu.serve import admission
+from byzantinerandomizedconsensus_tpu.serve.server import ConsensusServer
+from byzantinerandomizedconsensus_tpu.tools import loadgen
+
+#: Pinned so the stream below stays 2 fused buckets (compile-light in CI);
+#: a generator change that moves it shows up as a digest change here.
+_SEED = 35
+
+
+def test_stream_reproduces_byte_for_byte():
+    a = loadgen.request_stream(40, seed=_SEED, rate=4.0)
+    b = loadgen.request_stream(40, seed=_SEED, rate=4.0)
+    assert loadgen.stream_digest(a) == loadgen.stream_digest(b)
+    assert [(t, dataclasses.asdict(c)) for t, c in a] == \
+        [(t, dataclasses.asdict(c)) for t, c in b]
+    # arrival times strictly increase (open-loop Poisson gaps)
+    times = [t for t, _ in a]
+    assert all(t1 > t0 for t0, t1 in zip(times, times[1:]))
+    # a different seed is a different stream
+    c = loadgen.request_stream(40, seed=_SEED + 1, rate=4.0)
+    assert loadgen.stream_digest(c) != loadgen.stream_digest(a)
+
+
+def test_stream_population_is_admissible():
+    """Every draw respects the service's admission bounds by construction:
+    validated configs, round_cap at or under the ceiling, the three
+    population modes all present at this size."""
+    stream = loadgen.request_stream(120, seed=7, rate=4.0)
+    fat, keys = 0, 0
+    for _, cfg in stream:
+        cfg.validate()
+        assert cfg.round_cap <= loadgen.ROUND_CAP_CEILING
+        if cfg.instances > 32:
+            fat += 1
+        if cfg.delivery == "keys" and cfg.adversary == "none":
+            keys += 1
+    assert fat > 0, "fat-tail shapes absent from the population"
+    assert keys > 0, "keys-model validation traffic absent"
+
+
+def test_served_results_bit_identical_to_offline_batched_path():
+    """The same configs, served (streamed, continuously batched) vs the
+    offline batched path (grid barrier, run_many over the shared compile
+    cache): per-instance rounds/decisions equal bit-for-bit."""
+    stream = loadgen.request_stream(6, seed=_SEED, rate=50.0)
+    cfgs = [c for _, c in stream]
+    assert len({admission.bucket_of(c) for c in cfgs}) == 2  # seed pin
+    policy = CompactionPolicy(width=8, segment=1)
+    with ConsensusServer(policy=policy) as srv:
+        handles = [srv.submit(c) for c in cfgs]
+        recs = [h.wait(timeout=600.0) for h in handles]
+    offline, _report = get_backend("jax").run_many(cfgs, compaction=policy)
+    for rec, ref in zip(recs, offline):
+        assert rec["rounds"] == [int(r) for r in ref.rounds]
+        assert rec["decision"] == [int(d) for d in ref.decision]

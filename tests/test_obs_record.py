@@ -52,13 +52,36 @@ def test_validate_record_rejects_unknown_revision():
                                            "record_revision": bad})), bad
     # Every revision this build knows — including the legacy implied-v1
     # absence — stays valid.
-    for ok in (None, 0, 1, 2, 3, record.RECORD_REVISION):
+    for ok in (None, 0, 1, 2, 3, 4, record.RECORD_REVISION):
         doc = record.new_record("x")
         if ok is None:
             doc.pop("record_revision")
         else:
             doc["record_revision"] = ok
         assert record.validate_record(doc) == [], ok
+
+
+def test_validate_record_checks_serve_block():
+    """Schema v1.5: a serve block missing its required keys (or its latency
+    percentiles) must fail by name; the loadgen's own block validates."""
+    bad = {**record.new_record("serve"), "serve": {"requests": 3}}
+    problems = record.validate_record(bad)
+    assert any("serve block missing 'arrival_seed'" in p for p in problems)
+    assert any("steady_state_compiles" in p for p in problems)
+    good_stats = {
+        "arrival_seed": 14, "admission_policy": {"mode": "fused-compaction"},
+        "requests": 3, "latency_ms": {"p50": 1.0, "p99": 2.0},
+        "throughput_cps": 10.0, "time_to_first_result_ms": 5.0,
+        "steady_state_compiles": 0}
+    good = {**record.new_record("serve"),
+            "serve": record.serve_block(good_stats)}
+    assert record.validate_record(good) == []
+    # half-built percentiles fail by name too
+    lame = {**good, "serve": {**record.serve_block(good_stats),
+                              "latency_ms": {"p50": 1.0}}}
+    assert any("serve latency_ms missing 'p99'" in p
+               for p in record.validate_record(lame))
+    assert record.serve_block(None) is None
 
 
 def test_timing_block_maps_suspect_to_error():
@@ -152,10 +175,12 @@ def test_schema_census_every_committed_artifact_validates():
         problems = record.validate_record(payload)
         assert problems == [], (p.name, problems)
         checked.append(p.name)
-    # The v1+ era census as committed (r8-r13: ledger_r8, chaos_r9,
-    # batch_r10, compaction_r11, BENCH_r11, trace_r12, programs_r13): an
-    # accidentally narrowed glob must not silently pass on near-zero
-    # coverage — and the v1.4 artifact must be in the checked set, so the
-    # unknown-revision check above provably ran against a revision-4 head.
-    assert len(checked) >= 6, checked
+    # The v1+ era census as committed (r8-r14: ledger_r8, chaos_r9,
+    # batch_r10, compaction_r11, BENCH_r11, trace_r12, programs_r13,
+    # serve_r14): an accidentally narrowed glob must not silently pass on
+    # near-zero coverage — and the v1.4/v1.5 artifacts must be in the
+    # checked set, so the unknown-revision and serve-block checks above
+    # provably ran against real revision-4/-5 heads.
+    assert len(checked) >= 7, checked
     assert "programs_r13.json" in checked, checked
+    assert "serve_r14.json" in checked, checked

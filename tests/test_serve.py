@@ -1,0 +1,221 @@
+"""Tier-1 serve smoke (round 14): the in-process consensus service.
+
+Pins the tentpole seams: admission → fused bucket → continuously-batched
+compacted lane grid → streamed schema-v1.5 reply records; graceful
+shutdown draining in-flight lanes (no lost requests); the thread-safe
+``CompileCache`` under concurrent access; and the serve trace kinds the
+follow heartbeat consumes.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from byzantinerandomizedconsensus_tpu.backends.base import get_backend
+from byzantinerandomizedconsensus_tpu.backends.batch import CompileCache
+from byzantinerandomizedconsensus_tpu.backends.compaction import (
+    CompactionPolicy, WorkFeed)
+from byzantinerandomizedconsensus_tpu.config import SimConfig
+from byzantinerandomizedconsensus_tpu.obs import record
+from byzantinerandomizedconsensus_tpu.obs import trace
+from byzantinerandomizedconsensus_tpu.serve import admission
+from byzantinerandomizedconsensus_tpu.serve.server import ConsensusServer
+
+#: Small lane grid: fast compiles, still exercises refill (instances > W).
+_POLICY = CompactionPolicy(width=8, segment=1)
+
+#: Mixed-shape batch: two fused buckets (protocol × delivery), heterogeneous
+#: n/f/instances/adversary/round_cap within them.
+_CFGS = [
+    SimConfig(protocol="benor", n=5, f=1, instances=6, seed=3,
+              round_cap=32),
+    SimConfig(protocol="benor", n=9, f=3, instances=12, seed=21,
+              round_cap=64, adversary="crash", init="split"),
+    SimConfig(protocol="bracha", n=7, f=2, instances=4, seed=9,
+              round_cap=32, delivery="urn"),
+    SimConfig(protocol="bracha", n=10, f=3, instances=9, seed=77,
+              round_cap=64, delivery="urn", adversary="byzantine"),
+]
+
+
+def test_serve_smoke_mixed_shapes_bit_identical():
+    """The round-trip: mixed-shape requests through the service, every
+    reply a valid schema-v1.5 record, bit-identical to the per-config
+    offline path, clean shutdown with nothing lost."""
+    with ConsensusServer(policy=_POLICY) as srv:
+        handles = [srv.submit(c) for c in _CFGS]
+        recs = [h.wait(timeout=600.0) for h in handles]
+        stats = srv.stats()
+    assert stats["submitted"] == len(_CFGS)
+    assert stats["replied"] == len(_CFGS)
+    assert stats["failed"] == 0
+
+    offline = get_backend("numpy")
+    for cfg, h, rec in zip(_CFGS, handles, recs):
+        assert record.validate_record(rec) == [], rec
+        assert rec["record_revision"] == record.RECORD_REVISION
+        assert rec["kind"] == "serve_reply"
+        assert rec["request_id"] == h.id
+        assert rec["config"]["n"] == cfg.n
+        assert rec["latency_s"] > 0
+        ref = offline.run(cfg)
+        assert rec["rounds"] == [int(r) for r in ref.rounds]
+        assert rec["decision"] == [int(d) for d in ref.decision]
+
+
+def test_serve_shutdown_drains_in_flight():
+    """A shutdown racing fresh submissions must drain every queued bucket:
+    all requests reply, none are lost or failed."""
+    srv = ConsensusServer(policy=_POLICY).start()
+    handles = [srv.submit(c) for c in _CFGS]
+    srv.shutdown(drain=True)  # immediately: lanes still in flight
+    for h in handles:
+        rec = h.wait(timeout=600.0)  # already done post-drain
+        assert rec is not None and h.error is None
+    stats = srv.stats()
+    assert stats["replied"] == len(_CFGS)
+    assert stats["failed"] == 0
+    with pytest.raises(RuntimeError, match="shutting down"):
+        srv.submit(_CFGS[0])
+
+
+def test_serve_no_drain_shutdown_fails_pending_by_name():
+    srv = ConsensusServer(policy=_POLICY).start()
+    srv.shutdown(drain=True)  # empty server: both paths must be clean
+    srv2 = ConsensusServer(policy=_POLICY)  # never started: queue only
+    req = srv2.submit(_CFGS[0])
+    srv2.shutdown(drain=False)
+    assert req.done.is_set() and req.error is not None
+    with pytest.raises(RuntimeError, match="shutdown before dispatch"):
+        req.wait(timeout=1.0)
+
+
+def test_admission_rejects_bad_requests():
+    with pytest.raises(ValueError, match="unknown request field"):
+        admission.admit({"n": 5, "banana": 1})
+    with pytest.raises(TypeError, match="not a SimConfig or dict"):
+        admission.admit(42)
+    with pytest.raises(ValueError, match="exceeds the service ceiling"):
+        admission.admit(SimConfig(n=4, f=1, round_cap=256),
+                        round_cap_ceiling=128)
+    with pytest.raises(ValueError):
+        admission.admit({"n": 4, "f": 3})  # resilience bound
+    cfg = admission.admit({"protocol": "bracha", "n": 7, "f": 2,
+                           "instances": 3, "round_cap": 64})
+    assert isinstance(cfg, SimConfig) and cfg.protocol == "bracha"
+    assert admission.bucket_of(cfg).protocol == "bracha"
+
+
+def test_serve_span_kinds_emitted():
+    """The §3e serve kinds ride every request: request + admit at intake,
+    one dispatch span per grid, one reply per retirement."""
+    tr = trace.configure()  # in-memory
+    try:
+        with ConsensusServer(policy=_POLICY) as srv:
+            srv.submit(_CFGS[0]).wait(timeout=600.0)
+        kinds = {e["kind"] for e in tr.events}
+    finally:
+        trace.disable()
+    for kind in ("serve.request", "serve.admit", "serve.dispatch",
+                 "serve.reply"):
+        assert kind in kinds, (kind, sorted(kinds))
+
+
+def test_trace_follow_treats_serve_request_as_heartbeat():
+    from byzantinerandomizedconsensus_tpu.tools import trace as trace_tool
+
+    state = {"events": 0, "compiles": 0, "skips": 0, "progress": None,
+             "queue": None, "live": None, "total": None,
+             "serve_requests": 0, "serve_replies": 0}
+    trace_tool._follow_consume(state, {"kind": "serve.request", "attrs": {}})
+    trace_tool._follow_consume(state, {"kind": "serve.request", "attrs": {}})
+    trace_tool._follow_consume(state, {"kind": "serve.reply", "attrs": {}})
+    assert state["serve_requests"] == 2 and state["serve_replies"] == 1
+    line = trace_tool._follow_render(state)
+    assert "serve 1/2 replied" in line
+
+
+def test_workfeed_contract():
+    feed = WorkFeed(round_cap_ceiling=64)
+    cfg = SimConfig(n=4, f=1, round_cap=32)
+    feed.push(cfg, token="a")
+    with pytest.raises(ValueError, match="exceeds the feed ceiling"):
+        feed.push(SimConfig(n=4, f=1, round_cap=128))
+    assert feed.pull() == [(cfg, None, "a")]
+    assert feed.pull() == []  # open + empty
+    feed.push(cfg, token="b")
+    feed.close()
+    with pytest.raises(RuntimeError, match="closed WorkFeed"):
+        feed.push(cfg)
+    # items pushed before close are still drained, THEN the None sentinel
+    assert feed.pull() == [(cfg, None, "b")]
+    assert feed.pull() is None
+    assert feed.pull(block=True) is None
+
+
+def test_compile_cache_concurrent_access():
+    """The round-14 thread-safety satellite: hammer one cache from many
+    threads — exactly one build per resident key, consistent counters, LRU
+    bound respected."""
+    cache = CompileCache(max_entries=8)
+    built = []
+    build_lock = threading.Lock()
+
+    def make_build(key):
+        def build():
+            with build_lock:
+                built.append(key)
+            return lambda x, _k=key: (x, _k)
+        return build
+
+    keys = [("bucket", i) for i in range(8)]
+    errors = []
+
+    def worker(seed):
+        rng = np.random.default_rng(seed)
+        try:
+            for _ in range(300):
+                k = keys[int(rng.integers(len(keys)))]
+                fn = cache.get(k, make_build(k))
+                out = fn(1)
+                assert out == (1, k)
+        except Exception as e:  # pragma: no cover - failure reporting
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(s,)) for s in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    stats = cache.stats()
+    # 8 keys, capacity 8: every key built exactly once, everything else hit
+    assert stats["compiles"] == 8 == len(built)
+    assert stats["evictions"] == 0
+    assert stats["hits"] == 8 * 300 - 8
+    assert len(cache) == 8
+
+
+def test_compile_cache_concurrent_eviction_consistency():
+    """Under capacity pressure the counters must stay coherent (compiles =
+    evictions + residents) even with racing threads."""
+    cache = CompileCache(max_entries=4)
+    keys = [("k", i) for i in range(12)]
+
+    def worker(seed):
+        rng = np.random.default_rng(seed)
+        for _ in range(200):
+            k = keys[int(rng.integers(len(keys)))]
+            fn = cache.get(k, lambda _k=k: (lambda: _k))
+            assert fn() == k
+
+    threads = [threading.Thread(target=worker, args=(s,)) for s in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stats = cache.stats()
+    assert len(cache) == 4
+    assert stats["compiles"] - stats["evictions"] == len(cache)
+    assert stats["compiles"] + stats["hits"] == 6 * 200
