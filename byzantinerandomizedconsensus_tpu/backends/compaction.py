@@ -176,6 +176,13 @@ class WorkFeed:
     def closed(self) -> bool:
         return self._closed
 
+    def pending(self) -> int:
+        """Configs pushed but not yet pulled into the grid — the queue-depth
+        probe the serving stats and the fleet dispatcher's steal heuristic
+        read (serve/server.py stats, serve/fleet.py)."""
+        with self._cv:
+            return len(self._items)
+
     def pull(self, block: bool = False):
         """Everything pushed since the last pull: a list of
         ``(cfg, ids, token)`` items, ``[]`` when nothing is pending, or

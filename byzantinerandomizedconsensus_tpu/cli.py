@@ -332,14 +332,19 @@ def main(argv=None) -> int:
                    help="always-on consensus service (serve/server.py): "
                         "stdlib-HTTP front end over continuous-batching "
                         "fused lane grids, streamed schema-v1.5 replies, "
-                        "zero steady-state recompiles (all further options "
-                        "pass through)")
+                        "zero steady-state recompiles; --workers N shards "
+                        "the service across subprocess workers with "
+                        "bucket-affine routing + work stealing "
+                        "(serve/fleet.py) (all further options pass "
+                        "through)")
     sub.add_parser("loadgen",
                    help="seeded open-loop load generator for the service "
                         "(tools/loadgen.py): Poisson arrivals over a "
                         "heterogeneous population, emits the serving "
                         "artifact with p50/p99 latency + sustained "
-                        "configs/sec + the zero-recompile pin")
+                        "configs/sec + the zero-recompile pin; --workers "
+                        "1,2,4 sweeps the fleet and pins the scaling "
+                        "curve (schema-v1.6 fleet block)")
 
     if argv is None:
         argv = sys.argv[1:]

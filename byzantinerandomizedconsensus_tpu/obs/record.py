@@ -71,6 +71,18 @@ and any future serving artifact. Same compatibility rule as v1.1–v1.4:
 ``record_version`` stays 1, the revision is declarative, and the block
 shape is checked only when present.
 
+Schema v1.6 (round 15) adds the **fleet** block (:func:`fleet_block` — the
+sharded multi-worker dispatcher, serve/fleet.py + ``tools/loadgen.py
+--workers``): worker count, the fleet-wide serving numbers (same latency /
+throughput discipline as the v1.5 serve block), the work-steal and
+failure-re-admission counters, and a ``per_worker`` row list carrying each
+worker's replies, steady-state compiles (the v1.5 pin now holds *per
+worker*), steals and throughput — the rows ``brc-tpu ledger`` renders as
+the fleet columns. Carried by ``artifacts/serve_fleet_r15.json`` and any
+future fleet-serving artifact. Same compatibility rule as v1.1–v1.5:
+``record_version`` stays 1, the revision is declarative, and the block
+shape is checked only when present.
+
 tools/ledger.py consumes both this format and the legacy r1–r7 shapes;
 :func:`validate_record` is the schema check the tier-1 tests pin, and
 ``brc-tpu ledger --check`` (the regression sentinel) compares the committed
@@ -88,8 +100,10 @@ RECORD_VERSION = 1
 # v1.2 (round 11) the compaction block; v1.3 (round 12) the trace block +
 # compile_wall_s in the compile-cache block; v1.4 (round 13) the programs
 # block + the unknown-revision validate_record check; v1.5 (round 14) the
-# serve block (open-loop serving latency/throughput + steady-state compiles).
-RECORD_REVISION = 5
+# serve block (open-loop serving latency/throughput + steady-state compiles);
+# v1.6 (round 15) the fleet block (multi-worker serving: per-worker compile/
+# steal/throughput rows behind the single admission path).
+RECORD_REVISION = 6
 
 
 def env_fingerprint() -> dict:
@@ -348,6 +362,30 @@ def serve_block(stats: dict | None) -> dict | None:
             if k in stats}
 
 
+#: The fields a schema-v1.6 ``fleet`` block must carry (the sharded
+#: multi-worker serving accounting of serve/fleet.py + ``loadgen
+#: --workers``: fleet-wide numbers plus the per-worker ledger rows).
+FLEET_BLOCK_KEYS = ("workers", "arrival_seed", "admission_policy",
+                    "requests", "latency_ms", "throughput_cps",
+                    "steady_state_compiles", "steals", "readmitted",
+                    "lost_workers", "per_worker")
+
+
+def fleet_block(stats: dict | None) -> dict | None:
+    """The schema-v1.6 ``fleet`` block from a fleet-serving stats dict
+    (serve/fleet.py / tools/loadgen.py). None in, None out — a record
+    without the block stays a valid v1.x record. ``steady_state_compiles``
+    is the fleet-wide sum; ``per_worker`` carries the per-worker split the
+    zero-recompile pin is enforced on (every row must be 0)."""
+    if stats is None:
+        return None
+    return {k: stats.get(k) for k in
+            (FLEET_BLOCK_KEYS + ("warmup_compiles", "duration_s",
+                                 "population", "fabric_latency_ms",
+                                 "rotation_cap", "placement"))
+            if k in stats}
+
+
 def validate_record(doc: dict) -> list:
     """Schema check: returns a list of problems (empty = valid v1 record)."""
     problems = []
@@ -423,6 +461,30 @@ def validate_record(doc: dict) -> list:
                 for q in ("p50", "p99"):
                     if q not in lat:
                         problems.append(f"serve latency_ms missing {q!r}")
+    fl = doc.get("fleet")
+    if fl is not None:
+        if not isinstance(fl, dict):
+            problems.append("fleet block is not a dict")
+        else:
+            for key in FLEET_BLOCK_KEYS:
+                if key not in fl:
+                    problems.append(f"fleet block missing {key!r}")
+            lat = fl.get("latency_ms")
+            if lat is not None and isinstance(lat, dict):
+                for q in ("p50", "p99"):
+                    if q not in lat:
+                        problems.append(f"fleet latency_ms missing {q!r}")
+            pw = fl.get("per_worker")
+            if pw is not None:
+                if not isinstance(pw, list):
+                    problems.append("fleet per_worker is not a list")
+                else:
+                    for i, row in enumerate(pw):
+                        if not isinstance(row, dict) or "worker" not in row \
+                                or "steady_state_compiles" not in row:
+                            problems.append(
+                                f"fleet per_worker row {i} missing "
+                                "'worker'/'steady_state_compiles'")
     pg = doc.get("programs")
     if pg is not None:
         if not isinstance(pg, dict):

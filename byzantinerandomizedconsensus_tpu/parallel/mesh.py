@@ -100,3 +100,37 @@ def hybrid_grid(devs: Sequence, n_model: int) -> np.ndarray:
     order = sorted(devs, key=lambda d: (getattr(d, "slice_index", 0),
                                         d.process_index, d.id))
     return np.asarray(order, dtype=object).reshape(-1, n_model)
+
+
+def fleet_placement(n_workers: int,
+                    devices: Optional[Sequence] = None) -> list:
+    """Worker → device placement for the fleet dispatcher (serve/fleet.py).
+
+    The round-15 fleet runs subprocess workers on one box; this is the seam
+    a multi-device session widens: with more devices than workers each
+    worker gets its own resident device (round-robin over the data axis —
+    grids are instance-parallel, so no collective ever crosses workers),
+    otherwise workers share and the placement says so (``shared: true`` —
+    on the 1-CPU-core box every worker shares cpu:0 and fleet scaling is a
+    fabric property, not a compute one; docs/SERVING.md §Fleet).
+
+    Pure layout logic: returns one dict per worker
+    (``worker / platform / device_id / device_kind / shared``), never
+    initializes a backend when ``devices`` is passed explicitly."""
+    if n_workers < 1:
+        raise ValueError(f"n_workers={n_workers} out of range (>= 1)")
+    devs = list(devices) if devices is not None else jax.devices()
+    if not devs:
+        raise ValueError("fleet placement needs at least one device")
+    shared = len(devs) < n_workers
+    out = []
+    for w in range(n_workers):
+        d = devs[w % len(devs)]
+        out.append({
+            "worker": w,
+            "platform": getattr(d, "platform", "unknown"),
+            "device_id": int(getattr(d, "id", w % len(devs))),
+            "device_kind": getattr(d, "device_kind", "unknown"),
+            "shared": bool(shared),
+        })
+    return out

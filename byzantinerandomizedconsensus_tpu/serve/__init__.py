@@ -1,8 +1,12 @@
-"""Consensus-as-a-service (round 14): the always-on continuous-batching
-server over fused compacted lane grids. See serve/server.py for the
-architecture and docs/SERVING.md for the operator's view."""
+"""Consensus-as-a-service (rounds 14-15): the always-on
+continuous-batching server over fused compacted lane grids, and the
+sharded fleet dispatcher that places N of them behind one front door.
+See serve/server.py and serve/fleet.py for the architecture and
+docs/SERVING.md for the operator's view."""
 
 from byzantinerandomizedconsensus_tpu.serve.admission import (  # noqa: F401
     admit, bucket_of)
+from byzantinerandomizedconsensus_tpu.serve.fleet import (  # noqa: F401
+    FleetRequest, FleetServer)
 from byzantinerandomizedconsensus_tpu.serve.server import (  # noqa: F401
     ConsensusServer, ServeRequest, serve_http)

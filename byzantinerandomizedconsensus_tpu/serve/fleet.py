@@ -1,0 +1,832 @@
+"""Fleet serving: the sharded multi-worker dispatcher (round 15).
+
+One front door, N resident lane grids. :class:`FleetServer` places N
+workers — each a full single-grid
+:class:`~byzantinerandomizedconsensus_tpu.serve.server.ConsensusServer`
+with its *own* backend instance, ``CompileCache``, and trace sink — and
+routes every admitted request to exactly one of them:
+
+- **admission** stays the single-server path (serve/admission.py →
+  ``SimConfig``/``validate()`` → :class:`FusedBucket`); the fleet adds a
+  routing layer, never a second request schema;
+- **bucket affinity**: the ``bucket → worker`` map is sticky, so repeat
+  traffic for a shape lands on the worker whose ``CompileCache`` (and
+  live ``WorkFeed``) is already warm — a same-bucket request joins that
+  worker's in-flight grid mid-rotation, exactly as on one server. New
+  buckets go to the least-loaded live worker, where load is lane-round
+  weight (``round_cap x instances`` summed over queued requests), not
+  request count — a fat-tailed bucket is worth dozens of quickies;
+- **work stealing**: each worker's parent-side queue is an ordered map of
+  pending bucket rotations. A worker that goes idle first pumps its own
+  *longest* rotation (LPT: chain length is bounded by the longest member
+  ``round_cap``, so dispatching long chains first keeps the end-game
+  straggler short); with nothing left it steals the longest pending
+  rotation from the peer with the heaviest stealable backlog, same
+  lane-round weight (whole rotations move, never slices of
+  one — the single-bucket-per-grid invariant is what keeps per-worker
+  program keys arrival-free, so the zero-steady-state-recompile pin holds
+  per worker even under stealing). Every reply re-pumps fully idle peers,
+  so a chunked backlog is continuously rebalanced, not only at the
+  instant a thief's own in-flight happens to empty;
+- **worker loss**: when a worker dies mid-stream (EOF on its protocol
+  pipe), its in-flight and queued rotations are re-admitted to the
+  survivors under the same fleet request ids — replies stay bit-identical
+  to the offline ``run_many(compaction=)`` oracle because identity and
+  math never touched the dead process's arrival timing.
+
+``mode="process"`` (the default) spawns ``serve/worker.py`` children with
+the chaos subprocess discipline from tools/soak.py — ready-or-timeout,
+exponential backoff (``CHAOS_BACKOFF_S * 2**attempt``), one respawn
+attempt — and per-worker ``BRC_TRACE`` JSONL sinks that round-12
+``trace.merge()`` folds into one fleet timeline. ``mode="thread"`` runs
+the same routing fabric over in-process servers (shared process-global
+caches; the fast tier-1 surface for routing/steal tests).
+
+Device placement goes through the ``parallel/mesh.fleet_placement`` seam:
+on this box every worker shares the host device (``shared: true``); a
+multi-device session gives each worker its own accelerator and the
+``--segment-latency-s`` fabric stub becomes a real device round-trip.
+
+Trace kinds (docs/OBSERVABILITY.md §3f, role ``fleet-coord``):
+``fleet.spawn``, ``fleet.backoff``, ``fleet.route``, ``fleet.dispatch``,
+``fleet.steal``, ``fleet.worker_lost``, ``fleet.readmit``,
+``fleet.shutdown``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Optional
+
+from byzantinerandomizedconsensus_tpu.backends import compaction as _compaction
+from byzantinerandomizedconsensus_tpu.obs import trace as _trace
+from byzantinerandomizedconsensus_tpu.serve import admission as _admission
+from byzantinerandomizedconsensus_tpu.serve.server import (
+    DEFAULT_ROUND_CAP_CEILING, ConsensusServer)
+from byzantinerandomizedconsensus_tpu.tools.soak import (
+    CHAOS_BACKOFF_S, CHAOS_TIMEOUT_S)
+
+_STATS_RPC_TIMEOUT_S = 30.0
+
+
+class FleetRequest:
+    """One fleet-level request: same wait/latency surface as
+    :class:`~byzantinerandomizedconsensus_tpu.serve.server.ServeRequest`,
+    but owned by the dispatcher — the id (``f000001``) survives routing,
+    stealing, and re-admission after a worker loss."""
+
+    __slots__ = ("id", "cfg", "bucket", "t_submit", "t_reply", "record",
+                 "error", "done")
+
+    def __init__(self, rid: str, cfg, bucket):
+        self.id = rid
+        self.cfg = cfg
+        self.bucket = bucket
+        self.t_submit = time.perf_counter()
+        self.t_reply: Optional[float] = None
+        self.record: Optional[dict] = None
+        self.error: Optional[str] = None
+        self.done = threading.Event()
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        if self.t_reply is None:
+            return None
+        return self.t_reply - self.t_submit
+
+    def wait(self, timeout: Optional[float] = None) -> dict:
+        if not self.done.wait(timeout):
+            raise TimeoutError(f"request {self.id} not done after "
+                               f"{timeout}s")
+        if self.error is not None:
+            raise RuntimeError(f"request {self.id} failed: {self.error}")
+        return self.record
+
+
+def _policy_spec(policy: "_compaction.CompactionPolicy") -> str:
+    """The argv spelling of a policy (CompactionPolicy.parse round-trip)."""
+    parts = []
+    if policy.width is not None:
+        parts.append(f"width={policy.width}")
+    parts.append(f"segment={policy.segment}")
+    parts.append(f"threshold={policy.refill_threshold}")
+    return ",".join(parts)
+
+
+class _WorkerBase:
+    """Parent-side bookkeeping for one worker. All mutable routing state
+    (``current_bucket`` / ``inflight`` / ``pending``) is owned by the
+    fleet's lock, never this object's threads."""
+
+    def __init__(self, fleet: "FleetServer", idx: int):
+        self.fleet = fleet
+        self.idx = idx
+        self.alive = False
+        self.pid: Optional[int] = None
+        # the bucket whose rotation this worker currently runs (the
+        # single-bucket-inflight invariant: every inflight req shares it)
+        self.current_bucket = None
+        self.inflight: dict = {}            # fleet id -> FleetRequest
+        self.pending: dict = {}             # bucket -> [FleetRequest], FIFO
+        # buckets queued by pin_worker (warm-up targeting): peers must not
+        # steal these — a stolen warm-up would warm the wrong cache
+        self.pinned: set = set()
+        self.replied = 0
+        self.steals = 0
+
+    def queued(self) -> int:
+        return len(self.inflight) + sum(len(v) for v in self.pending.values())
+
+    def load(self) -> int:
+        """Lane-round proxy for this worker's queued work: sum of
+        round_cap x instances over inflight + pending. Request count is a
+        poor balance key when the population has a fat tail — one
+        round_cap-ceiling request is worth dozens of quickies, and a
+        worker that is handed two fat-tailed buckets becomes the
+        whole-burst straggler even though its request count looks fair."""
+        total = sum(r.cfg.round_cap * r.cfg.instances
+                    for r in self.inflight.values())
+        for reqs in self.pending.values():
+            total += sum(r.cfg.round_cap * r.cfg.instances for r in reqs)
+        return total
+
+    # subclasses: start() / send(req) / live_stats() / request_shutdown()
+    # / finish_shutdown() / kill()
+
+
+class _ProcessWorker(_WorkerBase):
+    """A subprocess worker speaking the serve/worker.py JSON-lines
+    protocol, spawned with the chaos ladder (ready-or-timeout, backoff,
+    one respawn attempt)."""
+
+    def __init__(self, fleet: "FleetServer", idx: int):
+        super().__init__(fleet, idx)
+        self.proc: Optional[subprocess.Popen] = None
+        self._reader: Optional[threading.Thread] = None
+        self._wlock = threading.Lock()
+        self._ready = threading.Event()
+        self._bye = threading.Event()
+        self._expect_exit = False
+        self.final_stats: Optional[dict] = None
+        self._rpc_cv = threading.Condition()
+        self._rpc_out: dict = {}
+
+    # -- spawn ladder ------------------------------------------------------
+
+    def start(self) -> None:
+        f = self.fleet
+        argv = [sys.executable, "-m",
+                "byzantinerandomizedconsensus_tpu.serve.worker",
+                "--index", str(self.idx),
+                "--backend", f._backend_name,
+                "--policy", _policy_spec(f._policy),
+                "--round-cap-ceiling", str(f._ceiling)]
+        if f._segment_latency_s > 0:
+            argv += ["--segment-latency-s", str(f._segment_latency_s)]
+        if f.placement is not None:
+            argv += ["--placement", json.dumps(f.placement[self.idx])]
+        env = dict(os.environ)
+        if f._trace_dir is not None:
+            env[_trace.TRACE_ENV] = str(f._trace_dir)
+        attempts = 1 + f._spawn_retries
+        for attempt in range(attempts):
+            self._ready.clear()
+            self.proc = subprocess.Popen(
+                argv, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                env=env, text=True, bufsize=1)
+            self._reader = threading.Thread(
+                target=self._read_loop, name=f"fleet-w{self.idx}-reader",
+                daemon=True)
+            self._reader.start()
+            if self._ready.wait(f._spawn_timeout_s):
+                self.alive = True
+                self.pid = self.proc.pid
+                _trace.event("fleet.spawn", worker=self.idx, pid=self.pid,
+                             attempt=attempt)
+                return
+            # ready never came: kill, back off, retry once — the chaos
+            # spawn discipline (tools/soak.py)
+            self.proc.kill()
+            self.proc.wait()
+            self._reader.join(timeout=5.0)
+            if attempt + 1 < attempts:
+                delay = f._backoff_s * (2 ** attempt)
+                _trace.event("fleet.backoff", worker=self.idx,
+                             attempt=attempt, delay_s=delay)
+                time.sleep(delay)
+        raise RuntimeError(
+            f"fleet worker {self.idx} failed to become ready after "
+            f"{attempts} attempt(s) ({f._spawn_timeout_s:.0f}s timeout)")
+
+    # -- protocol ----------------------------------------------------------
+
+    def _emit(self, doc: dict) -> bool:
+        proc = self.proc
+        if proc is None or proc.stdin is None:
+            return False
+        try:
+            with self._wlock:
+                proc.stdin.write(json.dumps(doc, separators=(",", ":"))
+                                 + "\n")
+                proc.stdin.flush()
+            return True
+        except (OSError, ValueError):
+            return False
+
+    def send(self, req: FleetRequest) -> None:
+        # a dead pipe surfaces through the reader's EOF → _worker_lost
+        # re-admits this request with everything else in flight here
+        self._emit({"op": "submit", "id": req.id,
+                    "cfg": dataclasses.asdict(req.cfg)})
+
+    def _read_loop(self) -> None:
+        proc = self.proc
+        for line in proc.stdout:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                msg = json.loads(line)
+            except ValueError:
+                continue
+            op = msg.get("op")
+            if op == "ready":
+                self.pid = msg.get("pid")
+                self._ready.set()
+            elif op == "reply":
+                self.fleet._resolve(self, msg.get("id"),
+                                    record=msg.get("record"))
+            elif op == "fail":
+                self.fleet._resolve(self, msg.get("id"),
+                                    error=str(msg.get("error")))
+            elif op == "stats":
+                with self._rpc_cv:
+                    self._rpc_out[msg.get("rpc")] = msg.get("stats")
+                    self._rpc_cv.notify_all()
+            elif op == "bye":
+                self.final_stats = msg.get("stats")
+                self._expect_exit = True
+                self._bye.set()
+        proc.stdout.close()
+        if not self._expect_exit:
+            self.fleet._worker_lost(self)
+
+    def live_stats(self) -> Optional[dict]:
+        """Blocking stats RPC to the child (None when dead/unresponsive —
+        after a graceful shutdown the bye-frame snapshot answers instead)."""
+        if not self.alive:
+            return self.final_stats
+        rpc = self.fleet._next_rpc()
+        if not self._emit({"op": "stats", "rpc": rpc}):
+            return self.final_stats
+        deadline = time.monotonic() + _STATS_RPC_TIMEOUT_S
+        with self._rpc_cv:
+            while rpc not in self._rpc_out:
+                left = deadline - time.monotonic()
+                if left <= 0 or not self.alive:
+                    return self._rpc_out.pop(rpc, None) or self.final_stats
+                self._rpc_cv.wait(left)
+            return self._rpc_out.pop(rpc)
+
+    # -- teardown ----------------------------------------------------------
+
+    def request_shutdown(self) -> None:
+        self._expect_exit = True
+        self._emit({"op": "shutdown"})
+
+    def finish_shutdown(self, timeout: float = CHAOS_TIMEOUT_S) -> None:
+        if self.proc is None:
+            return
+        self._bye.wait(timeout)
+        try:
+            if self.proc.stdin is not None:
+                self.proc.stdin.close()
+        except OSError:
+            pass
+        try:
+            self.proc.wait(timeout=10.0)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait()
+        if self._reader is not None:
+            self._reader.join(timeout=5.0)
+        self.alive = False
+
+    def kill(self) -> None:
+        """Hard-kill the child (the worker-failure tests' crash lever);
+        the reader's EOF then drives re-admission."""
+        if self.proc is not None:
+            self.proc.kill()
+
+
+class _ThreadWorker(_WorkerBase):
+    """An in-process worker: the same routing fabric over a plain
+    :class:`ConsensusServer` sharing this process's backend and caches.
+    Fast (no spawn, no JSON pipe) — the tier-1 routing/steal surface."""
+
+    def __init__(self, fleet: "FleetServer", idx: int):
+        super().__init__(fleet, idx)
+        self.inner: Optional[ConsensusServer] = None
+        self._ids: dict = {}                # inner id -> fleet id
+        self._ids_cv = threading.Condition()
+        self.final_stats: Optional[dict] = None
+
+    def start(self) -> None:
+        f = self.fleet
+        hook = None
+        if f._segment_latency_s > 0:
+            lat = f._segment_latency_s
+
+            def hook(_msg, _sleep=time.sleep, _lat=lat):
+                _sleep(_lat)
+
+        self.inner = ConsensusServer(
+            backend=f._backend_name, policy=f._policy,
+            round_cap_ceiling=f._ceiling, on_reply=self._on_inner_reply,
+            segment_hook=hook).start()
+        self.alive = True
+        self.pid = os.getpid()
+        _trace.event("fleet.spawn", worker=self.idx, pid=self.pid,
+                     attempt=0, mode="thread")
+
+    def send(self, req: FleetRequest) -> None:
+        try:
+            handle = self.inner.submit(dataclasses.asdict(req.cfg))
+        except Exception as e:  # noqa: BLE001 — surface as a request fail
+            threading.Thread(target=self.fleet._resolve,
+                             args=(self, req.id),
+                             kwargs={"error": f"submit error: {e}"},
+                             daemon=True).start()
+            return
+        with self._ids_cv:
+            self._ids[handle.id] = req.id
+            self._ids_cv.notify_all()
+        # inner failures (dispatch errors) set the handle without a reply
+        # callback; a per-request waiter forwards them
+        threading.Thread(target=self._watch, args=(req.id, handle),
+                         daemon=True).start()
+
+    def _on_inner_reply(self, inner_req) -> None:
+        with self._ids_cv:
+            while inner_req.id not in self._ids:
+                self._ids_cv.wait()
+            fid = self._ids.pop(inner_req.id)
+        rec = dict(inner_req.record)
+        rec["request_id"] = fid
+        self.fleet._resolve(self, fid, record=rec)
+
+    def _watch(self, fid: str, handle) -> None:
+        handle.done.wait()
+        if handle.error is not None:
+            self.fleet._resolve(self, fid, error=handle.error)
+
+    def live_stats(self) -> Optional[dict]:
+        if self.inner is None:
+            return self.final_stats
+        st = self.inner.stats()
+        st["worker"] = self.idx
+        st["pid"] = self.pid
+        return st
+
+    def request_shutdown(self) -> None:
+        pass
+
+    def finish_shutdown(self, timeout: float = CHAOS_TIMEOUT_S) -> None:
+        if self.inner is not None:
+            self.inner.shutdown(drain=True, timeout=timeout)
+            self.final_stats = self.live_stats()
+            self.inner = None
+        self.alive = False
+
+    def kill(self) -> None:
+        raise RuntimeError("thread-mode workers cannot be killed; use "
+                           "mode='process' for failure injection")
+
+
+class FleetServer:
+    """The sharded dispatcher. Duck-types :class:`ConsensusServer`'s
+    service surface (``submit`` / ``stats`` / ``_on_reply`` / context
+    manager), so ``serve_http`` and the loadgen driver run unchanged
+    behind it."""
+
+    def __init__(self, workers: int = 2, mode: str = "process",
+                 backend: str = "jax", policy=None,
+                 round_cap_ceiling: int = DEFAULT_ROUND_CAP_CEILING,
+                 trace_dir=None, on_reply=None,
+                 segment_latency_s: float = 0.0,
+                 spawn_timeout_s: float = CHAOS_TIMEOUT_S,
+                 spawn_retries: int = 1,
+                 backoff_s: float = CHAOS_BACKOFF_S,
+                 rotation_cap: Optional[int] = None):
+        if workers < 1:
+            raise ValueError(f"workers={workers} out of range (>= 1)")
+        if mode not in ("process", "thread"):
+            raise ValueError(f"mode={mode!r} not in ('process', 'thread')")
+        if rotation_cap is not None and rotation_cap < 1:
+            raise ValueError(f"rotation_cap={rotation_cap} out of range "
+                             "(>= 1, or None for unbounded)")
+        self._n_workers = int(workers)
+        self._mode = mode
+        self._backend_name = backend
+        self._policy = (policy or _compaction.CompactionPolicy(
+            width=64, segment=1)).validate()
+        self._ceiling = int(round_cap_ceiling)
+        self._trace_dir = trace_dir
+        self._on_reply = on_reply
+        self._segment_latency_s = float(segment_latency_s)
+        self._spawn_timeout_s = float(spawn_timeout_s)
+        self._spawn_retries = int(spawn_retries)
+        self._backoff_s = float(backoff_s)
+        # Work-sharing granularity: max *instance-lanes* resident per
+        # rotation. None = round-14 semantics (a bucket's whole queue is
+        # one rotation). A rotation is indivisible once resident, and its
+        # segment chain is ~round_cap × ceil(lanes / grid width) — so a
+        # request-count bound does NOT bound the chain; a lane budget of
+        # one grid wave (cap = policy width) pins it at <= round_cap
+        # segments. Without any cap the heaviest bucket is one
+        # indivisible unit and bounds fleet speedup at
+        # 1/its-weight-share regardless of worker count
+        # (docs/SERVING.md §Fleet).
+        self._rotation_cap = rotation_cap
+        self._cv = threading.Condition()
+        self._workers: list = []
+        self._where: dict = {}          # bucket -> worker (sticky affinity)
+        self._requests: list = []
+        self._counter = 0
+        self._rpc_counter = 0
+        self._submitted = 0
+        self._replied = 0
+        self._failed = 0
+        self._steals = 0
+        self._readmitted = 0
+        self._lost_workers = 0
+        self._stop = False
+        self._started = False
+        self.placement: Optional[list] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "FleetServer":
+        if self._started:
+            raise RuntimeError("fleet already started")
+        self._started = True
+        try:
+            from byzantinerandomizedconsensus_tpu.parallel.mesh import (
+                fleet_placement)
+
+            self.placement = fleet_placement(self._n_workers)
+        except Exception:  # noqa: BLE001 — placement is advisory metadata
+            self.placement = None
+        cls = _ProcessWorker if self._mode == "process" else _ThreadWorker
+        for idx in range(self._n_workers):
+            w = cls(self, idx)
+            w.start()
+            self._workers.append(w)
+        return self
+
+    def __enter__(self) -> "FleetServer":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.shutdown(drain=True)
+
+    def _next_rpc(self) -> int:
+        with self._cv:
+            self._rpc_counter += 1
+            return self._rpc_counter
+
+    # -- submission & routing ----------------------------------------------
+
+    def submit(self, payload, pin_worker: Optional[int] = None
+               ) -> FleetRequest:
+        """Admit a payload and route it. ``pin_worker`` bypasses affinity
+        routing (the warm-up seam: the loadgen warms every bucket on every
+        worker before measuring)."""
+        cfg = _admission.admit(payload, round_cap_ceiling=self._ceiling)
+        bucket = _admission.bucket_of(cfg)
+        with self._cv:
+            if self._stop:
+                raise RuntimeError("fleet is shutting down")
+            if not self._started:
+                raise RuntimeError("fleet not started")
+            self._counter += 1
+            req = FleetRequest(f"f{self._counter:06d}", cfg, bucket)
+            self._requests.append(req)
+            self._submitted += 1
+            self._route_locked(req, pin_worker=pin_worker)
+        return req
+
+    def _route_locked(self, req: FleetRequest,
+                      pin_worker: Optional[int] = None) -> None:
+        alive = [w for w in self._workers if w.alive]
+        if not alive:
+            self._fail_locked(req, "no live fleet workers")
+            return
+        affinity = False
+        if pin_worker is not None:
+            w = self._workers[pin_worker]
+            if not w.alive:
+                raise RuntimeError(f"pinned worker {pin_worker} is dead")
+        else:
+            w = self._where.get(req.bucket)
+            affinity = w is not None and w.alive
+            if not affinity:
+                # new bucket: least-loaded live worker by lane-round
+                # weight (see Worker.load), ties to lowest idx — counting
+                # requests instead once parked both fat-tailed buckets of
+                # a burst on the same worker
+                w = min(alive, key=lambda o: (o.load(), o.queued(), o.idx))
+                self._where[req.bucket] = w
+        _trace.event("fleet.route", id=req.id, worker=w.idx,
+                     bucket=req.bucket.label(), affinity=affinity)
+        cap = self._rotation_cap
+        if w.current_bucket == req.bucket and (
+                cap is None or pin_worker is not None
+                or sum(r.cfg.instances for r in w.inflight.values())
+                + req.cfg.instances <= cap):
+            # mid-flight join of the live rotation (the worker's inner
+            # server pushes into its active WorkFeed); a rotation at its
+            # lane budget queues instead, so the overflow stays
+            # stealable by idle peers. Pinned warm-up traffic bypasses
+            # the budget (and dispatch chunking below): the warm-up must
+            # overfill the grid so compact-refill compiles before
+            # anything is measured — the cap is a steady-state
+            # scheduling knob, not a warm-up one
+            w.inflight[req.id] = req
+            w.send(req)
+        elif w.current_bucket is None and not w.pending:
+            self._dispatch_locked(w, req.bucket, [req])
+        else:
+            w.pending.setdefault(req.bucket, []).append(req)
+            if pin_worker is not None:
+                w.pinned.add(req.bucket)
+                return
+            # idle capacity must not watch rotations queue: hand any fully
+            # idle peer a pump pass now (it will steal this — or an older —
+            # pending rotation), not only on the reply path
+            idle = next((o for o in self._workers
+                         if o.alive and o is not w and not o.inflight
+                         and o.current_bucket is None and not o.pending),
+                        None)
+            if idle is not None:
+                self._pump_locked(idle)
+
+    def _dispatch_locked(self, w, bucket, reqs) -> None:
+        cap = self._rotation_cap
+        if cap is not None and bucket not in w.pinned:
+            # chunk the rotation at the lane budget, longest chains
+            # first (round_cap varies within a bucket — it is traced
+            # lane data, not part of the bucket key — and a chunk
+            # dispatched last with the bucket's one fat member becomes
+            # the whole burst's straggler). Stable sort: arrival order
+            # breaks ties, and scheduling order never enters the PRF
+            # draw coordinates. Always take at least one request — a
+            # single request is never split. The tail stays pending
+            # (and stealable — unless pinned warm-up).
+            reqs = sorted(reqs, key=lambda r: -r.cfg.round_cap)
+            lanes = 0
+            take = len(reqs)
+            for i, r in enumerate(reqs):
+                if i and lanes + r.cfg.instances > cap:
+                    take = i
+                    break
+                lanes += r.cfg.instances
+            if take < len(reqs):
+                w.pending.setdefault(bucket, []).extend(reqs[take:])
+                reqs = reqs[:take]
+        w.current_bucket = bucket
+        for req in reqs:
+            w.inflight[req.id] = req
+        _trace.event("fleet.dispatch", worker=w.idx, bucket=bucket.label(),
+                     requests=len(reqs))
+        for req in reqs:
+            w.send(req)
+
+    # -- reply / steal path ------------------------------------------------
+
+    def _resolve(self, w, fid: str, record: Optional[dict] = None,
+                 error: Optional[str] = None) -> None:
+        """A worker answered (reply or fail) for fleet request ``fid``;
+        called from reader / inner-dispatcher threads."""
+        with self._cv:
+            req = w.inflight.pop(fid, None)
+            if req is None:
+                return  # stale: already re-admitted elsewhere
+            if record is not None:
+                req.t_reply = time.perf_counter()
+                req.record = record
+                self._replied += 1
+                w.replied += 1
+            else:
+                req.error = error or "worker error"
+                self._failed += 1
+            if not w.inflight:
+                w.current_bucket = None
+                self._pump_locked(w)
+            # Every reply is a steal opportunity. A fully idle peer only
+            # attempts a steal at the instant its own inflight empties; if
+            # the victim's backlog was all in flight at that moment, the
+            # peer would idle forever while the victim serially drains its
+            # chunked rotations.
+            for o in self._workers:
+                if (o.alive and o is not w and not o.inflight
+                        and o.current_bucket is None):
+                    self._pump_locked(o)
+            cb = self._on_reply
+            self._cv.notify_all()
+        req.done.set()
+        if record is not None and cb is not None:
+            cb(req)
+
+    @staticmethod
+    def _chain_locked(reqs) -> tuple:
+        """LPT weight of a pending rotation: its segment chain is bounded
+        by the longest member round_cap (a rotation is indivisible once
+        resident, so dispatching long chains first keeps the end-game
+        straggler short — classic longest-processing-time packing)."""
+        return (max(r.cfg.round_cap for r in reqs),
+                sum(r.cfg.instances for r in reqs))
+
+    def _pump_locked(self, w) -> None:
+        """An idle worker takes its own longest pending rotation, else
+        steals the longest rotation from the live peer with the heaviest
+        stealable backlog (lane-round weight, see Worker.load)."""
+        if not w.alive:
+            return
+        if w.pending:
+            bucket = max(w.pending,
+                         key=lambda b: self._chain_locked(w.pending[b]))
+            reqs = w.pending.pop(bucket)
+            self._dispatch_locked(w, bucket, reqs)
+            if bucket not in w.pending:
+                # fully drained (no chunked tail left behind): the
+                # warm-up pin has served its purpose
+                w.pinned.discard(bucket)
+            return
+
+        def stealable(o):
+            return [b for b in o.pending if b not in o.pinned]
+
+        victims = [o for o in self._workers
+                   if o.alive and o is not w and stealable(o)]
+        if not victims:
+            return
+
+        def backlog(o):
+            # stealable lane-round weight only: inflight and pinned work
+            # cannot move, so it must not make a peer look "busiest"
+            return sum(r.cfg.round_cap * r.cfg.instances
+                       for b in stealable(o) for r in o.pending[b])
+
+        victim = max(victims, key=lambda o: (backlog(o), -o.idx))
+        bucket = max(stealable(victim),       # longest stealable rotation
+                     key=lambda b: self._chain_locked(victim.pending[b]))
+        reqs = victim.pending.pop(bucket)
+        self._where[bucket] = w
+        w.steals += 1
+        self._steals += 1
+        _trace.event("fleet.steal", worker=w.idx, victim=victim.idx,
+                     bucket=bucket.label(), requests=len(reqs))
+        self._dispatch_locked(w, bucket, reqs)
+
+    # -- failure path ------------------------------------------------------
+
+    def _worker_lost(self, w) -> None:
+        """A worker's pipe hit EOF without a shutdown handshake: mark it
+        dead and re-admit every orphaned request to the survivors (same
+        fleet ids — replies stay bit-identical)."""
+        with self._cv:
+            if not w.alive:
+                return
+            w.alive = False
+            self._lost_workers += 1
+            orphans = []
+            if w.inflight:
+                orphans.append((w.current_bucket or
+                                next(iter(w.inflight.values())).bucket,
+                                list(w.inflight.values())))
+            w.inflight.clear()
+            w.current_bucket = None
+            for bucket, reqs in w.pending.items():
+                orphans.append((bucket, reqs))
+            w.pending.clear()
+            w.pinned.clear()
+            for bucket in [b for b, o in self._where.items() if o is w]:
+                del self._where[bucket]
+            n_orphans = sum(len(r) for _, r in orphans)
+            _trace.event("fleet.worker_lost", worker=w.idx, pid=w.pid,
+                         orphans=n_orphans)
+            survivors = [o for o in self._workers if o.alive]
+            if not survivors:
+                for _, reqs in orphans:
+                    for req in reqs:
+                        self._fail_locked(req, "all fleet workers lost")
+            else:
+                for bucket, reqs in orphans:
+                    _trace.event("fleet.readmit", worker=w.idx,
+                                 bucket=bucket.label() if bucket else None,
+                                 requests=len(reqs))
+                    for req in reqs:
+                        self._readmitted += 1
+                        self._route_locked(req)
+            self._cv.notify_all()
+
+    def _fail_locked(self, req: FleetRequest, why: str) -> None:
+        req.error = why
+        self._failed += 1
+        req.done.set()
+
+    # -- teardown ----------------------------------------------------------
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = None) -> None:
+        """Stop the fleet. ``drain=True`` (the ``with`` semantics) waits
+        for every outstanding request, then hands each worker a graceful
+        shutdown (child drains and answers ``bye`` with its final stats).
+        ``drain=False`` fails parent-side queued rotations first."""
+        with self._cv:
+            if not self._started:
+                return
+            self._stop = True
+            if not drain:
+                for w in self._workers:
+                    for reqs in w.pending.values():
+                        for req in reqs:
+                            self._fail_locked(
+                                req, "fleet shutdown before dispatch")
+                    w.pending.clear()
+            handles = list(self._requests)
+        if drain:
+            deadline = (time.monotonic() + timeout) if timeout else None
+            for req in handles:
+                left = None
+                if deadline is not None:
+                    left = max(0.0, deadline - time.monotonic())
+                req.done.wait(left)
+        for w in self._workers:
+            w.request_shutdown()
+        for w in self._workers:
+            w.finish_shutdown()
+        _trace.event("fleet.shutdown", submitted=self._submitted,
+                     replied=self._replied, failed=self._failed,
+                     steals=self._steals, readmitted=self._readmitted,
+                     lost_workers=self._lost_workers)
+
+    # -- monitoring --------------------------------------------------------
+
+    def stats(self, live: bool = True) -> dict:
+        """Fleet counters + one row per worker. ``live=True`` adds each
+        worker's own server stats (compile cache included) via the stats
+        RPC; dead/closed workers answer with their last snapshot."""
+        per_worker = []
+        with self._cv:
+            rows = [(w, w.alive, w.replied, w.steals, len(w.inflight),
+                     {b.label(): len(v) for b, v in w.pending.items()})
+                    for w in self._workers]
+            out = {
+                "mode": self._mode,
+                "workers": self._n_workers,
+                "alive": sum(1 for w in self._workers if w.alive),
+                "submitted": self._submitted,
+                "replied": self._replied,
+                "failed": self._failed,
+                "steals": self._steals,
+                "readmitted": self._readmitted,
+                "lost_workers": self._lost_workers,
+                "policy": self._policy.doc(),
+                "round_cap_ceiling": self._ceiling,
+                "rotation_cap": self._rotation_cap,
+            }
+        for w, alive, replied, steals, inflight, pending in rows:
+            row = {"worker": w.idx, "pid": w.pid, "alive": alive,
+                   "replied": replied, "steals": steals,
+                   "inflight": inflight, "pending": pending}
+            if live:
+                server = w.live_stats()
+                if server is not None:
+                    row["server"] = server
+            per_worker.append(row)
+        out["per_worker"] = per_worker
+        if self.placement is not None:
+            out["placement"] = self.placement
+        return out
+
+    def compile_counts(self) -> list:
+        """Per-worker compile counters (the loadgen's per-worker
+        zero-steady-state probe). ``None`` for an unresponsive worker."""
+        counts = []
+        for w in self._workers:
+            st = w.live_stats()
+            cache = (st or {}).get("compile_cache") or {}
+            counts.append(cache.get("compiles"))
+        return counts
+
+    def compile_count(self) -> int:
+        """Fleet-wide compile total (ConsensusServer duck-type)."""
+        return sum(c or 0 for c in self.compile_counts())

@@ -102,7 +102,7 @@ class ConsensusServer:
 
     def __init__(self, backend: str = "jax", policy=None,
                  round_cap_ceiling: int = DEFAULT_ROUND_CAP_CEILING,
-                 on_reply=None):
+                 on_reply=None, segment_hook=None):
         from byzantinerandomizedconsensus_tpu.backends.base import get_backend
 
         self._backend = get_backend(backend)
@@ -111,6 +111,11 @@ class ConsensusServer:
             width=64, segment=1)).validate()
         self._ceiling = int(round_cap_ceiling)
         self._on_reply = on_reply
+        # Called once per grid segment with the progress message (the
+        # run_bucket ``progress`` seam). The fleet worker's device-placement
+        # stub injects its synthetic per-dispatch device latency here
+        # (serve/fleet.py) — nothing flows back into the simulation math.
+        self._segment_hook = segment_hook
         self._cv = threading.Condition()
         # bucket -> [ServeRequest] queued while another bucket holds the grid
         self._pending: dict = {}
@@ -226,7 +231,8 @@ class ConsensusServer:
                                  seeded=len(reqs)):
                     _compaction.run_bucket(
                         self._backend, bucket, [], [], policy=self._policy,
-                        feed=feed, on_retire=self._retire)
+                        feed=feed, on_retire=self._retire,
+                        progress=self._segment_hook)
             except Exception as e:  # noqa: BLE001 — a grid failure must
                 # fail its requests, never kill the dispatcher
                 feed.close()
@@ -271,9 +277,11 @@ class ConsensusServer:
     def stats(self) -> dict:
         with self._cv:
             active = self._active[0].label() if self._active else None
+            feed_depth = self._active[1].pending() if self._active else 0
             pending = {b.label(): len(v) for b, v in self._pending.items()}
             out = {
                 "submitted": self._submitted,
+                "feed_depth": feed_depth,
                 "replied": self._replied,
                 "failed": self._failed,
                 "active_bucket": active,
@@ -382,18 +390,33 @@ def main(argv=None) -> int:
                     help="max admitted round_cap; pins the drain program")
     ap.add_argument("--trace-dir", default=None,
                     help="write a serve trace JSONL under this directory")
+    ap.add_argument("--workers", type=int, default=1,
+                    help="worker count: 1 runs the single-grid server, "
+                         ">1 the fleet dispatcher (serve/fleet.py — "
+                         "subprocess workers, bucket-affinity routing, "
+                         "work stealing; docs/SERVING.md §Fleet)")
     args = ap.parse_args(argv)
 
     if args.trace_dir:
-        _trace.configure(out_dir=args.trace_dir, role="serve")
+        _trace.configure(out_dir=args.trace_dir,
+                         role="fleet-coord" if args.workers > 1 else "serve")
     _devices.ensure_live_backend()
     policy = _compaction.CompactionPolicy.parse(args.policy)
-    with ConsensusServer(backend=args.backend, policy=policy,
-                         round_cap_ceiling=args.round_cap_ceiling) as srv:
+    if args.workers > 1:
+        from byzantinerandomizedconsensus_tpu.serve.fleet import FleetServer
+
+        server_cm = FleetServer(workers=args.workers, backend=args.backend,
+                                policy=policy,
+                                round_cap_ceiling=args.round_cap_ceiling,
+                                trace_dir=args.trace_dir)
+    else:
+        server_cm = ConsensusServer(backend=args.backend, policy=policy,
+                                    round_cap_ceiling=args.round_cap_ceiling)
+    with server_cm as srv:
         httpd = serve_http(srv, host=args.host, port=args.port)
         print(f"brc-tpu serve: listening on http://{args.host}:{args.port} "
               f"(policy {policy.doc()}, cap ceiling "
-              f"{args.round_cap_ceiling})")
+              f"{args.round_cap_ceiling}, workers {args.workers})")
         try:
             httpd.serve_forever()
         except KeyboardInterrupt:

@@ -1,0 +1,196 @@
+"""Fleet worker subprocess body (round 15; serve/fleet.py spawns these).
+
+One worker = one process owning one single-grid
+:class:`~byzantinerandomizedconsensus_tpu.serve.server.ConsensusServer` —
+its own backend instance, its own thread-safe ``CompileCache`` (the
+zero-steady-state-recompile pin holds *per worker*), and its own trace
+sink: like a chaos child, the worker self-enables telemetry from the
+``BRC_TRACE`` environment variable, but under the stable role
+``fleet-w<index>`` so the merged fleet timeline and the ``trace follow``
+heartbeat can attribute events to workers by file name.
+
+The wire protocol is JSON lines over stdin/stdout (stdlib only, same
+spawn discipline as the chaos subprocess ladder in tools/soak.py):
+
+parent → worker
+    ``{"op": "submit", "id": fid, "cfg": {...SimConfig fields...}}``
+    ``{"op": "stats", "rpc": k}``
+    ``{"op": "shutdown"}``
+
+worker → parent
+    ``{"op": "ready", "pid": p, "worker": i}``   (backend is live)
+    ``{"op": "reply", "id": fid, "record": {...}}``  (streamed at retire)
+    ``{"op": "fail", "id": fid, "error": "..."}``
+    ``{"op": "stats", "rpc": k, "stats": {...}}``
+    ``{"op": "bye", "stats": {...}}``            (drained; about to exit)
+
+Replies carry the *fleet* request id (the parent's ``id``), so a request
+re-admitted to a different worker after a failure keeps its identity. The
+real ``sys.stdout`` is reserved for the protocol; anything else a library
+prints is redirected to stderr so a stray banner can never tear a frame.
+
+``--segment-latency-s`` is the device-placement stub's fabric harness: a
+synthetic per-segment device round-trip injected through the server's
+``segment_hook`` (never into simulation math — replies stay bit-identical).
+On the 1-CPU-core box it is what makes fleet *dispatcher* scaling
+measurable at all; see docs/SERVING.md §Fleet.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import queue
+import sys
+import threading
+import time
+
+
+def _protocol_writer(stream):
+    """A locked line emitter; the only writer to the protocol stream."""
+    lock = threading.Lock()
+
+    def emit(doc: dict) -> None:
+        with lock:
+            stream.write(json.dumps(doc, separators=(",", ":")) + "\n")
+            stream.flush()
+
+    return emit
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="brc-tpu fleet-worker")
+    ap.add_argument("--index", type=int, required=True)
+    ap.add_argument("--backend", default="jax")
+    ap.add_argument("--policy", default="width=64,segment=1")
+    ap.add_argument("--round-cap-ceiling", type=int, default=128)
+    ap.add_argument("--segment-latency-s", type=float, default=0.0)
+    ap.add_argument("--placement", default=None,
+                    help="JSON placement doc from parallel/mesh."
+                         "fleet_placement (recorded in stats; the "
+                         "multi-device seam)")
+    args = ap.parse_args(argv)
+
+    # The protocol owns the real stdout; reroute everything else to stderr
+    # so library prints cannot corrupt a frame.
+    proto = sys.stdout
+    sys.stdout = sys.stderr
+    emit = _protocol_writer(proto)
+
+    from byzantinerandomizedconsensus_tpu.backends import batch as _batch
+    from byzantinerandomizedconsensus_tpu.obs import programs as _programs
+    from byzantinerandomizedconsensus_tpu.obs import trace as _trace
+
+    # Per-worker trace sink under the parent's BRC_TRACE dir, with a stable
+    # role (not the chaos w<pid>) so follow/merge can name workers.
+    out_dir = os.environ.get(_trace.TRACE_ENV)
+    if out_dir:
+        _trace.configure(out_dir=out_dir, role=f"fleet-w{args.index}")
+    _batch.maybe_enable_cache_from_env()
+    _programs.maybe_enable_from_env()
+
+    from byzantinerandomizedconsensus_tpu.backends.compaction import (
+        CompactionPolicy)
+    from byzantinerandomizedconsensus_tpu.serve.server import ConsensusServer
+    from byzantinerandomizedconsensus_tpu.utils.devices import (
+        ensure_live_backend)
+
+    ensure_live_backend()
+    placement = json.loads(args.placement) if args.placement else None
+    policy = CompactionPolicy.parse(args.policy)
+    hook = None
+    if args.segment_latency_s > 0:
+        lat = float(args.segment_latency_s)
+
+        def hook(_msg, _sleep=time.sleep, _lat=lat):
+            _sleep(_lat)
+
+    # inner request id -> fleet id; a reply can retire before submit()
+    # returns to the reader loop, so the retire callback waits for the
+    # mapping under this condition instead of racing it.
+    ids: dict = {}
+    ids_cv = threading.Condition()
+    watch: "queue.Queue" = queue.Queue()
+
+    def on_reply(req) -> None:
+        with ids_cv:
+            while req.id not in ids:
+                ids_cv.wait()
+            fid = ids.pop(req.id)
+        rec = dict(req.record)
+        rec["request_id"] = fid
+        emit({"op": "reply", "id": fid, "record": rec})
+
+    server = ConsensusServer(backend=args.backend, policy=policy,
+                             round_cap_ceiling=args.round_cap_ceiling,
+                             on_reply=on_reply, segment_hook=hook)
+
+    def watch_failures() -> None:
+        # on_reply only fires for successful retirements; a dispatch-error
+        # _fail sets the handle's error without a callback. This thread
+        # turns those into protocol "fail" frames (order is irrelevant —
+        # failures are rare and the parent matches by id).
+        while True:
+            item = watch.get()
+            if item is None:
+                return
+            fid, handle = item
+            handle.done.wait()
+            if handle.error is not None:
+                emit({"op": "fail", "id": fid, "error": handle.error})
+
+    watcher = threading.Thread(target=watch_failures,
+                               name=f"fleet-w{args.index}-watch", daemon=True)
+
+    def worker_stats() -> dict:
+        st = server.stats()
+        st["worker"] = args.index
+        st["pid"] = os.getpid()
+        if placement is not None:
+            st["placement"] = placement
+        return st
+
+    with server:
+        watcher.start()
+        emit({"op": "ready", "pid": os.getpid(), "worker": args.index})
+        graceful = False
+        for line in sys.stdin:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                msg = json.loads(line)
+            except ValueError:
+                continue  # torn frame: the parent never half-writes; skip
+            op = msg.get("op")
+            if op == "submit":
+                fid = msg.get("id")
+                try:
+                    handle = server.submit(msg.get("cfg") or {})
+                except Exception as e:  # noqa: BLE001 — report, don't die
+                    emit({"op": "fail", "id": fid,
+                          "error": f"admission/submit error: {e}"})
+                    continue
+                with ids_cv:
+                    ids[handle.id] = fid
+                    ids_cv.notify_all()
+                watch.put((fid, handle))
+            elif op == "stats":
+                emit({"op": "stats", "rpc": msg.get("rpc"),
+                      "stats": worker_stats()})
+            elif op == "shutdown":
+                graceful = True
+                break
+        # context exit drains: every queued request completes (or fails
+        # through the watcher) before the bye frame.
+        server.shutdown(drain=graceful)
+    watch.put(None)
+    if graceful:
+        emit({"op": "bye", "stats": worker_stats()})
+    _trace.disable()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -261,6 +261,35 @@ def _serve_rows_of(name: str, doc) -> list:
     return rows
 
 
+def _fleet_rows_of(name: str, doc) -> list:
+    """Schema-v1.6 ``fleet`` blocks of one artifact: one row per
+    ``per_worker`` entry (worker, replied, steady-state compiles, steals,
+    cfg/s) plus the fleet-wide steal/readmit counters — the ledger's
+    per-worker fleet columns."""
+    from byzantinerandomizedconsensus_tpu.obs import record as _record
+
+    rows = []
+    for path, fl in _blocks_of(doc, "fleet", _record.FLEET_BLOCK_KEYS):
+        pw = fl.get("per_worker")
+        for row in (pw if isinstance(pw, list) else []):
+            if not isinstance(row, dict):
+                continue
+            rows.append({
+                "artifact": name,
+                "path": path,
+                "workers": fl.get("workers"),
+                "worker": row.get("worker"),
+                "replied": row.get("replied"),
+                "cfg_per_s": row.get("cfg_per_s"),
+                "steals": row.get("steals"),
+                "steady_state_compiles": row.get("steady_state_compiles"),
+                "fleet_steals": fl.get("steals"),
+                "fleet_readmitted": fl.get("readmitted"),
+                "fleet_throughput_cps": fl.get("throughput_cps"),
+            })
+    return rows
+
+
 def sentinel_verdict(bench: dict, wall_chain: list,
                      programs_rows: list) -> dict:
     """The ``--check`` verdict: wall-chain regressions past
@@ -478,6 +507,12 @@ def build_ledger(root=None) -> dict:
     for name, doc in sorted(docs.items()):
         serve_rows.extend(_serve_rows_of(name, doc))
 
+    # ---- fleet per-worker columns (schema v1.6, round 15): every committed
+    # artifact carrying a multi-worker fleet-serving block.
+    fleet_rows = []
+    for name, doc in sorted(docs.items()):
+        fleet_rows.extend(_fleet_rows_of(name, doc))
+
     from byzantinerandomizedconsensus_tpu.obs import record
 
     return {
@@ -492,6 +527,7 @@ def build_ledger(root=None) -> dict:
         "trace_rows": trace_rows,
         "programs_rows": programs_rows,
         "serve_rows": serve_rows,
+        "fleet_rows": fleet_rows,
         "bench_rounds": {str(r): bench[r] for r in rounds_seen},
         "wall_chain": chain,
         "device_chain": device_chain,
@@ -593,6 +629,18 @@ def format_report(doc: dict) -> str:
                 f"{row['requests']} requests, p50 {row['p50_ms']} ms, "
                 f"p99 {row['p99_ms']} ms, {row['throughput_cps']} cfg/s, "
                 f"ttfr {row['time_to_first_result_ms']} ms, "
+                f"{row['steady_state_compiles']} steady-state compiles")
+    # Present only once an artifact carries the v1.6 fleet block.
+    if doc.get("fleet_rows"):
+        lines.append("fleet per-worker columns (schema v1.6 — "
+                     "artifact[path]: worker/of replied cfg/s steals "
+                     "steady-state compiles):")
+        for row in doc["fleet_rows"]:
+            lines.append(
+                f"  {row['artifact']}[{row['path']}]: "
+                f"worker {row['worker']}/{row['workers']}, "
+                f"{row['replied']} replied, {row['cfg_per_s']} cfg/s, "
+                f"{row['steals']} steals, "
                 f"{row['steady_state_compiles']} steady-state compiles")
     sent = doc.get("sentinel")
     if sent is not None:

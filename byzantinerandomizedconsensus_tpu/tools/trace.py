@@ -15,6 +15,10 @@ Four verbs:
   (``brc-tpu chaos --trace DIR`` writes one line-buffered JSONL per worker):
   incremental byte offsets per file, one status line per tick — configs
   done, mismatches/violations/skips, compaction queue depth, compiles.
+  Against a fleet trace directory (serve/fleet.py workers write
+  ``trace-fleet-w<i>.jsonl``) the serve heartbeat becomes the fleet
+  heartbeat — ``fleet N/M replied (w0:a w1:b …)`` — attributing reply
+  counts to workers by sink file name.
 - ``overhead`` — the round-12 inertness instrument: run the seeded chaos
   grid (tools/bench_batch.chaos_grid — the same population as
   artifacts/chaos_r9.json) through the fused lanes traced vs untraced,
@@ -32,6 +36,7 @@ from __future__ import annotations
 import argparse
 import json
 import pathlib
+import re
 import sys
 import time
 
@@ -123,8 +128,22 @@ def cmd_summary(args) -> int:
 # follow — live tail of a trace directory
 
 
-def _follow_consume(state: dict, ev: dict) -> None:
-    """Fold one event into the follow-mode aggregate."""
+#: The fleet workers' trace sink naming law (serve/worker.py configures
+#: role ``fleet-w<i>`` → obs/trace.py writes ``trace-fleet-w<i>.jsonl``):
+#: the follow heartbeat attributes replies to workers by file name alone.
+_FLEET_FILE_RE = re.compile(r"trace-fleet-(w\d+)\.jsonl$")
+
+
+def _fleet_worker_of(src) -> "str | None":
+    if not src:
+        return None
+    m = _FLEET_FILE_RE.search(str(src))
+    return m.group(1) if m else None
+
+
+def _follow_consume(state: dict, ev: dict, src=None) -> None:
+    """Fold one event into the follow-mode aggregate. ``src`` (the sink
+    file name) attributes fleet workers' serve events per worker."""
     state["events"] += 1
     kind = ev.get("kind", "")
     attrs = ev.get("attrs") or {}
@@ -144,8 +163,15 @@ def _follow_consume(state: dict, ev: dict) -> None:
         # done/total to converge on, but every admitted request proves the
         # admission path is moving.
         state["serve_requests"] += 1
+        w = _fleet_worker_of(src)
+        if w is not None:
+            state.setdefault("fleet", {}).setdefault(w, 0)
     elif kind == "serve.reply":
         state["serve_replies"] += 1
+        w = _fleet_worker_of(src)
+        if w is not None:
+            fleet = state.setdefault("fleet", {})
+            fleet[w] = fleet.get(w, 0) + 1
 
 
 def _follow_render(state: dict) -> str:
@@ -160,7 +186,12 @@ def _follow_render(state: dict) -> str:
              f"compiles {state['compiles']}"]
     if state.get("queue") is not None:
         parts.append(f"queue {state['queue']} (live {state.get('live')})")
-    if state.get("serve_requests"):
+    if state.get("fleet"):
+        per = " ".join(f"{w}:{n}" for w, n in sorted(
+            state["fleet"].items(), key=lambda kv: int(kv[0][1:])))
+        parts.append(f"fleet {state['serve_replies']}/"
+                     f"{state['serve_requests']} replied ({per})")
+    elif state.get("serve_requests"):
         parts.append(f"serve {state['serve_replies']}/"
                      f"{state['serve_requests']} replied")
     return "[trace] " + " | ".join(parts)
@@ -176,7 +207,7 @@ def follow(trace_dir, interval: float = 2.0, once: bool = False,
     offsets: dict = {}
     state = {"events": 0, "compiles": 0, "skips": 0, "progress": None,
              "queue": None, "live": None, "total": None,
-             "serve_requests": 0, "serve_replies": 0}
+             "serve_requests": 0, "serve_replies": 0, "fleet": {}}
     ticks = 0
     while True:
         # Per-worker files only: a post-run merged trace.jsonl duplicates
@@ -201,7 +232,7 @@ def follow(trace_dir, interval: float = 2.0, once: bool = False,
                     ev = json.loads(line)
                 except ValueError:
                     continue  # torn line mid-write: next tick re-reads
-                _follow_consume(state, ev)
+                _follow_consume(state, ev, src=p.name)
         out(_follow_render(state))
         ticks += 1
         if once or (max_ticks is not None and ticks >= max_ticks):

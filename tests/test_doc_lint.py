@@ -51,9 +51,12 @@ def test_span_kind_census_is_nontrivial_and_complete():
                      "compaction.refill", "compact.run", "program.compile",
                      "chaos.start", "chaos.progress", "chaos.skip",
                      "chaos.child.jax", "serve.request", "serve.admit",
-                     "serve.dispatch", "serve.reply"):
+                     "serve.dispatch", "serve.reply", "fleet.spawn",
+                     "fleet.backoff", "fleet.route", "fleet.dispatch",
+                     "fleet.steal", "fleet.worker_lost", "fleet.readmit",
+                     "fleet.shutdown"):
         assert expected in kinds, (expected, sorted(kinds))
-    assert len(kinds) >= 24
+    assert len(kinds) >= 32
 
 
 def test_every_emitted_span_kind_is_documented():
@@ -92,6 +95,7 @@ def test_every_record_block_key_is_documented():
         "trace": record.TRACE_BLOCK_KEYS,
         "programs": record.PROGRAMS_BLOCK_KEYS,
         "serve": record.SERVE_BLOCK_KEYS,
+        "fleet": record.FLEET_BLOCK_KEYS,
         "counters": ("supported", "totals"),
     }
     missing = []

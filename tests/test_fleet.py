@@ -1,0 +1,288 @@
+"""Round-15 fleet dispatcher tests (serve/fleet.py, serve/worker.py).
+
+Tier-1 layer: thread-mode routing / affinity / work-stealing semantics and
+the pure placement seam — in-process, no subprocess spawns. Slow layer: the
+real subprocess fleet (spawn ladder, stdio protocol, per-worker traces) and
+the worker-loss re-admission pin: kill a worker mid-stream and every
+in-flight request must be re-admitted to survivors with bit-identical
+replies under the same fleet ids.
+"""
+
+import dataclasses
+import json
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from byzantinerandomizedconsensus_tpu.backends.base import get_backend
+from byzantinerandomizedconsensus_tpu.backends.compaction import CompactionPolicy
+from byzantinerandomizedconsensus_tpu.config import SimConfig
+from byzantinerandomizedconsensus_tpu.obs import record
+from byzantinerandomizedconsensus_tpu.parallel import mesh as pmesh
+from byzantinerandomizedconsensus_tpu.serve import admission
+from byzantinerandomizedconsensus_tpu.serve.fleet import FleetServer, _policy_spec
+from byzantinerandomizedconsensus_tpu.tools import trace as trace_tool
+
+_POLICY = CompactionPolicy(width=8, segment=1)
+
+#: Two genuinely distinct buckets (different protocols — benor and bracha
+#: never fuse): the heavy one holds a worker long enough for the light
+#: worker to go idle and steal.
+_HEAVY = SimConfig(protocol="bracha", n=10, f=3, instances=24, seed=77,
+                   round_cap=64, delivery="urn", adversary="byzantine")
+_LIGHT = SimConfig(protocol="benor", n=4, f=1, instances=2, seed=3,
+                   round_cap=16)
+_THIRD = SimConfig(protocol="benor", n=9, f=3, instances=6, seed=21,
+                   round_cap=64, adversary="crash", init="split")
+
+
+def _offline(cfg):
+    ref = get_backend("numpy").run(cfg)
+    return [int(r) for r in ref.rounds], [int(d) for d in ref.decision]
+
+
+def test_policy_spec_round_trips():
+    p = CompactionPolicy(width=64, segment=2, refill_threshold=0.25)
+    assert CompactionPolicy.parse(_policy_spec(p)) == p
+    # width=None (unbounded lanes) must survive the argv spelling too
+    q = CompactionPolicy(width=None, segment=1)
+    assert CompactionPolicy.parse(_policy_spec(q)) == q
+
+
+def test_fleet_rejects_bad_shape():
+    with pytest.raises(ValueError, match="workers=0"):
+        FleetServer(workers=0)
+    with pytest.raises(ValueError, match="mode='coroutine'"):
+        FleetServer(workers=2, mode="coroutine")
+    with pytest.raises(ValueError, match="rotation_cap=0"):
+        FleetServer(workers=2, rotation_cap=0)
+
+
+def test_fleet_placement_layout():
+    devs = [SimpleNamespace(platform="tpu", id=k, device_kind="v5e")
+            for k in range(4)]
+    rows = pmesh.fleet_placement(3, devices=devs)
+    assert [r["device_id"] for r in rows] == [0, 1, 2]
+    assert all(r["shared"] is False for r in rows)
+    rows = pmesh.fleet_placement(4, devices=devs[:2])
+    assert [r["device_id"] for r in rows] == [0, 1, 0, 1]
+    assert all(r["shared"] is True for r in rows)
+    with pytest.raises(ValueError, match="n_workers=0"):
+        pmesh.fleet_placement(0, devices=devs)
+    with pytest.raises(ValueError, match="at least one device"):
+        pmesh.fleet_placement(2, devices=[])
+
+
+def test_thread_fleet_routes_steals_and_bit_matches():
+    """Thread-mode fleet: same-bucket affinity keeps a bucket on one
+    worker; a worker going idle steals the longest cross-bucket pending
+    rotation from the busiest peer; every reply bit-matches offline."""
+    with FleetServer(workers=2, mode="thread", policy=_POLICY,
+                     segment_latency_s=0.05) as fleet:
+        # w0 runs the heavy bucket, w1 the light one (pin = warm-up seam).
+        h_heavy = fleet.submit(_HEAVY, pin_worker=0)
+        h_light = fleet.submit(_LIGHT, pin_worker=1)
+        # Unpinned third bucket: both workers busy -> queued; whichever
+        # worker drains first pumps it. The light worker finishes long
+        # before the heavy one (segment latency scales with grid work),
+        # so the pending rotation moves by steal or by idle-pump.
+        h_third = fleet.submit(_THIRD)
+        # Same-bucket request while the rotation is live: joins mid-flight
+        # on the same worker (affinity), never opens a second grid.
+        h_heavy2 = fleet.submit(dataclasses.replace(_HEAVY, seed=78), pin_worker=None)
+        recs = [h.wait(timeout=600.0)
+                for h in (h_heavy, h_light, h_third, h_heavy2)]
+        stats = fleet.stats(live=True)
+
+    assert stats["submitted"] == 4
+    assert stats["replied"] == 4
+    assert stats["failed"] == 0
+    assert stats["lost_workers"] == 0
+    assert len(stats["per_worker"]) == 2
+    # both workers did real work (the steal/idle-pump moved the third
+    # bucket off the pinned-busy worker)
+    assert all(row["replied"] >= 1 for row in stats["per_worker"])
+
+    for h, rec, cfg in zip((h_heavy, h_light, h_third, h_heavy2), recs,
+                           (_HEAVY, _LIGHT, _THIRD, dataclasses.replace(_HEAVY, seed=78))):
+        assert rec["request_id"] == h.id
+        assert record.validate_record(rec) == [], rec
+        rounds, decision = _offline(cfg)
+        assert rec["rounds"] == rounds
+        assert rec["decision"] == decision
+
+
+def test_thread_fleet_steals_from_busiest_queue():
+    """Deterministic steal: the light worker drains first and must pull the
+    queued cross-bucket rotation off the still-busy heavy worker."""
+    with FleetServer(workers=2, mode="thread", policy=_POLICY,
+                     segment_latency_s=0.08) as fleet:
+        h0 = fleet.submit(_HEAVY, pin_worker=0)
+        # Queue the third bucket directly on the busy heavy worker: with
+        # w1 idle the router's idle-pump (or w1's drain) must move it.
+        h1 = fleet.submit(_LIGHT, pin_worker=1)
+        h2 = fleet.submit(_THIRD)
+        for h in (h0, h1, h2):
+            h.wait(timeout=600.0)
+        stats = fleet.stats(live=False)
+    # Work moved across workers at least once: either counted as a steal
+    # (pulled from a busy peer's queue) or both workers replied.
+    moved = stats["steals"] >= 1 or all(
+        row["replied"] >= 1 for row in stats["per_worker"])
+    assert moved, stats
+
+
+def test_rotation_cap_splits_hot_bucket_across_workers():
+    """Work-sharing granularity: a single hot bucket is NOT an indivisible
+    unit — with a rotation lane budget (here 6 lanes = exactly one
+    6-instance request per rotation) its overflow queues stealable, an
+    idle peer pulls a chunk immediately, and both workers end up serving
+    it with bit-identical replies."""
+    cfgs = [dataclasses.replace(_THIRD, seed=s) for s in range(30, 42)]
+    with FleetServer(workers=2, mode="thread", policy=_POLICY,
+                     segment_latency_s=0.05, rotation_cap=6) as fleet:
+        handles = [fleet.submit(c) for c in cfgs]
+        recs = [h.wait(timeout=600.0) for h in handles]
+        stats = fleet.stats(live=False)
+    assert stats["failed"] == 0 and stats["replied"] == len(cfgs)
+    assert stats["rotation_cap"] == 6
+    assert stats["steals"] >= 1  # w1 was idle: the first overflow chunk
+    # is pulled the moment it queues (idle-pump), not on some reply path
+    assert all(row["replied"] >= 1 for row in stats["per_worker"])
+    for rec, cfg in zip(recs, cfgs):
+        rounds, decision = _offline(cfg)
+        assert rec["rounds"] == rounds
+        assert rec["decision"] == decision
+
+
+def test_fleet_shutdown_no_drain_fails_pending():
+    fleet = FleetServer(workers=1, mode="thread", policy=_POLICY).start()
+    h = fleet.submit(_LIGHT)
+    fleet.shutdown(drain=True)
+    assert h.error is None and h.record is not None
+    with pytest.raises(RuntimeError, match="shutting down"):
+        fleet.submit(_LIGHT)
+
+
+def test_thread_fleet_kill_is_refused():
+    fleet = FleetServer(workers=1, mode="thread", policy=_POLICY).start()
+    try:
+        with pytest.raises(RuntimeError, match="mode='process'"):
+            fleet._workers[0].kill()
+    finally:
+        fleet.shutdown(drain=True)
+
+
+def test_follow_heartbeat_renders_fleet_line(tmp_path):
+    """Satellite: `trace follow` on a fleet trace dir shows the per-worker
+    heartbeat — "fleet N/M replied (w0:a w1:b ...)" — attributing serve
+    events to workers by sink file name alone."""
+    def sink(name, events):
+        (tmp_path / name).write_text(
+            "".join(json.dumps(e) + "\n" for e in events))
+
+    sink("trace-fleet-w0.jsonl", [
+        {"kind": "serve.request", "attrs": {"id": "f000001"}},
+        {"kind": "serve.request", "attrs": {"id": "f000002"}},
+        {"kind": "serve.reply", "attrs": {"id": "f000001"}},
+        {"kind": "serve.reply", "attrs": {"id": "f000002"}},
+    ])
+    sink("trace-fleet-w1.jsonl", [
+        {"kind": "serve.request", "attrs": {"id": "f000003"}},
+        {"kind": "serve.request", "attrs": {"id": "f000004"}},
+        {"kind": "serve.reply", "attrs": {"id": "f000003"}},
+    ])
+    sink("trace-fleet-coord.jsonl", [
+        {"kind": "fleet.route", "attrs": {"id": "f000001", "worker": 0}},
+    ])
+    lines = []
+    state = trace_tool.follow(tmp_path, once=True, out=lines.append)
+    assert state["fleet"] == {"w0": 2, "w1": 1}
+    assert len(lines) == 1
+    assert "fleet 3/4 replied (w0:2 w1:1)" in lines[0]
+
+
+def test_follow_heartbeat_without_fleet_keeps_serve_line(tmp_path):
+    (tmp_path / "trace-serve.jsonl").write_text(
+        json.dumps({"kind": "serve.request", "attrs": {}}) + "\n"
+        + json.dumps({"kind": "serve.reply", "attrs": {}}) + "\n")
+    lines = []
+    state = trace_tool.follow(tmp_path, once=True, out=lines.append)
+    assert state["fleet"] == {}
+    assert "serve 1/1 replied" in lines[0]
+    assert "fleet" not in lines[0]
+
+
+@pytest.mark.slow
+def test_process_fleet_smoke_and_per_worker_traces(tmp_path):
+    """The real subprocess fleet: spawn ladder, stdio protocol, per-worker
+    compile counts over the stats RPC, merged per-worker trace sinks."""
+    from byzantinerandomizedconsensus_tpu.obs import trace as _trace
+
+    with FleetServer(workers=2, mode="process", policy=_POLICY,
+                     trace_dir=str(tmp_path)) as fleet:
+        handles = [fleet.submit(c) for c in (_HEAVY, _LIGHT, _THIRD)]
+        recs = [h.wait(timeout=600.0) for h in handles]
+        counts = fleet.compile_counts()
+        stats = fleet.stats(live=True)
+
+    assert stats["replied"] == 3 and stats["failed"] == 0
+    assert len(counts) == 2 and all(c is not None for c in counts)
+    for h, rec, cfg in zip(handles, recs, (_HEAVY, _LIGHT, _THIRD)):
+        assert rec["request_id"] == h.id
+        rounds, decision = _offline(cfg)
+        assert rec["rounds"] == rounds
+        assert rec["decision"] == decision
+    # every worker wrote its own sink, and merge() folds them time-ordered
+    sinks = sorted(p.name for p in tmp_path.glob("trace-fleet-w*.jsonl"))
+    assert sinks == ["trace-fleet-w0.jsonl", "trace-fleet-w1.jsonl"]
+    merged = _trace.merge(tmp_path)
+    events = _trace.read_events(merged)
+    assert any(e["kind"] == "serve.reply" for e in events)
+
+
+@pytest.mark.slow
+def test_process_fleet_worker_loss_readmits_bit_identical():
+    """Satellite: kill one worker mid-stream. Its in-flight and queued
+    requests are re-admitted to survivors under the same fleet ids and
+    every reply stays bit-identical to the offline oracle."""
+    victims = [_HEAVY, dataclasses.replace(_HEAVY, seed=101),
+               dataclasses.replace(_HEAVY, seed=102)]
+    with FleetServer(workers=2, mode="process", policy=_POLICY,
+                     segment_latency_s=0.2) as fleet:
+        doomed = [fleet.submit(c, pin_worker=0) for c in victims]
+        safe = fleet.submit(_LIGHT, pin_worker=1)
+        # wait until w0 actually has the rotation in flight, then kill it
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            with fleet._cv:
+                if fleet._workers[0].inflight:
+                    break
+            time.sleep(0.05)
+        fleet._workers[0].kill()
+        recs = [h.wait(timeout=600.0) for h in doomed]
+        safe_rec = safe.wait(timeout=600.0)
+        stats = fleet.stats(live=False)
+
+    assert stats["lost_workers"] == 1
+    assert stats["readmitted"] >= 1
+    assert stats["failed"] == 0
+    assert stats["replied"] == 4
+    for h, rec, cfg in zip(doomed, recs, victims):
+        assert rec["request_id"] == h.id  # same id across re-admission
+        rounds, decision = _offline(cfg)
+        assert rec["rounds"] == rounds
+        assert rec["decision"] == decision
+    assert safe_rec["request_id"] == safe.id
+
+
+def test_thread_fleet_all_workers_share_one_front_door():
+    """The admission seam is the fleet's only entry: a bad payload is
+    rejected before any routing state mutates."""
+    with FleetServer(workers=2, mode="thread", policy=_POLICY) as fleet:
+        with pytest.raises(ValueError, match="unknown request field"):
+            fleet.submit({"n": 5, "banana": 1})
+        with pytest.raises(ValueError, match="exceeds the service ceiling"):
+            fleet.submit(SimConfig(n=4, f=1, round_cap=256))
+        assert fleet.stats(live=False)["submitted"] == 0

@@ -473,3 +473,40 @@ def test_census_includes_serve_artifact():
     report = ledger.format_report(doc)
     assert "serve latency/throughput columns" in report
     assert "steady-state compiles" in report
+
+
+def test_census_includes_fleet_artifact():
+    """The round-15 fleet artifact: parsed with zero errors, the per-worker
+    zero-steady-state-recompile pin on every row, the steal counter, and
+    the schema-v1.6 per-worker columns reconstructed by the ledger."""
+    import pathlib
+
+    from byzantinerandomizedconsensus_tpu.utils.rounds import repo_root
+
+    doc = ledger.build_ledger()
+    assert doc["parse_errors"] == []
+    rows = [r for r in doc["fleet_rows"]
+            if r["artifact"] == "artifacts/serve_fleet_r15.json"]
+    assert rows, "serve_fleet_r15.json must yield per-worker fleet columns"
+    for row in rows:
+        assert isinstance(row["worker"], int)
+        assert row["steady_state_compiles"] == 0  # the round-15 claim,
+        # enforced per worker (a fleet-wide sum could hide one hot worker)
+        assert row["replied"] is None or row["replied"] >= 0
+    # the headline sweep leg carries the largest worker count
+    assert max(r["workers"] for r in rows) >= 4
+    assert any(r["fleet_steals"] and r["fleet_steals"] > 0 for r in rows), \
+        "the committed fat-tail run must have stolen at least once"
+
+    fv = json.loads((pathlib.Path(repo_root())
+                     / "artifacts/serve_fleet_r15.json").read_text())
+    assert fv["kind"] == "serve_fleet"
+    assert record.validate_record(fv) == []
+    assert fv["record_revision"] >= 6  # schema v1.6
+    assert fv["differential"]["mismatches"] == 0
+    assert fv["fleet"]["steady_state_compiles"] == 0
+    assert fv["stream_digest"]
+    assert "device_chain_note" in fv  # CPU-box honesty label
+
+    report = ledger.format_report(doc)
+    assert "fleet per-worker columns" in report

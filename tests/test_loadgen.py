@@ -34,6 +34,20 @@ def test_stream_reproduces_byte_for_byte():
     assert loadgen.stream_digest(c) != loadgen.stream_digest(a)
 
 
+def test_stream_digest_invariant_under_worker_count():
+    """Round-15 fleet pin: the request stream is generated once, before
+    dispatch — the sha256 digest is byte-identical for --workers 1/2/4
+    (worker count routes, it never reshapes the arrival process)."""
+    digests = {
+        k: loadgen.stream_digest(
+            loadgen.fleet_request_stream(40, seed=_SEED, rate=4.0,
+                                         workers=k))
+        for k in (1, 2, 4)}
+    assert digests[1] == digests[2] == digests[4]
+    assert digests[1] == loadgen.stream_digest(
+        loadgen.request_stream(40, seed=_SEED, rate=4.0))
+
+
 def test_stream_population_is_admissible():
     """Every draw respects the service's admission bounds by construction:
     validated configs, round_cap at or under the ceiling, the three

@@ -52,7 +52,7 @@ def test_validate_record_rejects_unknown_revision():
                                            "record_revision": bad})), bad
     # Every revision this build knows — including the legacy implied-v1
     # absence — stays valid.
-    for ok in (None, 0, 1, 2, 3, 4, record.RECORD_REVISION):
+    for ok in (None, 0, 1, 2, 3, 4, 5, record.RECORD_REVISION):
         doc = record.new_record("x")
         if ok is None:
             doc.pop("record_revision")
@@ -82,6 +82,39 @@ def test_validate_record_checks_serve_block():
     assert any("serve latency_ms missing 'p99'" in p
                for p in record.validate_record(lame))
     assert record.serve_block(None) is None
+
+
+def test_validate_record_checks_fleet_block():
+    """Schema v1.6: a fleet block missing its required keys, latency
+    percentiles, or per-worker compile split must fail by name; the
+    loadgen's own fleet block validates."""
+    bad = {**record.new_record("serve_fleet"), "fleet": {"workers": 2}}
+    problems = record.validate_record(bad)
+    assert any("fleet block missing 'arrival_seed'" in p for p in problems)
+    assert any("steady_state_compiles" in p for p in problems)
+    assert any("'per_worker'" in p for p in problems)
+    good_stats = {
+        "workers": 2, "arrival_seed": 15,
+        "admission_policy": {"mode": "fused-compaction"},
+        "requests": 8, "latency_ms": {"p50": 1.0, "p99": 2.0},
+        "throughput_cps": 10.0, "steady_state_compiles": 0,
+        "steals": 1, "readmitted": 0, "lost_workers": 0,
+        "per_worker": [{"worker": 0, "steady_state_compiles": 0},
+                       {"worker": 1, "steady_state_compiles": 0}],
+        "fabric_latency_ms": 12.0}
+    good = {**record.new_record("serve_fleet"),
+            "fleet": record.fleet_block(good_stats)}
+    assert record.validate_record(good) == []
+    assert good["fleet"]["fabric_latency_ms"] == 12.0  # passthrough extras
+    lame = {**good, "fleet": {**record.fleet_block(good_stats),
+                              "latency_ms": {"p50": 1.0}}}
+    assert any("fleet latency_ms missing 'p99'" in p
+               for p in record.validate_record(lame))
+    torn = {**good, "fleet": {**record.fleet_block(good_stats),
+                              "per_worker": [{"worker": 0}]}}
+    assert any("per_worker row 0" in p
+               for p in record.validate_record(torn))
+    assert record.fleet_block(None) is None
 
 
 def test_timing_block_maps_suspect_to_error():
@@ -175,12 +208,14 @@ def test_schema_census_every_committed_artifact_validates():
         problems = record.validate_record(payload)
         assert problems == [], (p.name, problems)
         checked.append(p.name)
-    # The v1+ era census as committed (r8-r14: ledger_r8, chaos_r9,
+    # The v1+ era census as committed (r8-r15: ledger_r8, chaos_r9,
     # batch_r10, compaction_r11, BENCH_r11, trace_r12, programs_r13,
-    # serve_r14): an accidentally narrowed glob must not silently pass on
-    # near-zero coverage — and the v1.4/v1.5 artifacts must be in the
-    # checked set, so the unknown-revision and serve-block checks above
-    # provably ran against real revision-4/-5 heads.
-    assert len(checked) >= 7, checked
+    # serve_r14, serve_fleet_r15): an accidentally narrowed glob must not
+    # silently pass on near-zero coverage — and the v1.4/v1.5/v1.6
+    # artifacts must be in the checked set, so the unknown-revision,
+    # serve-block, and fleet-block checks above provably ran against real
+    # revision-4/-5/-6 heads.
+    assert len(checked) >= 8, checked
     assert "programs_r13.json" in checked, checked
     assert "serve_r14.json" in checked, checked
+    assert "serve_fleet_r15.json" in checked, checked
