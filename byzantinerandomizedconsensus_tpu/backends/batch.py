@@ -50,6 +50,7 @@ import numpy as np
 
 from byzantinerandomizedconsensus_tpu.config import SimConfig, validate_batch
 from byzantinerandomizedconsensus_tpu.models.adversaries import AdversaryModel
+from byzantinerandomizedconsensus_tpu.obs import metrics as _metrics
 from byzantinerandomizedconsensus_tpu.obs import programs as _programs
 from byzantinerandomizedconsensus_tpu.obs import trace as _trace
 from byzantinerandomizedconsensus_tpu.ops import prf
@@ -290,6 +291,8 @@ class CompileCache:
             if key in self._entries:
                 self._entries.move_to_end(key)
                 self.hits += 1
+                _metrics.counter("brc_compile_cache_hits_total",
+                                 "CompileCache lookups served warm").inc()
                 _trace.event("compile_cache.hit", key=_key_label(key))
                 return self._entries[key]
             t0 = time.perf_counter()
@@ -297,6 +300,10 @@ class CompileCache:
             wall = time.perf_counter() - t0
             self.compiles += 1
             self.compile_wall_s += wall
+            # the steady-state-compile counter: loadgen/SLO runs assert its
+            # delta is zero once every bucket program is warm
+            _metrics.counter("brc_compile_cache_compiles_total",
+                             "Program builds (cold CompileCache keys)").inc()
             if callable(fn):
                 fn = self._timed_first_call(key, fn, wall)
             else:
@@ -306,6 +313,8 @@ class CompileCache:
             while len(self._entries) > self.max_entries:
                 old_key, _ = self._entries.popitem(last=False)
                 self.evictions += 1
+                _metrics.counter("brc_compile_cache_evictions_total",
+                                 "LRU evictions from the CompileCache").inc()
                 _trace.event("compile_cache.evict", key=_key_label(old_key))
             return fn
 

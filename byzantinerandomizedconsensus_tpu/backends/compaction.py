@@ -56,6 +56,7 @@ from byzantinerandomizedconsensus_tpu.backends.batch import (
     ADV_CODES, COIN_CODES, FAULT_CODES, INIT_CODES, FusedBucket,
     FusedLaneConfig, LaneConfig, ShapeBucket, _chunk_instances, _key_label,
     _PadAdversary, compile_cache, lane_tier)
+from byzantinerandomizedconsensus_tpu.obs import metrics as _metrics
 from byzantinerandomizedconsensus_tpu.obs import trace as _trace
 from byzantinerandomizedconsensus_tpu.ops import prf
 
@@ -670,6 +671,37 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
             sp["useful_trips"] = int(trips.sum())
             sp["retired"] = int(retire.sum())
             sp["live"] = W - free
+        if _metrics.enabled():
+            # Live consensus health off the host-fetched arrays (nothing
+            # feeds back into the grid math — bit-identity is structural):
+            # the rounds-to-decision histogram is the protocol's headline
+            # distribution as a stream; decision==2 marks undecided-at-cap.
+            _metrics.counter("brc_compaction_segments_total",
+                             "Segment dispatches across all grids").inc()
+            _metrics.gauge("brc_compaction_live_lanes",
+                           "Lanes holding live instances after the last "
+                           "segment").set(W - free)
+            if device_rounds:
+                _metrics.gauge("brc_compaction_occupancy",
+                               "Cumulative useful/device lane-round "
+                               "ratio").set(
+                                   round(useful_rounds / device_rounds, 6))
+            n_ret = int(retire.sum())
+            if n_ret:
+                _metrics.histogram(
+                    "brc_consensus_rounds",
+                    "Ben-Or rounds to decision per retired instance",
+                    buckets=_metrics.ROUNDS_BUCKETS).observe_many(
+                        np.asarray(rounds_h)[retire].tolist())
+                decided = int((np.asarray(dec_h)[retire] != 2).sum())
+                if decided:
+                    _metrics.counter("brc_consensus_decided_total",
+                                     "Instances retired with a "
+                                     "decision").inc(decided)
+                if n_ret - decided:
+                    _metrics.counter("brc_consensus_undecided_total",
+                                     "Instances retired undecided at "
+                                     "round_cap").inc(n_ret - decided)
         if progress is not None:
             progress(f"compaction segment {segments}: {W - free}/{W} live, "
                      f"{total - head} queued")
@@ -714,6 +746,12 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
                 sp["keep"] = n_keep
                 sp["take"] = take
                 sp["queued"] = total - head
+            if _metrics.enabled():
+                _metrics.counter("brc_compaction_refills_total",
+                                 "Compaction+refill dispatches").inc()
+                _metrics.gauge("brc_compaction_refill_depth",
+                               "Work-stream items still queued after the "
+                               "last refill").set(total - head)
 
     results = [SimResult(config=c, inst_ids=i, rounds=r, decision=d)
                for c, i, r, d in zip(cfgs, ids_list, rounds_out, dec_out)]
@@ -722,6 +760,17 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
         docs = [_c.counters_doc(c, _c.finalize(c, rows),
                                 backend=backend.name)
                 for c, rows in zip(cfgs, acc_out)]
+        if _metrics.enabled():
+            # fault-attribution lives only in the schema-v2 counter totals
+            # (the feed/fused paths have no counter leg — CountersUnsupported
+            # above), so the silenced stream updates per counters-enabled run
+            silenced = sum(int(v) for d in docs
+                           for k, v in d["totals"].items()
+                           if k.startswith("fault_silenced@"))
+            if silenced:
+                _metrics.counter("brc_consensus_fault_silenced_total",
+                                 "Messages silenced by faulty senders "
+                                 "(schema-v2 counter totals)").inc(silenced)
     stats = {
         "width": W,
         "segments": segments,

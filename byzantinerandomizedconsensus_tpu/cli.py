@@ -344,17 +344,27 @@ def main(argv=None) -> int:
                         "artifact with p50/p99 latency + sustained "
                         "configs/sec + the zero-recompile pin; --workers "
                         "1,2,4 sweeps the fleet and pins the scaling "
-                        "curve (schema-v1.6 fleet block)")
+                        "curve (schema-v1.6 fleet block); --slo-p99-ms / "
+                        "--slo-error-rate gate the run against a live "
+                        "/metrics scrape (exit 5 on breach)")
+    sub.add_parser("dash",
+                   help="live terminal dashboard over a serving endpoint's "
+                        "GET /metrics (tools/dash.py): request p50/p99 + "
+                        "rate, admission/rejection counters, grid "
+                        "occupancy, compile-cache deltas, consensus "
+                        "decided fraction + rounds sparkline, per-worker "
+                        "fleet table; read-only and survives a dead "
+                        "endpoint")
 
     if argv is None:
         argv = sys.argv[1:]
     if argv and argv[0] in ("accept", "slack", "product", "ledger", "chaos",
                             "compaction", "trace", "programs", "serve",
-                            "loadgen"):
+                            "loadgen", "dash"):
         from byzantinerandomizedconsensus_tpu.serve import server as serve_tool
         from byzantinerandomizedconsensus_tpu.tools import (
-            acceptance, bench_compaction, ledger, loadgen, product, slack,
-            soak)
+            acceptance, bench_compaction, dash, ledger, loadgen, product,
+            slack, soak)
         from byzantinerandomizedconsensus_tpu.tools import (
             programs as programs_tool)
         from byzantinerandomizedconsensus_tpu.tools import trace as trace_tool
@@ -365,7 +375,7 @@ def main(argv=None) -> int:
                 "product": product, "ledger": ledger,
                 "compaction": bench_compaction, "trace": trace_tool,
                 "programs": programs_tool, "serve": serve_tool,
-                "loadgen": loadgen}[argv[0]]
+                "loadgen": loadgen, "dash": dash}[argv[0]]
         return tool.main(argv[1:])
     args = ap.parse_args(argv)
     if getattr(args, "backend", "").startswith("jax"):

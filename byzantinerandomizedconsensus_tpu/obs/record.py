@@ -102,8 +102,10 @@ RECORD_VERSION = 1
 # block + the unknown-revision validate_record check; v1.5 (round 14) the
 # serve block (open-loop serving latency/throughput + steady-state compiles);
 # v1.6 (round 15) the fleet block (multi-worker serving: per-worker compile/
-# steal/throughput rows behind the single admission path).
-RECORD_REVISION = 6
+# steal/throughput rows behind the single admission path); v1.7 (round 16)
+# the metrics block (live metrics plane: registry snapshot digest, scraped
+# p99 / decided fraction, SLO verdict).
+RECORD_REVISION = 7
 
 
 def env_fingerprint() -> dict:
@@ -386,6 +388,39 @@ def fleet_block(stats: dict | None) -> dict | None:
             if k in stats}
 
 
+#: The fields a schema-v1.7 ``metrics`` block must carry (the live metrics
+#: plane of obs/metrics.py: which metric families the run registered, the
+#: headline scraped gauges, and the SLO verdict when one was enforced).
+METRICS_BLOCK_KEYS = ("names", "series", "p99_latency_ms",
+                      "decided_fraction")
+
+
+def metrics_block(snapshot: dict | None, slo: dict | None = None
+                  ) -> dict | None:
+    """The schema-v1.7 ``metrics`` block from a registry snapshot
+    (obs/metrics.py ``snapshot()`` or a ``parse_text`` scrape). None in,
+    None out — a record without the block stays a valid v1.x record. The
+    block is a *digest*, not the full series dump: family names, series
+    count, and the headline gauges the SLO gate reads; ``slo`` (when the
+    run enforced one) carries the thresholds and the verdict."""
+    if not snapshot:
+        return None
+    from byzantinerandomizedconsensus_tpu.obs import metrics as _metrics
+
+    summ = _metrics.summary(snapshot)
+    out = {
+        "names": sorted(snapshot),
+        "series": sum(len(f.get("series") or ()) for f in snapshot.values()),
+        "p99_latency_ms": summ["p99_latency_ms"],
+        "decided_fraction": summ["decided_fraction"],
+        "p50_latency_ms": summ["p50_latency_ms"],
+        "error_rate": summ["error_rate"],
+    }
+    if slo is not None:
+        out["slo"] = dict(slo)
+    return out
+
+
 def validate_record(doc: dict) -> list:
     """Schema check: returns a list of problems (empty = valid v1 record)."""
     problems = []
@@ -485,6 +520,20 @@ def validate_record(doc: dict) -> list:
                             problems.append(
                                 f"fleet per_worker row {i} missing "
                                 "'worker'/'steady_state_compiles'")
+    mt = doc.get("metrics")
+    if mt is not None:
+        if not isinstance(mt, dict):
+            problems.append("metrics block is not a dict")
+        else:
+            for key in METRICS_BLOCK_KEYS:
+                if key not in mt:
+                    problems.append(f"metrics block missing {key!r}")
+            if not isinstance(mt.get("names"), list):
+                problems.append("metrics block 'names' is not a list")
+            slo = mt.get("slo")
+            if slo is not None and (not isinstance(slo, dict)
+                                    or "ok" not in slo):
+                problems.append("metrics slo block missing 'ok'")
     pg = doc.get("programs")
     if pg is not None:
         if not isinstance(pg, dict):

@@ -29,7 +29,15 @@ import dataclasses
 
 from byzantinerandomizedconsensus_tpu.backends.batch import FusedBucket
 from byzantinerandomizedconsensus_tpu.config import SimConfig
+from byzantinerandomizedconsensus_tpu.obs import metrics as _metrics
 from byzantinerandomizedconsensus_tpu.obs import trace as _trace
+
+_REJECT_HELP = "Requests rejected at admission, by reason"
+
+
+def _reject(reason: str) -> None:
+    _metrics.counter("brc_serve_rejected_total", _REJECT_HELP,
+                     reason=reason).inc()
 
 #: The payload keys a dict request may carry — exactly the SimConfig fields.
 REQUEST_FIELDS = tuple(f.name for f in dataclasses.fields(SimConfig))
@@ -48,20 +56,33 @@ def admit(payload, round_cap_ceiling: int | None = None) -> SimConfig:
     elif isinstance(payload, dict):
         unknown = sorted(set(payload) - set(REQUEST_FIELDS))
         if unknown:
+            _reject("unknown_fields")
             raise ValueError(
                 f"unknown request field(s) {unknown}; "
                 f"a request carries SimConfig fields: {REQUEST_FIELDS}")
-        cfg = SimConfig(**payload)
+        try:
+            cfg = SimConfig(**payload)
+        except (TypeError, ValueError):
+            _reject("invalid_config")
+            raise
     else:
+        _reject("bad_type")
         raise TypeError(
             f"request payload is {type(payload).__name__}, "
             "not a SimConfig or dict")
-    cfg.validate()
+    try:
+        cfg.validate()
+    except ValueError:
+        _reject("invalid_config")
+        raise
     if round_cap_ceiling is not None and cfg.round_cap > round_cap_ceiling:
+        _reject("cap_ceiling")
         raise ValueError(
             f"round_cap={cfg.round_cap} exceeds the service ceiling "
             f"{round_cap_ceiling}; a longer cap would force a new drain "
             "program (zero steady-state recompiles is a service guarantee)")
+    _metrics.counter("brc_serve_admitted_total",
+                     "Requests admitted into the service").inc()
     _trace.event("serve.admit", bucket=bucket_of(cfg).label(),
                  instances=int(cfg.instances))
     return cfg

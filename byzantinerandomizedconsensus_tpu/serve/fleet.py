@@ -65,6 +65,7 @@ import time
 from typing import Optional
 
 from byzantinerandomizedconsensus_tpu.backends import compaction as _compaction
+from byzantinerandomizedconsensus_tpu.obs import metrics as _metrics
 from byzantinerandomizedconsensus_tpu.obs import trace as _trace
 from byzantinerandomizedconsensus_tpu.serve import admission as _admission
 from byzantinerandomizedconsensus_tpu.serve.server import (
@@ -194,6 +195,10 @@ class _ProcessWorker(_WorkerBase):
         env = dict(os.environ)
         if f._trace_dir is not None:
             env[_trace.TRACE_ENV] = str(f._trace_dir)
+        if _metrics.enabled():
+            # the child self-enables (serve/worker.py) and ships its
+            # registry snapshot back over stats/bye frames
+            env[_metrics.METRICS_ENV] = "1"
         attempts = 1 + f._spawn_retries
         for attempt in range(attempts):
             self._ready.clear()
@@ -217,6 +222,9 @@ class _ProcessWorker(_WorkerBase):
             self._reader.join(timeout=5.0)
             if attempt + 1 < attempts:
                 delay = f._backoff_s * (2 ** attempt)
+                _metrics.counter("brc_fleet_respawns_total",
+                                 "Worker spawn retries (backoff ladder)"
+                                 ).inc()
                 _trace.event("fleet.backoff", worker=self.idx,
                              attempt=attempt, delay_s=delay)
                 time.sleep(delay)
@@ -271,6 +279,7 @@ class _ProcessWorker(_WorkerBase):
                     self._rpc_cv.notify_all()
             elif op == "bye":
                 self.final_stats = msg.get("stats")
+                self.fleet._absorb_worker(self.idx, self.final_stats)
                 self._expect_exit = True
                 self._bye.set()
         proc.stdout.close()
@@ -303,7 +312,10 @@ class _ProcessWorker(_WorkerBase):
     def finish_shutdown(self, timeout: float = CHAOS_TIMEOUT_S) -> None:
         if self.proc is None:
             return
-        self._bye.wait(timeout)
+        # a process that already exited (killed / crashed) will never send
+        # bye — waiting the full chaos timeout for it just stalls teardown
+        if self.proc.poll() is None:
+            self._bye.wait(timeout)
         try:
             if self.proc.stdin is not None:
                 self.proc.stdin.close()
@@ -691,6 +703,8 @@ class FleetServer:
         self._where[bucket] = w
         w.steals += 1
         self._steals += 1
+        _metrics.counter("brc_fleet_steals_total",
+                         "Pending rotations stolen by idle workers").inc()
         _trace.event("fleet.steal", worker=w.idx, victim=victim.idx,
                      bucket=bucket.label(), requests=len(reqs))
         self._dispatch_locked(w, bucket, reqs)
@@ -706,6 +720,9 @@ class FleetServer:
                 return
             w.alive = False
             self._lost_workers += 1
+            _metrics.counter("brc_fleet_workers_lost_total",
+                             "Workers lost without a shutdown handshake"
+                             ).inc()
             orphans = []
             if w.inflight:
                 orphans.append((w.current_bucket or
@@ -734,12 +751,18 @@ class FleetServer:
                                  requests=len(reqs))
                     for req in reqs:
                         self._readmitted += 1
+                        _metrics.counter(
+                            "brc_fleet_readmitted_total",
+                            "Orphaned requests re-admitted to survivors"
+                        ).inc()
                         self._route_locked(req)
             self._cv.notify_all()
 
     def _fail_locked(self, req: FleetRequest, why: str) -> None:
         req.error = why
         self._failed += 1
+        _metrics.counter("brc_serve_failed_total",
+                         "Requests failed after admission").inc()
         req.done.set()
 
     # -- teardown ----------------------------------------------------------
@@ -780,14 +803,23 @@ class FleetServer:
 
     # -- monitoring --------------------------------------------------------
 
+    def _absorb_worker(self, idx: int, st: Optional[dict]) -> None:
+        """Fold a worker's shipped registry snapshot into the parent's
+        (labeled per worker — the fleet ``/metrics`` federation seam)."""
+        if st and _metrics.enabled():
+            _metrics.absorb(st.get("metrics"), worker=str(idx))
+
     def stats(self, live: bool = True) -> dict:
-        """Fleet counters + one row per worker. ``live=True`` adds each
-        worker's own server stats (compile cache included) via the stats
-        RPC; dead/closed workers answer with their last snapshot."""
+        """Fleet counters + one row per worker (same row shape as the
+        single-grid server's ``per_worker``, the one-shape rule).
+        ``live=True`` adds each worker's own server stats (compile cache
+        included) via the stats RPC; dead/closed workers answer with their
+        last snapshot."""
         per_worker = []
         with self._cv:
             rows = [(w, w.alive, w.replied, w.steals, len(w.inflight),
-                     {b.label(): len(v) for b, v in w.pending.items()})
+                     {b.label(): len(v) for b, v in w.pending.items()},
+                     w.load())
                     for w in self._workers]
             out = {
                 "mode": self._mode,
@@ -803,19 +835,55 @@ class FleetServer:
                 "round_cap_ceiling": self._ceiling,
                 "rotation_cap": self._rotation_cap,
             }
-        for w, alive, replied, steals, inflight, pending in rows:
+        for w, alive, replied, steals, inflight, pending, load in rows:
             row = {"worker": w.idx, "pid": w.pid, "alive": alive,
                    "replied": replied, "steals": steals,
-                   "inflight": inflight, "pending": pending}
+                   "inflight": inflight, "pending": pending, "load": load}
             if live:
                 server = w.live_stats()
                 if server is not None:
                     row["server"] = server
+                    self._absorb_worker(w.idx, server)
             per_worker.append(row)
         out["per_worker"] = per_worker
         if self.placement is not None:
             out["placement"] = self.placement
         return out
+
+    def health(self) -> dict:
+        """Liveness doc for ``GET /healthz``: the fleet never respawns a
+        worker after its initial backoff ladder, so any non-alive worker is
+        down for good — the doc goes non-ok and names it."""
+        with self._cv:
+            total = len(self._workers)
+            dead = [w.idx for w in self._workers if not w.alive]
+        ok = self._started and total > 0 and not dead
+        return {"ok": ok, "workers": total, "alive": total - len(dead),
+                "dead_workers": dead}
+
+    def refresh_metrics(self) -> None:
+        """Update fleet gauges and pull each live worker's registry
+        snapshot (stats RPC) just before a ``/metrics`` render."""
+        if not _metrics.enabled():
+            return
+        with self._cv:
+            rows = [(w, w.idx, w.alive, w.load(), len(w.inflight))
+                    for w in self._workers]
+        _metrics.gauge("brc_fleet_workers_alive",
+                       "Live fleet workers").set(
+                           sum(1 for r in rows if r[2]))
+        for w, idx, alive, load, inflight in rows:
+            _metrics.gauge("brc_fleet_worker_up",
+                           "Per-worker liveness (1 up, 0 down)",
+                           worker=str(idx)).set(1 if alive else 0)
+            _metrics.gauge("brc_fleet_worker_load",
+                           "Queued lane-round weight per worker "
+                           "(round_cap x instances over inflight+pending)",
+                           worker=str(idx)).set(load)
+            _metrics.gauge("brc_fleet_worker_inflight",
+                           "Requests in flight per worker",
+                           worker=str(idx)).set(inflight)
+            self._absorb_worker(idx, w.live_stats())
 
     def compile_counts(self) -> list:
         """Per-worker compile counters (the loadgen's per-worker

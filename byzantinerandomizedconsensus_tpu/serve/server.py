@@ -45,12 +45,14 @@ GET /stats and GET /healthz for monitoring.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from typing import Optional
 
 from byzantinerandomizedconsensus_tpu.backends import batch as _batch
 from byzantinerandomizedconsensus_tpu.backends import compaction as _compaction
+from byzantinerandomizedconsensus_tpu.obs import metrics as _metrics
 from byzantinerandomizedconsensus_tpu.obs import record as _record
 from byzantinerandomizedconsensus_tpu.obs import trace as _trace
 from byzantinerandomizedconsensus_tpu.serve import admission as _admission
@@ -63,14 +65,17 @@ class ServeRequest:
     reply record once the last instance retires. ``wait()`` blocks the
     submitting thread until then."""
 
-    __slots__ = ("id", "cfg", "bucket", "t_submit", "t_reply", "result",
-                 "record", "error", "done")
+    __slots__ = ("id", "cfg", "bucket", "t_submit", "t_dispatch", "t_reply",
+                 "result", "record", "error", "done")
 
     def __init__(self, rid: str, cfg, bucket):
         self.id = rid
         self.cfg = cfg
         self.bucket = bucket
         self.t_submit = time.perf_counter()
+        # stamped when the request enters a live grid (feed push or seed) —
+        # splits latency into queue wait vs grid service for the histograms
+        self.t_dispatch: Optional[float] = None
         self.t_reply: Optional[float] = None
         self.result = None
         self.record: Optional[dict] = None
@@ -189,6 +194,7 @@ class ConsensusServer:
             if self._active is not None and self._active[0] == bucket:
                 try:
                     self._active[1].push(cfg, token=req)
+                    req.t_dispatch = time.perf_counter()
                     self._active[2].append(req)
                     placed = True
                 except RuntimeError:
@@ -220,6 +226,7 @@ class ConsensusServer:
                 # close cannot land mid-seed
                 for req in reqs:
                     feed.push(req.cfg, token=req)
+                    req.t_dispatch = time.perf_counter()
                 run_reqs = list(reqs)
                 self._active = (bucket, feed, run_reqs)
                 # keep the feed open only when this bucket is the sole
@@ -250,6 +257,22 @@ class ConsensusServer:
         req.record = self._reply_record(req, result)
         with self._cv:
             self._replied += 1
+        if _metrics.enabled():
+            _metrics.counter("brc_serve_replied_total",
+                             "Replies streamed back at retire").inc()
+            _metrics.histogram(
+                "brc_serve_request_latency_seconds",
+                "End-to-end request latency (admit to reply)").observe(
+                    req.latency_s)
+            if req.t_dispatch is not None:
+                _metrics.histogram(
+                    "brc_serve_queue_wait_seconds",
+                    "Admit-to-dispatch wait (time queued for a grid)"
+                ).observe(max(0.0, req.t_dispatch - req.t_submit))
+                _metrics.histogram(
+                    "brc_serve_service_seconds",
+                    "Dispatch-to-reply grid service time").observe(
+                        max(0.0, req.t_reply - req.t_dispatch))
         _trace.event("serve.reply", id=req.id, bucket=req.bucket.label(),
                      latency_s=round(req.latency_s, 6))
         req.done.set()
@@ -259,6 +282,8 @@ class ConsensusServer:
     def _fail(self, req: ServeRequest, why: str) -> None:
         req.error = why
         self._failed += 1
+        _metrics.counter("brc_serve_failed_total",
+                         "Requests failed after admission").inc()
         req.done.set()
 
     def _reply_record(self, req: ServeRequest, result) -> dict:
@@ -275,10 +300,20 @@ class ConsensusServer:
     # -- monitoring --------------------------------------------------------
 
     def stats(self) -> dict:
+        alive = self._thread is not None and self._thread.is_alive()
         with self._cv:
             active = self._active[0].label() if self._active else None
             feed_depth = self._active[1].pending() if self._active else 0
             pending = {b.label(): len(v) for b, v in self._pending.items()}
+            inflight = (sum(1 for r in self._active[2]
+                            if not r.done.is_set())
+                        if self._active else 0)
+            load = 0
+            if self._active is not None:
+                load += sum(r.cfg.round_cap * r.cfg.instances
+                            for r in self._active[2] if not r.done.is_set())
+            for reqs in self._pending.values():
+                load += sum(r.cfg.round_cap * r.cfg.instances for r in reqs)
             out = {
                 "submitted": self._submitted,
                 "feed_depth": feed_depth,
@@ -288,9 +323,42 @@ class ConsensusServer:
                 "pending": pending,
                 "policy": self._policy.doc(),
                 "round_cap_ceiling": self._ceiling,
+                # one-shape rule (round 16): the single-grid server reports
+                # the same worker/per_worker surface as the fleet, so /stats
+                # consumers never branch on worker count
+                "workers": 1,
+                "alive": 1 if alive else 0,
+                "per_worker": [{
+                    "worker": 0, "pid": os.getpid(), "alive": alive,
+                    "replied": self._replied, "steals": 0,
+                    "inflight": inflight, "pending": pending, "load": load,
+                }],
             }
         out["compile_cache"] = _batch.compile_cache(self._backend).stats()
         return out
+
+    def health(self) -> dict:
+        """Liveness doc for ``GET /healthz``: ok iff the dispatcher thread
+        is running (same shape as the fleet's per-worker report)."""
+        alive = self._thread is not None and self._thread.is_alive()
+        return {"ok": bool(alive), "workers": 1, "alive": 1 if alive else 0,
+                "dead_workers": [] if alive else [0]}
+
+    def refresh_metrics(self) -> None:
+        """Update the point-in-time gauges just before a ``/metrics``
+        render (counters and histograms update at their own seams)."""
+        if not _metrics.enabled():
+            return
+        st = self.stats()
+        _metrics.gauge("brc_serve_feed_depth",
+                       "Configs pending in the active WorkFeed").set(
+                           st["feed_depth"])
+        _metrics.gauge("brc_serve_pending_requests",
+                       "Requests queued behind another bucket's grid").set(
+                           sum(st["pending"].values()))
+        _metrics.gauge("brc_compile_cache_entries",
+                       "Programs resident in the CompileCache").set(
+                           st["compile_cache"]["entries"])
 
     def compile_count(self) -> int:
         """Compiles so far — the loadgen's zero-steady-state probe."""
@@ -322,6 +390,15 @@ def serve_http(server: ConsensusServer, host: str = "127.0.0.1",
             self.end_headers()
             self.wfile.write(body)
 
+        def _reply_text(self, code: int, text: str,
+                        content_type: str = _metrics.CONTENT_TYPE) -> None:
+            body = text.encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def _read_payload(self):
             length = int(self.headers.get("Content-Length") or 0)
             raw = self.rfile.read(length) if length else b"{}"
@@ -329,7 +406,19 @@ def serve_http(server: ConsensusServer, host: str = "127.0.0.1",
 
         def do_GET(self):  # noqa: N802 — stdlib handler name
             if self.path == "/healthz":
-                return self._reply(200, {"ok": True})
+                # per-worker liveness (round 16): 503 + the dead worker
+                # list when any worker is down and un-respawned
+                health = getattr(server, "health", None)
+                doc = health() if health is not None else {"ok": True}
+                return self._reply(200 if doc.get("ok") else 503, doc)
+            if self.path == "/metrics":
+                # point-in-time gauges refresh at scrape; everything else
+                # accumulated at its seam. Valid exposition text either
+                # way — a disabled plane answers with a comment line.
+                refresh = getattr(server, "refresh_metrics", None)
+                if refresh is not None:
+                    refresh()
+                return self._reply_text(200, _metrics.render())
             if self.path == "/stats":
                 return self._reply(200, server.stats())
             if self.path.startswith("/result/"):
@@ -390,6 +479,10 @@ def main(argv=None) -> int:
                     help="max admitted round_cap; pins the drain program")
     ap.add_argument("--trace-dir", default=None,
                     help="write a serve trace JSONL under this directory")
+    ap.add_argument("--metrics", action="store_true",
+                    help="enable the live metrics plane (GET /metrics, "
+                         "Prometheus text format; BRC_METRICS=1 does the "
+                         "same; docs/OBSERVABILITY.md §3g)")
     ap.add_argument("--workers", type=int, default=1,
                     help="worker count: 1 runs the single-grid server, "
                          ">1 the fleet dispatcher (serve/fleet.py — "
@@ -400,6 +493,10 @@ def main(argv=None) -> int:
     if args.trace_dir:
         _trace.configure(out_dir=args.trace_dir,
                          role="fleet-coord" if args.workers > 1 else "serve")
+    if args.metrics:
+        _metrics.configure()
+    else:
+        _metrics.maybe_enable_from_env()
     _devices.ensure_live_backend()
     policy = _compaction.CompactionPolicy.parse(args.policy)
     if args.workers > 1:

@@ -79,6 +79,7 @@ def main(argv=None) -> int:
     emit = _protocol_writer(proto)
 
     from byzantinerandomizedconsensus_tpu.backends import batch as _batch
+    from byzantinerandomizedconsensus_tpu.obs import metrics as _metrics
     from byzantinerandomizedconsensus_tpu.obs import programs as _programs
     from byzantinerandomizedconsensus_tpu.obs import trace as _trace
 
@@ -87,6 +88,10 @@ def main(argv=None) -> int:
     out_dir = os.environ.get(_trace.TRACE_ENV)
     if out_dir:
         _trace.configure(out_dir=out_dir, role=f"fleet-w{args.index}")
+    # Same self-enable discipline for the metrics plane: the parent sets
+    # BRC_METRICS, the worker's registry snapshot rides every stats/bye
+    # frame, and the parent absorbs it under a worker label.
+    _metrics.maybe_enable_from_env()
     _batch.maybe_enable_cache_from_env()
     _programs.maybe_enable_from_env()
 
@@ -149,6 +154,11 @@ def main(argv=None) -> int:
         st["pid"] = os.getpid()
         if placement is not None:
             st["placement"] = placement
+        if _metrics.enabled():
+            # gauges are scrape-time state; refresh before snapshotting so
+            # the parent's /metrics shows this worker as of this frame
+            server.refresh_metrics()
+            st["metrics"] = _metrics.snapshot()
         return st
 
     with server:

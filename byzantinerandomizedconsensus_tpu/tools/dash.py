@@ -1,0 +1,208 @@
+"""Live terminal dashboard over the metrics plane (round 16).
+
+``brc-tpu dash`` polls a serving endpoint's ``GET /metrics`` (the
+Prometheus text exposition from obs/metrics.py) and renders a compact
+terminal view: request p50/p99 + throughput, admission/rejection
+counters, grid occupancy and refill depth, compile-cache deltas (the
+zero-steady-state-recompile pin, live), consensus health (decided
+fraction + a rounds-to-decision sparkline) and the per-worker fleet
+table (up/load/inflight, steals, respawns, orphan re-admissions).
+
+Stdlib only, read-only, and resilient: a dead endpoint renders an
+UNREACHABLE frame and keeps polling — the dash never takes the service
+down with it. Rates are derived client-side from successive scrapes of
+the monotonic counters.
+
+Usage::
+
+    python -m byzantinerandomizedconsensus_tpu.serve.server --metrics &
+    python -m byzantinerandomizedconsensus_tpu.cli dash          # default URL
+    brc-tpu dash --url http://127.0.0.1:8787 --interval 1
+    brc-tpu dash --once                # one frame, no ANSI (CI/tests)
+
+See docs/OBSERVABILITY.md §3g for the metric-name table this reads.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from byzantinerandomizedconsensus_tpu.obs import metrics as _metrics
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _metrics_url(url: str) -> str:
+    url = url.rstrip("/")
+    return url if url.endswith("/metrics") else url + "/metrics"
+
+
+def _val(snap, name, **labels) -> float | None:
+    """Sum of a family's series values, optionally filtered by labels."""
+    rows = [r for r in _metrics._series_of(snap, name)
+            if all(r.get("labels", {}).get(k) == v
+                   for k, v in labels.items())]
+    if not rows:
+        return None
+    return float(sum(r.get("value", 0.0) for r in rows))
+
+
+def _by_label(snap, name, label) -> dict:
+    out = {}
+    for r in _metrics._series_of(snap, name):
+        key = r.get("labels", {}).get(label)
+        if key is not None:
+            out[key] = out.get(key, 0.0) + float(r.get("value", 0.0))
+    return out
+
+
+def _fmt(v, unit="", nd=1) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float) and not v.is_integer():
+        return f"{v:.{nd}f}{unit}"
+    return f"{int(v)}{unit}"
+
+
+def _sparkline(series) -> str:
+    """Non-cumulative histogram cell counts → a block sparkline (the +Inf
+    cell rides the end)."""
+    if not series:
+        return ""
+    counts = [0] * (len(series[0]["counts"]))
+    for s in series:
+        for i, c in enumerate(s["counts"]):
+            if i < len(counts):
+                counts[i] += int(c)
+    peak = max(counts) or 1
+    return "".join(_SPARK[min(len(_SPARK) - 1,
+                              int(c / peak * (len(_SPARK) - 1)))]
+                   for c in counts)
+
+
+def render_frame(snap, prev=None, dt: float | None = None,
+                 url: str = "") -> str:
+    """One dashboard frame as plain text (None snap → UNREACHABLE)."""
+    lines = []
+    stamp = time.strftime("%H:%M:%S")
+    if snap is None:
+        lines.append(f"brc-tpu dash  {stamp}  {url}  ** UNREACHABLE **")
+        lines.append("  (endpoint down or metrics disabled — serve with "
+                     "--metrics or BRC_METRICS=1)")
+        return "\n".join(lines) + "\n"
+
+    s = _metrics.summary(snap)
+    lines.append(f"brc-tpu dash  {stamp}  {url}")
+
+    rate = ""
+    if prev is not None and dt and dt > 0:
+        r0 = _val(prev, "brc_serve_replied_total") or 0.0
+        r1 = _val(snap, "brc_serve_replied_total") or 0.0
+        rate = f"  rate {max(0.0, (r1 - r0) / dt):.1f} req/s"
+    lines.append(
+        f"  serve    p50 {_fmt(s['p50_latency_ms'], 'ms')}  "
+        f"p99 {_fmt(s['p99_latency_ms'], 'ms')}  "
+        f"replied {_fmt(s['replied'])}  failed {_fmt(s['failed'])}  "
+        f"err {_fmt(s['error_rate'], nd=4)}{rate}")
+
+    rejected = _by_label(snap, "brc_serve_rejected_total", "reason")
+    rej = (" ".join(f"{k}={int(v)}" for k, v in sorted(rejected.items()))
+           or "none")
+    lines.append(
+        f"  admit    admitted {_fmt(_val(snap, 'brc_serve_admitted_total'))}"
+        f"  pending {_fmt(_val(snap, 'brc_serve_pending_requests'))}"
+        f"  feed-depth {_fmt(_val(snap, 'brc_serve_feed_depth'))}"
+        f"  rejected: {rej}")
+
+    lines.append(
+        f"  grid     occupancy {_fmt(_val(snap, 'brc_compaction_occupancy'), nd=3)}"
+        f"  live-lanes {_fmt(_val(snap, 'brc_compaction_live_lanes'))}"
+        f"  refill-depth {_fmt(_val(snap, 'brc_compaction_refill_depth'))}"
+        f"  segments {_fmt(_val(snap, 'brc_compaction_segments_total'))}"
+        f"  refills {_fmt(_val(snap, 'brc_compaction_refills_total'))}")
+
+    compiles = _val(snap, "brc_compile_cache_compiles_total")
+    steady = ""
+    if prev is not None and compiles is not None:
+        delta = compiles - (_val(prev, "brc_compile_cache_compiles_total")
+                            or 0.0)
+        steady = (f"  steady-state {'OK (+0)' if delta == 0 else f'+{int(delta)} COMPILES'}")
+    lines.append(
+        f"  compile  hits {_fmt(_val(snap, 'brc_compile_cache_hits_total'))}"
+        f"  compiles {_fmt(compiles)}"
+        f"  evictions {_fmt(_val(snap, 'brc_compile_cache_evictions_total'))}"
+        f"  entries {_fmt(_val(snap, 'brc_compile_cache_entries'))}{steady}")
+
+    rounds = _metrics._series_of(snap, "brc_consensus_rounds")
+    spark = _sparkline(rounds)
+    lines.append(
+        f"  decide   fraction {_fmt(s['decided_fraction'], nd=4)}"
+        f"  decided {_fmt(_val(snap, 'brc_consensus_decided_total'))}"
+        f"  undecided {_fmt(_val(snap, 'brc_consensus_undecided_total'))}"
+        f"  fault-silenced {_fmt(_val(snap, 'brc_consensus_fault_silenced_total'))}"
+        + (f"  rounds {spark}" if spark else ""))
+
+    alive = _val(snap, "brc_fleet_workers_alive")
+    if alive is not None:
+        lines.append(
+            f"  fleet    alive {_fmt(alive)}"
+            f"  steals {_fmt(_val(snap, 'brc_fleet_steals_total'))}"
+            f"  readmitted {_fmt(_val(snap, 'brc_fleet_readmitted_total'))}"
+            f"  lost {_fmt(_val(snap, 'brc_fleet_workers_lost_total'))}"
+            f"  respawns {_fmt(_val(snap, 'brc_fleet_respawns_total'))}")
+        up = _by_label(snap, "brc_fleet_worker_up", "worker")
+        load = _by_label(snap, "brc_fleet_worker_load", "worker")
+        infl = _by_label(snap, "brc_fleet_worker_inflight", "worker")
+        for w in sorted(up, key=lambda x: int(x) if x.isdigit() else 0):
+            mark = "up" if up[w] else "DOWN"
+            lines.append(f"    w{w:<3} {mark:<5} "
+                         f"load {_fmt(load.get(w))}  "
+                         f"inflight {_fmt(infl.get(w))}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="brc-tpu dash",
+        description="Live terminal view over a serving endpoint's "
+                    "GET /metrics (obs/metrics.py exposition).")
+    ap.add_argument("--url", default="http://127.0.0.1:8787",
+                    help="endpoint base URL or full /metrics URL "
+                         "(default http://127.0.0.1:8787)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="poll interval, seconds (default 2)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame without ANSI control codes and "
+                         "exit (nonzero when the endpoint is unreachable)")
+    ap.add_argument("--frames", type=int, default=None,
+                    help="stop after N frames (default: run until ^C)")
+    args = ap.parse_args(argv)
+
+    url = _metrics_url(args.url)
+    prev = None
+    t_prev = None
+    n = 0
+    try:
+        while True:
+            snap = _metrics.scrape(url, timeout=5.0)
+            now = time.monotonic()
+            dt = (now - t_prev) if t_prev is not None else None
+            frame = render_frame(snap, prev=prev, dt=dt, url=url)
+            if args.once:
+                sys.stdout.write(frame)
+                return 0 if snap is not None else 1
+            sys.stdout.write("\x1b[2J\x1b[H" + frame)
+            sys.stdout.flush()
+            prev, t_prev = snap, now
+            n += 1
+            if args.frames is not None and n >= args.frames:
+                return 0
+            time.sleep(max(0.1, args.interval))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

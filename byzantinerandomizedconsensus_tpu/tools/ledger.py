@@ -290,6 +290,28 @@ def _fleet_rows_of(name: str, doc) -> list:
     return rows
 
 
+def _metrics_rows_of(name: str, doc) -> list:
+    """Schema-v1.7 ``metrics`` blocks of one artifact: (path, family count,
+    series count, scraped p99 / decided fraction, SLO verdict) rows — the
+    ledger's live-metrics-plane columns."""
+    from byzantinerandomizedconsensus_tpu.obs import record as _record
+
+    rows = []
+    for path, mt in _blocks_of(doc, "metrics", _record.METRICS_BLOCK_KEYS):
+        names = mt.get("names")
+        slo = mt.get("slo") if isinstance(mt.get("slo"), dict) else None
+        rows.append({
+            "artifact": name,
+            "path": path,
+            "families": len(names) if isinstance(names, list) else None,
+            "series": mt.get("series"),
+            "p99_latency_ms": mt.get("p99_latency_ms"),
+            "decided_fraction": mt.get("decided_fraction"),
+            "slo_ok": slo.get("ok") if slo else None,
+        })
+    return rows
+
+
 def sentinel_verdict(bench: dict, wall_chain: list,
                      programs_rows: list) -> dict:
     """The ``--check`` verdict: wall-chain regressions past
@@ -513,6 +535,12 @@ def build_ledger(root=None) -> dict:
     for name, doc in sorted(docs.items()):
         fleet_rows.extend(_fleet_rows_of(name, doc))
 
+    # ---- live-metrics-plane columns (schema v1.7, round 16): every
+    # committed artifact carrying a metrics block.
+    metrics_rows = []
+    for name, doc in sorted(docs.items()):
+        metrics_rows.extend(_metrics_rows_of(name, doc))
+
     from byzantinerandomizedconsensus_tpu.obs import record
 
     return {
@@ -528,6 +556,7 @@ def build_ledger(root=None) -> dict:
         "programs_rows": programs_rows,
         "serve_rows": serve_rows,
         "fleet_rows": fleet_rows,
+        "metrics_rows": metrics_rows,
         "bench_rounds": {str(r): bench[r] for r in rounds_seen},
         "wall_chain": chain,
         "device_chain": device_chain,
@@ -642,6 +671,19 @@ def format_report(doc: dict) -> str:
                 f"{row['replied']} replied, {row['cfg_per_s']} cfg/s, "
                 f"{row['steals']} steals, "
                 f"{row['steady_state_compiles']} steady-state compiles")
+    # Present only once an artifact carries the v1.7 metrics block.
+    if doc.get("metrics_rows"):
+        lines.append("live-metrics-plane columns (schema v1.7 — "
+                     "artifact[path]: families/series scraped-p99 "
+                     "decided-fraction slo):")
+        for row in doc["metrics_rows"]:
+            slo = row["slo_ok"]
+            slo_s = "n/a" if slo is None else ("OK" if slo else "FAIL")
+            lines.append(
+                f"  {row['artifact']}[{row['path']}]: "
+                f"{row['families']} families / {row['series']} series, "
+                f"p99 {row['p99_latency_ms']} ms, "
+                f"decided {row['decided_fraction']}, slo {slo_s}")
     sent = doc.get("sentinel")
     if sent is not None:
         lines.append(

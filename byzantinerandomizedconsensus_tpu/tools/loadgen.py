@@ -68,8 +68,21 @@ round-15 committed artifact::
         --requests 200 --seed 15 --rate 4 --trace \\
         --out artifacts/serve_fleet_r15.json
 
+**SLO enforcement (round 16)** — ``--slo-p99-ms`` / ``--slo-error-rate``
+turn the run into a gate against the *live metrics plane*: the in-process
+server (or each fleet leg) is wrapped in a real ephemeral
+``serve_http(port=0)`` endpoint, ``GET /metrics`` is scraped at every
+phase boundary (warm-up, burst, open-loop), and the final scrape —
+the same Prometheus text a production scraper reads, parsed by
+``obs.metrics.parse_text`` — is enforced by exit code. In fleet mode the
+scrape-and-enforce happens at **every worker width**, each leg against a
+fresh registry, so a p99 regression at any width fails the run. The
+artifact records the scraped digest + verdict as the schema-v1.7
+``metrics`` block.
+
 Exit codes: 1 differential mismatch, 2 steady-state compiles, 3 invalid
-record, 4 fleet scaling below ``--min-scaling``.
+record, 4 fleet scaling below ``--min-scaling``, 5 SLO breach
+(``--slo-p99-ms`` / ``--slo-error-rate`` vs the live ``/metrics`` scrape).
 """
 
 from __future__ import annotations
@@ -89,6 +102,7 @@ import numpy as np
 from byzantinerandomizedconsensus_tpu.backends import compaction as _compaction
 from byzantinerandomizedconsensus_tpu.config import (
     DELIVERY_KINDS, SimConfig)
+from byzantinerandomizedconsensus_tpu.obs import metrics as _metrics
 from byzantinerandomizedconsensus_tpu.obs import record
 from byzantinerandomizedconsensus_tpu.obs import trace as _trace
 from byzantinerandomizedconsensus_tpu.tools import soak
@@ -261,6 +275,67 @@ def _leg_metrics(handles, t0: float, t_first_reply, t_last_reply) -> dict:
     }
 
 
+class _MetricsEndpoint:
+    """The live scrape surface for SLO enforcement: a real ephemeral
+    ``serve_http`` endpoint (``port=0``) around the in-process server, so
+    the enforced numbers come from ``GET /metrics`` text — the surface a
+    production scraper reads — never from in-process shortcuts."""
+
+    def __init__(self, server):
+        from byzantinerandomizedconsensus_tpu.serve.server import serve_http
+
+        self._httpd = serve_http(server, host="127.0.0.1", port=0)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="brc-loadgen-metrics", daemon=True)
+        self._thread.start()
+        host, port = self._httpd.server_address[:2]
+        self.url = f"http://{host}:{port}/metrics"
+
+    def scrape(self):
+        """Parsed snapshot of the live exposition text (None on failure)."""
+        return _metrics.scrape(self.url, timeout=30.0)
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def _slo_enabled(args) -> bool:
+    return args.slo_p99_ms is not None or args.slo_error_rate is not None
+
+
+def _slo_verdict(args, snap) -> dict:
+    """Enforce the SLO thresholds against one parsed ``/metrics`` scrape.
+
+    A missing observation (scrape failed, or no latency samples landed)
+    fails the check — an SLO that cannot be measured is not met."""
+    s = _metrics.summary(snap or {})
+    checks = {}
+    ok = True
+    if args.slo_p99_ms is not None:
+        got = s.get("p99_latency_ms")
+        passed = got is not None and got <= args.slo_p99_ms
+        checks["p99_latency_ms"] = {"limit": args.slo_p99_ms,
+                                    "observed": got, "ok": passed}
+        ok = ok and passed
+    if args.slo_error_rate is not None:
+        got = s.get("error_rate")
+        passed = got is not None and got <= args.slo_error_rate
+        checks["error_rate"] = {"limit": args.slo_error_rate,
+                                "observed": got, "ok": passed}
+        ok = ok and passed
+    return {"ok": ok, "source": "GET /metrics", "checks": checks}
+
+
+def _slo_print(tag: str, verdict: dict) -> None:
+    parts = ", ".join(
+        f"{k} {c['observed']} vs limit {c['limit']}"
+        for k, c in verdict["checks"].items())
+    status = "OK" if verdict["ok"] else "BREACH"
+    print(f"loadgen: SLO {status} [{tag}]: {parts}")
+
+
 def _drive(server, stream, open_loop: bool) -> dict:
     """Submit the stream (at its arrival schedule, or all at once) and wait
     for every reply. Returns the leg metrics + the reply handles."""
@@ -359,12 +434,19 @@ def _fleet_leg(args, policy, k: int, stream, buckets,
     the reply handles for the differential."""
     from byzantinerandomizedconsensus_tpu.serve.fleet import FleetServer
 
+    if _slo_enabled(args):
+        # Fresh registry per worker width: each leg's scrape answers for
+        # its own width only, so a p99 regression at x2 cannot hide
+        # behind x4's samples (every leg is enforced; exit 5 on any).
+        _metrics.configure()
     fleet = FleetServer(
         workers=k, mode="process", backend=args.backend, policy=policy,
         round_cap_ceiling=ROUND_CAP_CEILING, trace_dir=trace_dir,
         segment_latency_s=args.fleet_latency_ms / 1000.0,
         rotation_cap=args.rotation_cap)
     with fleet:
+        endpoint = _MetricsEndpoint(fleet) if _slo_enabled(args) else None
+        phase_scrapes = {}
         t0 = time.perf_counter()
         warm_handles = warm_up_fleet(fleet, buckets)
         for h in warm_handles:
@@ -373,6 +455,9 @@ def _fleet_leg(args, policy, k: int, stream, buckets,
         warm_s = time.perf_counter() - t0
         print(f"loadgen: fleet x{k} warm-up {len(warm_handles)} requests, "
               f"compiles/worker {warm_counts}, {warm_s:.1f}s")
+        if endpoint:
+            phase_scrapes["warm_up"] = _metrics.summary(
+                endpoint.scrape() or {})
 
         pre = {r["worker"]: r["replied"]
                for r in fleet.stats(live=False)["per_worker"]}
@@ -381,6 +466,8 @@ def _fleet_leg(args, policy, k: int, stream, buckets,
                          for r in fleet.stats(live=False)["per_worker"]}
         print(f"loadgen: fleet x{k} burst {burst_leg['throughput_cps']} "
               f"cfg/s (per-worker replied {burst_replied})")
+        if endpoint:
+            phase_scrapes["burst"] = _metrics.summary(endpoint.scrape() or {})
 
         open_leg = open_handles = None
         if headline:
@@ -392,6 +479,12 @@ def _fleet_leg(args, policy, k: int, stream, buckets,
         steady = [(c or 0) - w for c, w
                   in zip(fleet.compile_counts(), warm_counts)]
         stats = fleet.stats()
+        final_snap = None
+        if endpoint:
+            final_snap = endpoint.scrape()
+            phase_scrapes["open_loop" if headline else "burst_final"] = (
+                _metrics.summary(final_snap or {}))
+            endpoint.close()
     span = burst_leg["duration_s"] or 0.0
     per_worker = []
     for row in stats["per_worker"]:
@@ -420,6 +513,8 @@ def _fleet_leg(args, policy, k: int, stream, buckets,
         "readmitted": stats["readmitted"],
         "lost_workers": stats["lost_workers"],
         "stats": stats,
+        "metrics_scrapes": phase_scrapes or None,
+        "_snap": final_snap,
         "_handles": [("burst", burst_handles)]
                     + ([("open_loop", open_handles)] if open_handles
                        else []),
@@ -450,6 +545,28 @@ def _run_fleet(args, policy, workers_list, stream, digest, cfgs, buckets,
             leg_handles.append((f"x{k}/{name}", handles))
         legs[str(k)] = leg
     head = legs[str(headline_k)]
+
+    slo = None
+    head_snap = None
+    if _slo_enabled(args):
+        # Every worker width is enforced against its own live scrape; the
+        # run passes only if every leg passes.
+        per_width = {}
+        all_ok = True
+        for k in workers_list:
+            snap = legs[str(k)].pop("_snap", None)
+            v = _slo_verdict(args, snap)
+            _slo_print(f"x{k}", v)
+            per_width[str(k)] = v
+            all_ok = all_ok and v["ok"]
+            if k == headline_k:
+                head_snap = snap
+        slo = {"ok": all_ok, "source": "GET /metrics",
+               "checks": per_width[str(headline_k)]["checks"],
+               "per_width": per_width}
+    else:
+        for k in workers_list:
+            legs[str(k)].pop("_snap", None)
 
     differential = _fleet_differential(args.backend, policy, cfgs,
                                        leg_handles)
@@ -521,6 +638,10 @@ def _run_fleet(args, policy, workers_list, stream, digest, cfgs, buckets,
         doc["summary"] = {
             f"scaling_{headline_k}w_vs_1w": (round(peak / base, 3)
                                              if base else None)}
+    if slo is not None:
+        blk = record.metrics_block(head_snap, slo=slo)
+        if blk is not None:
+            doc["metrics"] = blk
     if args.trace and trace_dir is not None:
         _trace.disable()
         merged = _trace.merge(trace_dir)
@@ -554,6 +675,10 @@ def _run_fleet(args, policy, workers_list, stream, digest, cfgs, buckets,
             print(f"loadgen: fleet scaling below --min-scaling "
                   f"{args.min_scaling}", file=sys.stderr)
             return 4
+    if slo is not None and not slo["ok"]:
+        print("loadgen: SLO BREACH (see per-width checks above)",
+              file=sys.stderr)
+        return 5
     return 0
 
 
@@ -596,6 +721,14 @@ def main(argv=None) -> int:
     ap.add_argument("--min-scaling", type=float, default=None,
                     help="fleet mode: exit 4 if headline-vs-1-worker burst "
                          "scaling falls below this factor")
+    ap.add_argument("--slo-p99-ms", type=float, default=None,
+                    help="enforce p99 request latency (ms) against a live "
+                         "GET /metrics scrape at every phase boundary — "
+                         "and, in fleet mode, at every worker width; "
+                         "breach = exit 5 (enables the metrics registry)")
+    ap.add_argument("--slo-error-rate", type=float, default=None,
+                    help="enforce failed/(replied+failed) against the same "
+                         "live /metrics scrape; breach = exit 5")
     ap.add_argument("--rotation-cap", type=int, default=64,
                     help="fleet mode: max instance-lanes per dispatched "
                          "rotation (work-sharing granularity; default = one "
@@ -633,6 +766,11 @@ def main(argv=None) -> int:
     if args.trace and not fleet_mode:
         _trace.configure(path=trace_path)
 
+    if _slo_enabled(args):
+        # The SLO gate reads the live metrics plane; enforcing it with
+        # the registry inert would vacuously fail every check.
+        _metrics.configure()
+
     _devices.ensure_live_backend()
     policy = _compaction.CompactionPolicy.parse(args.policy)
     stream = fleet_request_stream(args.requests, args.seed, args.rate,
@@ -656,6 +794,8 @@ def main(argv=None) -> int:
     server = ConsensusServer(backend=args.backend, policy=policy,
                              round_cap_ceiling=ROUND_CAP_CEILING)
     with server:
+        endpoint = _MetricsEndpoint(server) if _slo_enabled(args) else None
+        phase_scrapes = {}
         t_warm0 = time.perf_counter()
         warm_handles = warm_up(server, buckets)
         for h in warm_handles:
@@ -664,10 +804,15 @@ def main(argv=None) -> int:
         warmup_compiles = server.compile_count()
         print(f"loadgen: warm-up {len(warm_handles)} requests, "
               f"{warmup_compiles} compiles, {warm_s:.1f}s")
+        if endpoint:
+            phase_scrapes["warm_up"] = _metrics.summary(
+                endpoint.scrape() or {})
 
         burst_leg, _burst_handles = _drive(server, stream, open_loop=False)
         print(f"loadgen: burst leg {burst_leg['throughput_cps']} cfg/s "
               f"(p50 {burst_leg['latency_ms']['p50']}ms)")
+        if endpoint:
+            phase_scrapes["burst"] = _metrics.summary(endpoint.scrape() or {})
 
         open_leg, open_handles = _drive(server, stream, open_loop=True)
         print(f"loadgen: open-loop leg p50 {open_leg['latency_ms']['p50']}ms "
@@ -675,6 +820,11 @@ def main(argv=None) -> int:
 
         steady_compiles = server.compile_count() - warmup_compiles
         server_stats = server.stats()
+        final_snap = None
+        if endpoint:
+            final_snap = endpoint.scrape()
+            phase_scrapes["open_loop"] = _metrics.summary(final_snap or {})
+            endpoint.close()
 
     differential = _differential(cfgs, open_handles)
     offline_leg = (None if args.no_offline
@@ -723,6 +873,14 @@ def main(argv=None) -> int:
                 burst_leg["throughput_cps"]
                 / offline_leg["throughput_cps"], 3),
         }
+    slo = None
+    if _slo_enabled(args):
+        slo = _slo_verdict(args, final_snap)
+        _slo_print("open_loop", slo)
+        blk = record.metrics_block(final_snap, slo=slo)
+        if blk is not None:
+            blk["phase_scrapes"] = phase_scrapes
+            doc["metrics"] = blk
     if args.trace:
         _trace.disable()
         blk = record.trace_block(trace_path)
@@ -741,6 +899,9 @@ def main(argv=None) -> int:
         return 1
     if steady_compiles:
         return 2
+    if slo is not None and not slo["ok"]:
+        print("loadgen: SLO BREACH (see checks above)", file=sys.stderr)
+        return 5
     return 0
 
 

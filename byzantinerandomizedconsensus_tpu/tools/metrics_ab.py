@@ -1,0 +1,208 @@
+"""Metrics-plane inertness + SLO artifact (round 16).
+
+The live metrics plane (obs/metrics.py) claims the same two properties
+the trace plane proved in round 12: **bit-identity** (instrumentation
+reads host-side state only — rounds/decisions never change) and
+**inertness** (disabled = one global check per site; enabled = cheap
+enough to leave on in production). This tool pins both, plus the SLO
+gate, into ``artifacts/metrics_r16.json``:
+
+1. **A/B legs** — the seeded 280-config chaos grid through the fused
+   vmapped path, metrics-off vs metrics-on, best-of-N walls; results
+   bit-compared against the warm baseline every repeat. The overhead
+   fraction is pinned at ``<= OVERHEAD_BOUND`` (2%, same bound as the
+   trace plane).
+2. **Compacted leg** — a sample of the grid through the
+   decision-driven compaction path with metrics on (this is the path
+   that feeds the consensus-health histograms at ``on_retire``),
+   bit-compared against the same baseline.
+3. **SLO loadgen leg** — a full ``tools/loadgen.py`` run with
+   ``--workers 1,2 --slo-p99-ms ... --slo-error-rate ...``: every
+   worker width is scraped over a live ephemeral ``GET /metrics``
+   endpoint and enforced by exit code (0 required here — which also
+   re-pins zero steady-state recompiles per worker with the metrics
+   plane enabled).
+
+The committed artifact::
+
+    python -m byzantinerandomizedconsensus_tpu.tools.metrics_ab \\
+        --configs 280 --seed 12 --repeats 3 --out artifacts/metrics_r16.json
+
+Exit nonzero when any pin fails (bit mismatch, overhead above bound,
+or the SLO leg's exit code).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import time
+
+#: Same inertness bar as the trace plane (tools/trace.py OVERHEAD_BOUND):
+#: an always-on plane must cost ~nothing when it is the only one enabled.
+OVERHEAD_BOUND = 0.02
+
+
+def main(argv=None) -> int:
+    import numpy as np
+
+    from byzantinerandomizedconsensus_tpu.backends import get_backend
+    from byzantinerandomizedconsensus_tpu.backends.compaction import (
+        CompactionPolicy)
+    from byzantinerandomizedconsensus_tpu.obs import metrics as _metrics
+    from byzantinerandomizedconsensus_tpu.obs import record
+    from byzantinerandomizedconsensus_tpu.tools import bench_batch
+    from byzantinerandomizedconsensus_tpu.utils.devices import (
+        ensure_live_backend)
+
+    ap = argparse.ArgumentParser(
+        prog="brc-tpu metrics-ab", description=__doc__.splitlines()[0])
+    ap.add_argument("--configs", type=int, default=280,
+                    help="chaos-grid size (the round-12 A/B population)")
+    ap.add_argument("--seed", type=int, default=12)
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--compacted-sample", type=int, default=64,
+                    help="grid prefix through the compaction path "
+                         "(metrics on, bit-compared)")
+    ap.add_argument("--slo-requests", type=int, default=24,
+                    help="request count for the SLO loadgen leg")
+    ap.add_argument("--slo-seed", type=int, default=16)
+    ap.add_argument("--slo-p99-ms", type=float, default=120000.0,
+                    help="p99 bound for the SLO leg (generous: the pin is "
+                         "that enforcement runs end-to-end off a live "
+                         "scrape, not a latency claim — CPU walls)")
+    ap.add_argument("--skip-slo", action="store_true",
+                    help="skip the loadgen SLO leg (A/B only)")
+    ap.add_argument("--out", default="artifacts/metrics_r16.json")
+    args = ap.parse_args(argv)
+
+    ensure_live_backend()
+    _metrics.disable()
+    cfgs = bench_batch.chaos_grid(args.configs, args.seed)
+    jb = get_backend("jax")
+    out = pathlib.Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+
+    print(f"warm-up: fused grid of {len(cfgs)} configs...", flush=True)
+    baseline, _ = jb.run_fused(cfgs)
+
+    def bit_identical(results, ref) -> bool:
+        return all(np.array_equal(a.rounds, b.rounds)
+                   and np.array_equal(a.decision, b.decision)
+                   for a, b in zip(ref, results))
+
+    def timed(metrics_on: bool):
+        if metrics_on:
+            _metrics.configure()
+        t0 = time.perf_counter()
+        results, _report = jb.run_fused(cfgs)
+        wall = time.perf_counter() - t0
+        if metrics_on:
+            _metrics.disable()
+        return wall, results
+
+    walls_off, walls_on = [], []
+    identical = True
+    for rep in range(args.repeats):
+        w_off, _res = timed(False)
+        w_on, res_on = timed(True)
+        walls_off.append(round(w_off, 3))
+        walls_on.append(round(w_on, 3))
+        identical = identical and bit_identical(res_on, baseline)
+        print(f"repeat {rep}: metrics-off {w_off:.2f} s, "
+              f"metrics-on {w_on:.2f} s, bit_identical={identical}",
+              flush=True)
+
+    # The compacted leg is the one that exercises the consensus-health
+    # seam (on_retire histograms + occupancy gauges). Untimed — lane
+    # recycling changes the wall by design; the pin here is the bits.
+    sample = cfgs[:args.compacted_sample]
+    _metrics.configure()
+    res_comp, _rep = jb.run_fused(sample, compaction=CompactionPolicy(
+        width=64, segment=1))
+    snap_comp = _metrics.snapshot()
+    _metrics.disable()
+    compacted_identical = bit_identical(res_comp, baseline[:len(sample)])
+    identical = identical and compacted_identical
+
+    slo_leg = None
+    slo_ok = True
+    if not args.skip_slo:
+        from byzantinerandomizedconsensus_tpu.tools import loadgen
+
+        slo_out = out.with_name(out.stem + "_slo.json")
+        lg_args = ["--workers", "1,2", "--requests", str(args.slo_requests),
+                   "--seed", str(args.slo_seed), "--rate", "16",
+                   "--slo-p99-ms", str(args.slo_p99_ms),
+                   "--slo-error-rate", "0",
+                   "--out", str(slo_out)]
+        print(f"SLO leg: loadgen {' '.join(lg_args)}", flush=True)
+        rc = loadgen.main(lg_args)
+        slo_doc = (json.loads(slo_out.read_text())
+                   if slo_out.exists() else {})
+        slo_leg = {
+            "exit_code": rc,
+            "argv": lg_args,
+            "workers_swept": slo_doc.get("workers_swept"),
+            "slo": (slo_doc.get("metrics") or {}).get("slo"),
+            "steady_state_compiles": {
+                k: leg.get("steady_state_compiles")
+                for k, leg in (slo_doc.get("legs") or {}).items()},
+        }
+        slo_ok = rc == 0
+        slo_out.unlink(missing_ok=True)  # the summary above is the record
+
+    overhead = (min(walls_on) / min(walls_off) - 1.0) if min(walls_off) \
+        else None
+    doc = {
+        **record.new_record(
+            "metrics_bench",
+            description="metrics-plane inertness A/B on the seeded chaos "
+                        "grid: fused lanes metrics-on vs metrics-off, "
+                        "best-of-N walls, results bit-compared on the "
+                        "vmapped AND compacted paths, plus the live-scrape "
+                        "SLO loadgen leg at every worker width "
+                        "(tools/metrics_ab.py; round 16)"),
+        "generator_version": bench_batch.soak.GENERATOR_VERSION,
+        "seed": args.seed,
+        "configs": args.configs,
+        "repeats": args.repeats,
+        "legs": {
+            "metrics_off": {"walls_s": walls_off, "wall_s": min(walls_off)},
+            "metrics_on": {"walls_s": walls_on, "wall_s": min(walls_on)},
+            **({"slo_loadgen": slo_leg} if slo_leg else {}),
+        },
+        "overhead_fraction": (round(overhead, 4)
+                              if overhead is not None else None),
+        "overhead_bound": OVERHEAD_BOUND,
+        "bit_identical": bool(identical),
+        "compacted_sample_configs": len(sample),
+        "compacted_bit_identical": bool(compacted_identical),
+        "metrics": record.metrics_block(snap_comp),
+        "compile_cache": record.compile_cache_block(jb),
+        "device_chain_note": (
+            "wall-only A/B; CPU XLA walls are a valid capture for the "
+            "metrics-on-vs-off ratio (host-side instrumentation only), "
+            "the r5 device chain rule still applies to any kernel-time "
+            "claim (docs/PERF.md)"),
+    }
+    problems = record.validate_record(doc)
+    if problems:
+        print(f"metrics_ab: INVALID RECORD: {problems}")
+        return 1
+    out.write_text(json.dumps(doc, indent=1) + "\n")
+    summary = {"out": str(out),
+               "overhead_fraction": doc["overhead_fraction"],
+               "bit_identical": doc["bit_identical"],
+               "compacted_bit_identical": doc["compacted_bit_identical"],
+               "slo_exit_code": slo_leg["exit_code"] if slo_leg else None}
+    print(json.dumps(summary))
+    ok = (identical and overhead is not None
+          and overhead <= OVERHEAD_BOUND and slo_ok
+          and doc["metrics"] is not None)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

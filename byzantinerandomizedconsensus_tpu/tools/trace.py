@@ -197,12 +197,38 @@ def _follow_render(state: dict) -> str:
     return "[trace] " + " | ".join(parts)
 
 
+def _metrics_heartbeat(metrics_url) -> str:
+    """The live-metrics suffix for a follow tick: scraped p99 + decided
+    fraction when a ``/metrics`` endpoint is reachable, '' otherwise —
+    the heartbeat never dies on a dead endpoint (obs/metrics.scrape
+    returns None, and a trace dir can outlive its server)."""
+    if not metrics_url:
+        return ""
+    from byzantinerandomizedconsensus_tpu.obs import metrics as _metrics
+
+    url = str(metrics_url).rstrip("/")
+    if not url.endswith("/metrics"):
+        url += "/metrics"
+    snap = _metrics.scrape(url)
+    if snap is None:
+        return ""
+    s = _metrics.summary(snap)
+    parts = []
+    if s["p99_latency_ms"] is not None:
+        parts.append(f"p99 {s['p99_latency_ms']}ms")
+    if s["decided_fraction"] is not None:
+        parts.append(f"decided {s['decided_fraction']}")
+    return " | live " + " ".join(parts) if parts else ""
+
+
 def follow(trace_dir, interval: float = 2.0, once: bool = False,
-           out=print, max_ticks=None) -> dict:
+           out=print, max_ticks=None, metrics_url=None) -> dict:
     """Tail every ``trace*.jsonl`` in ``trace_dir``: per-file byte offsets,
     only complete lines consumed, one aggregate status line per tick.
-    ``once`` (and ``max_ticks``) bound the loop for drills/tests; returns
-    the final aggregate state."""
+    ``once`` (and ``max_ticks``) bound the loop for drills/tests;
+    ``metrics_url`` appends the live p99/decided-fraction heartbeat from a
+    serving endpoint's ``/metrics`` when reachable. Returns the final
+    aggregate state."""
     trace_dir = pathlib.Path(trace_dir)
     offsets: dict = {}
     state = {"events": 0, "compiles": 0, "skips": 0, "progress": None,
@@ -233,7 +259,7 @@ def follow(trace_dir, interval: float = 2.0, once: bool = False,
                 except ValueError:
                     continue  # torn line mid-write: next tick re-reads
                 _follow_consume(state, ev, src=p.name)
-        out(_follow_render(state))
+        out(_follow_render(state) + _metrics_heartbeat(metrics_url))
         ticks += 1
         if once or (max_ticks is not None and ticks >= max_ticks):
             return state
@@ -245,7 +271,8 @@ def follow(trace_dir, interval: float = 2.0, once: bool = False,
 
 
 def cmd_follow(args) -> int:
-    follow(args.src, interval=args.interval, once=args.once)
+    follow(args.src, interval=args.interval, once=args.once,
+           metrics_url=args.metrics_url)
     return 0
 
 
@@ -382,6 +409,11 @@ def main(argv=None) -> int:
     p_fo.add_argument("--interval", type=float, default=2.0)
     p_fo.add_argument("--once", action="store_true",
                       help="one pass + one status line, then exit")
+    p_fo.add_argument("--metrics-url", default=None,
+                      help="serving endpoint base URL (or full /metrics "
+                           "URL): appends live p99 + decided-fraction "
+                           "from the metrics plane to each heartbeat "
+                           "line when reachable")
     p_fo.set_defaults(fn=cmd_follow)
 
     p_ov = sub.add_parser("overhead",

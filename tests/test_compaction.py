@@ -359,3 +359,37 @@ def test_bench_compaction_smoke(tmp_path, capsys):
     rows = ledger._compaction_rows_of("x.json", doc)
     assert rows and all(r["occupancy"] is not None for r in rows)
     capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# the live metrics plane (round 16)
+
+
+def test_compaction_metrics_on_off_bit_identical():
+    """Round 16: the consensus-health instrumentation at on_retire reads
+    host-fetched state only — a compacted run with the metrics registry
+    enabled equals the metrics-off run bit-for-bit, while the grid and
+    consensus families fill from the same retirements."""
+    from byzantinerandomizedconsensus_tpu.obs import metrics as _metrics
+
+    cfgs = _lanes("none", "benor", "keys", "none")
+    jb = get_backend("jax")
+    off, _ = jb.run_many(cfgs, compaction=_POLICY)
+    _metrics.configure()
+    try:
+        on, _ = jb.run_many(cfgs, compaction=_POLICY)
+        snap = _metrics.snapshot()
+    finally:
+        _metrics.disable()
+    for a, b in zip(off, on):
+        np.testing.assert_array_equal(a.rounds, b.rounds)
+        np.testing.assert_array_equal(a.decision, b.decision)
+
+    assert snap["brc_compaction_segments_total"]["series"][0]["value"] >= 1
+    rounds = snap["brc_consensus_rounds"]["series"][0]
+    assert rounds["count"] == sum(cfg.instances for cfg in cfgs)
+    s = _metrics.summary(snap)
+    assert s["decided_fraction"] is not None and 0 <= s["decided_fraction"] <= 1
+    decided = _metrics._sum_values(snap, "brc_consensus_decided_total") or 0
+    undecided = _metrics._sum_values(snap, "brc_consensus_undecided_total") or 0
+    assert decided + undecided == rounds["count"]

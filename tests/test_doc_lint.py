@@ -81,6 +81,55 @@ def test_every_emitted_span_kind_is_documented():
         "instrumented-seams table (§3c/§3d)")
 
 
+#: Registered metric names: ``_metrics.counter("brc_...", ...)`` /
+#: ``.gauge(`` / ``.histogram(`` call sites (obs/metrics.py accessors) —
+#: the name may land on the line after the call.
+_METRIC = re.compile(
+    r"(?:_metrics|metrics)\.(?:counter|gauge|histogram)\(\s*"
+    r"\"(brc_[a-z0-9_]+)\"")
+
+
+def registered_metric_names() -> set:
+    names = set()
+    for p in _source_files():
+        names.update(_METRIC.findall(p.read_text()))
+    return names
+
+
+def test_metric_name_census_is_nontrivial_and_complete():
+    """The regex harvest must see the known metric families — a refactor
+    that moves registration out of its reach fails here before the doc
+    check can pass vacuously on an empty set."""
+    names = registered_metric_names()
+    for expected in ("brc_serve_admitted_total", "brc_serve_rejected_total",
+                     "brc_serve_replied_total", "brc_serve_failed_total",
+                     "brc_serve_request_latency_seconds",
+                     "brc_serve_queue_wait_seconds",
+                     "brc_serve_service_seconds",
+                     "brc_compile_cache_hits_total",
+                     "brc_compile_cache_compiles_total",
+                     "brc_compaction_segments_total",
+                     "brc_compaction_occupancy",
+                     "brc_consensus_rounds", "brc_consensus_decided_total",
+                     "brc_consensus_fault_silenced_total",
+                     "brc_fleet_workers_alive", "brc_fleet_worker_up",
+                     "brc_fleet_steals_total", "brc_fleet_respawns_total"):
+        assert expected in names, (expected, sorted(names))
+    assert len(names) >= 28
+
+
+def test_every_registered_metric_is_documented():
+    """Every metric name the code registers must appear in
+    docs/OBSERVABILITY.md (§3g metric table) — the live metrics plane is a
+    contract surface like the span kinds above it."""
+    doc = (pathlib.Path(repo_root()) / "docs/OBSERVABILITY.md").read_text()
+    missing = [n for n in sorted(registered_metric_names()) if n not in doc]
+    assert missing == [], (
+        f"metric names registered by the code but absent from "
+        f"docs/OBSERVABILITY.md: {missing} — add them to the §3g metric "
+        "table")
+
+
 def test_every_record_block_key_is_documented():
     """Every versioned record block name and every required field of the
     *_BLOCK_KEYS registries (obs/record.py) must appear in
@@ -96,6 +145,7 @@ def test_every_record_block_key_is_documented():
         "programs": record.PROGRAMS_BLOCK_KEYS,
         "serve": record.SERVE_BLOCK_KEYS,
         "fleet": record.FLEET_BLOCK_KEYS,
+        "metrics": record.METRICS_BLOCK_KEYS,
         "counters": ("supported", "totals"),
     }
     missing = []

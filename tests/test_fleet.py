@@ -277,6 +277,51 @@ def test_process_fleet_worker_loss_readmits_bit_identical():
     assert safe_rec["request_id"] == safe.id
 
 
+@pytest.mark.slow
+def test_process_fleet_healthz_names_dead_worker():
+    """Round-16 satellite: ``GET /healthz`` is per-worker liveness — 200
+    while every worker is up; after a hard kill (the fleet never respawns
+    past the initial backoff ladder) it degrades to 503 with a JSON body
+    naming the dead worker, while survivors keep serving."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from byzantinerandomizedconsensus_tpu.serve.server import serve_http
+
+    with FleetServer(workers=2, mode="process", policy=_POLICY) as fleet:
+        httpd = serve_http(fleet, host="127.0.0.1", port=0)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        host, port = httpd.server_address[:2]
+        base = f"http://{host}:{port}"
+        try:
+            with urllib.request.urlopen(base + "/healthz", timeout=30) as r:
+                assert r.status == 200
+                doc = json.loads(r.read())
+            assert doc == {"ok": True, "workers": 2, "alive": 2,
+                           "dead_workers": []}
+            # park work on the survivor, then hard-kill worker 0
+            safe = fleet.submit(_LIGHT, pin_worker=1)
+            fleet._workers[0].kill()
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline and fleet.health()["ok"]:
+                time.sleep(0.05)
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(base + "/healthz", timeout=30)
+            assert exc.value.code == 503
+            doc = json.loads(exc.value.read())
+            assert doc["ok"] is False
+            assert doc["dead_workers"] == [0]
+            assert doc["workers"] == 2 and doc["alive"] == 1
+            # degraded, not down: the survivor still replies bit-identically
+            rec = safe.wait(timeout=600.0)
+            rounds, decision = _offline(_LIGHT)
+            assert rec["rounds"] == rounds and rec["decision"] == decision
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+
 def test_thread_fleet_all_workers_share_one_front_door():
     """The admission seam is the fleet's only entry: a bad payload is
     rejected before any routing state mutates."""

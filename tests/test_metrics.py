@@ -1,0 +1,300 @@
+"""Live metrics plane (obs/metrics.py, round 16): registry units —
+counter monotonicity (incl. under a thread hammer), histogram bucket-edge
+law and exact sum/count, type-conflict rejection, the disabled fast path's
+strict inertness, Prometheus text-exposition validity, the parse_text
+round-trip (the ONE scrape parser), histogram_quantile, the fleet absorb
+federation rule, and the env self-enable discipline."""
+
+import re
+import threading
+
+import pytest
+
+from byzantinerandomizedconsensus_tpu.obs import metrics as _metrics
+
+
+@pytest.fixture(autouse=True)
+def _inert_registry():
+    """Every test starts and ends on the disabled fast path — the metrics
+    plane is process-global, and leaking an enabled registry into another
+    test file would break ITS inertness assumptions."""
+    _metrics.disable()
+    yield
+    _metrics.disable()
+
+
+# ---------------------------------------------------------------------------
+# disabled fast path
+
+
+def test_disabled_fast_path_is_strictly_inert():
+    assert not _metrics.enabled()
+    assert _metrics.current() is None
+    assert _metrics.snapshot() is None
+    # every accessor hands out the one shared no-op...
+    c = _metrics.counter("brc_x_total", "x")
+    g = _metrics.gauge("brc_x", "x")
+    h = _metrics.histogram("brc_x_seconds", "x", buckets=(1.0, 2.0))
+    assert c is g is h is _metrics.counter("brc_y_total")
+    # ...which swallows every mutation (even invalid ones: no registry,
+    # no bookkeeping, no validation work on the disabled path)
+    c.inc()
+    c.inc(-5)
+    g.set(3)
+    g.dec()
+    h.observe(0.5)
+    h.observe_many([1, 2, 3])
+    assert _metrics.snapshot() is None
+    assert _metrics.render().startswith("# brc metrics disabled")
+    _metrics.absorb({"brc_x": {"type": "gauge", "series": []}}, worker="0")
+    assert _metrics.snapshot() is None
+
+
+def test_env_self_enable_discipline(monkeypatch):
+    monkeypatch.delenv(_metrics.METRICS_ENV, raising=False)
+    assert _metrics.maybe_enable_from_env() is None
+    assert not _metrics.enabled()
+    monkeypatch.setenv(_metrics.METRICS_ENV, "0")
+    assert _metrics.maybe_enable_from_env() is None
+    monkeypatch.setenv(_metrics.METRICS_ENV, "1")
+    assert _metrics.maybe_enable_from_env() is not None
+    assert _metrics.enabled()
+    # already-configured: no-op (does not replace the live registry)
+    r = _metrics.current()
+    assert _metrics.maybe_enable_from_env() is None
+    assert _metrics.current() is r
+
+
+# ---------------------------------------------------------------------------
+# counters / gauges
+
+
+def test_counter_monotonic_negative_increment_raises():
+    _metrics.configure()
+    c = _metrics.counter("brc_t_total", "t")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError, match="monotonic"):
+        c.inc(-1)
+    assert c.value == 3.5
+
+
+def test_counter_thread_hammer_loses_nothing():
+    _metrics.configure()
+
+    def hammer():
+        # re-resolve the child through the registry each time: the
+        # accessor path (dict get + lock) is the production call shape
+        for _ in range(500):
+            _metrics.counter("brc_hammer_total", "t").inc()
+
+    threads = [threading.Thread(target=hammer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert _metrics.counter("brc_hammer_total").value == 8 * 500
+
+
+def test_gauge_set_inc_dec():
+    _metrics.configure()
+    g = _metrics.gauge("brc_g", "g")
+    g.set(10)
+    g.inc(5)
+    g.dec(2)
+    assert g.value == 13.0
+
+
+def test_type_conflict_rejected():
+    _metrics.configure()
+    _metrics.counter("brc_dual", "x")
+    with pytest.raises(ValueError, match="already registered"):
+        _metrics.gauge("brc_dual", "x")
+
+
+def test_labeled_series_are_distinct_children():
+    _metrics.configure()
+    _metrics.counter("brc_r_total", "r", reason="bad_type").inc()
+    _metrics.counter("brc_r_total", "r", reason="cap_ceiling").inc(2)
+    snap = _metrics.snapshot()
+    rows = {tuple(sorted(s["labels"].items())): s["value"]
+            for s in snap["brc_r_total"]["series"]}
+    assert rows == {(("reason", "bad_type"),): 1.0,
+                    (("reason", "cap_ceiling"),): 2.0}
+
+
+# ---------------------------------------------------------------------------
+# histograms
+
+
+def test_histogram_bucket_edges_le_semantics():
+    """Prometheus ``le`` law: a value equal to an edge lands in that
+    edge's bucket; above every finite edge lands in +Inf."""
+    _metrics.configure()
+    h = _metrics.histogram("brc_h_seconds", "h", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.1, 0.5, 1.0, 9.99, 10.0, 11.0):
+        h.observe(v)
+    #            <=0.1      <=1        <=10        +Inf
+    assert h.counts == [2, 2, 2, 1]
+    assert h.count == 7
+    assert h.sum == pytest.approx(0.05 + 0.1 + 0.5 + 1.0 + 9.99 + 10.0 + 11.0)
+
+
+def test_histogram_observe_many_matches_observe():
+    _metrics.configure()
+    a = _metrics.histogram("brc_a_seconds", "a", buckets=(1.0, 2.0))
+    b = _metrics.histogram("brc_b_seconds", "b", buckets=(1.0, 2.0))
+    vals = [0.5, 1.0, 1.5, 2.5, 3.0]
+    a.observe_many(vals)
+    for v in vals:
+        b.observe(v)
+    assert a.counts == b.counts and a.sum == b.sum and a.count == b.count
+    a.observe_many([])   # empty batch is a no-op, not an error
+    assert a.count == 5
+
+
+def test_histogram_bad_buckets_rejected():
+    _metrics.configure()
+    for bad in ((), (2.0, 1.0), (1.0, 1.0)):
+        with pytest.raises(ValueError, match="ascending"):
+            _metrics.histogram(f"brc_bad_{len(bad)}", "x", buckets=bad)
+
+
+def test_histogram_quantile_interpolation_and_edges():
+    series = {"labels": {}, "buckets": [0.1, 1.0, 10.0],
+              "counts": [2, 2, 0, 0], "sum": 1.2, "count": 4}
+    # rank 2 of 4 sits at the top of the first bucket
+    assert _metrics.histogram_quantile(series, 0.5) == pytest.approx(0.1)
+    assert _metrics.histogram_quantile(series, 0.75) == pytest.approx(0.55)
+    # +Inf cell answers the top finite edge, never infinity
+    inf_heavy = {"labels": {}, "buckets": [1.0], "counts": [0, 5],
+                 "sum": 50.0, "count": 5}
+    assert _metrics.histogram_quantile(inf_heavy, 0.99) == 1.0
+    empty = {"labels": {}, "buckets": [1.0], "counts": [0, 0],
+             "sum": 0.0, "count": 0}
+    assert _metrics.histogram_quantile(empty, 0.5) is None
+    assert _metrics.histogram_quantile([], 0.5) is None
+    # multi-series (the fleet's per-worker histograms) fold into one
+    two = [series, dict(series, counts=[0, 0, 4, 0])]
+    assert _metrics.histogram_quantile(two, 0.99) <= 10.0
+
+
+# ---------------------------------------------------------------------------
+# exposition text + the one scrape parser
+
+#: One exposition sample line: metric name, optional {labels}, a value.
+_SAMPLE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\""
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\")*\})?"
+    r" (NaN|[+-]Inf|[-+]?[0-9.eE+-]+)$")
+
+
+def _populated_registry():
+    _metrics.configure()
+    _metrics.counter("brc_serve_replied_total", "Replies").inc(7)
+    _metrics.counter("brc_serve_rejected_total", "Rejections",
+                     reason="bad_type").inc(2)
+    _metrics.gauge("brc_fleet_workers_alive", "Alive").set(2)
+    h = _metrics.histogram("brc_serve_request_latency_seconds", "Latency",
+                           buckets=(0.1, 1.0, 10.0))
+    h.observe_many([0.05, 0.5, 2.0, 20.0])
+    return _metrics.snapshot()
+
+
+def test_render_is_valid_prometheus_exposition():
+    snap = _populated_registry()
+    body = _metrics.render()
+    assert body.endswith("\n")
+    seen_types = {}
+    for line in body.splitlines():
+        if not line:
+            continue
+        if line.startswith("# TYPE"):
+            _, _, name, kind = line.split(None, 3)
+            seen_types[name] = kind
+            continue
+        if line.startswith("#"):
+            assert line.startswith("# HELP"), line
+            continue
+        assert _SAMPLE.match(line), f"invalid exposition line: {line!r}"
+    assert seen_types["brc_serve_replied_total"] == "counter"
+    assert seen_types["brc_serve_request_latency_seconds"] == "histogram"
+    # cumulative bucket law: counts 1,2,3 at the finite edges, 4 at +Inf
+    assert "brc_serve_request_latency_seconds_bucket{le=\"0.1\"} 1" in body
+    assert "brc_serve_request_latency_seconds_bucket{le=\"+Inf\"} 4" in body
+    assert "brc_serve_request_latency_seconds_count 4" in body
+    assert snap is not None
+
+
+def test_parse_text_roundtrips_snapshot():
+    snap = _populated_registry()
+    parsed = _metrics.parse_text(_metrics.render())
+    assert set(parsed) == set(snap)
+    assert parsed["brc_serve_replied_total"]["series"][0]["value"] == 7.0
+    rej = parsed["brc_serve_rejected_total"]["series"][0]
+    assert rej["labels"] == {"reason": "bad_type"}
+    hist = parsed["brc_serve_request_latency_seconds"]["series"][0]
+    ref = snap["brc_serve_request_latency_seconds"]["series"][0]
+    assert hist["buckets"] == ref["buckets"]
+    assert hist["counts"] == ref["counts"]
+    assert hist["count"] == ref["count"]
+    assert hist["sum"] == pytest.approx(ref["sum"])
+    # quantiles computed off the scrape match the local snapshot
+    assert (_metrics.histogram_quantile(hist, 0.5)
+            == _metrics.histogram_quantile(ref, 0.5))
+
+
+def test_label_escaping_roundtrips():
+    _metrics.configure()
+    ugly = 'quote " backslash \\ end'
+    _metrics.counter("brc_esc_total", "esc", what=ugly).inc()
+    parsed = _metrics.parse_text(_metrics.render())
+    assert parsed["brc_esc_total"]["series"][0]["labels"]["what"] == ugly
+
+
+def test_summary_reads_the_headline_gauges():
+    _populated_registry()
+    _metrics.counter("brc_serve_failed_total", "f").inc(1)
+    _metrics.counter("brc_consensus_decided_total", "d").inc(9)
+    _metrics.counter("brc_consensus_undecided_total", "u").inc(1)
+    s = _metrics.summary(_metrics.snapshot())
+    assert s["replied"] == 7 and s["failed"] == 1
+    assert s["error_rate"] == pytest.approx(1 / 8)
+    assert s["decided_fraction"] == pytest.approx(0.9)
+    assert s["p99_latency_ms"] is not None
+    # absent families answer None, not garbage
+    none = _metrics.summary({})
+    assert none["p99_latency_ms"] is None
+    assert none["decided_fraction"] is None
+    assert _metrics.summary(None)["replied"] is None
+
+
+# ---------------------------------------------------------------------------
+# fleet federation
+
+
+def test_absorb_is_latest_wins_per_labeled_series():
+    _metrics.configure()
+    worker_snap = {
+        "brc_serve_replied_total": {
+            "type": "counter", "help": "x",
+            "series": [{"labels": {}, "value": 5.0}]},
+        "brc_serve_request_latency_seconds": {
+            "type": "histogram", "help": "x",
+            "series": [{"labels": {}, "buckets": [1.0],
+                        "counts": [2, 1], "sum": 4.0, "count": 3}]},
+    }
+    _metrics.absorb(worker_snap, worker="3")
+    _metrics.absorb(worker_snap, worker="3")  # absolute, not summed
+    snap = _metrics.snapshot()
+    rows = snap["brc_serve_replied_total"]["series"]
+    assert rows == [{"labels": {"worker": "3"}, "value": 5.0}]
+    hrow = snap["brc_serve_request_latency_seconds"]["series"][0]
+    assert hrow["labels"] == {"worker": "3"}
+    assert hrow["counts"] == [2, 1] and hrow["count"] == 3
+    # a second worker's series lands beside it, never over it
+    _metrics.absorb(worker_snap, worker="4")
+    assert len(_metrics.snapshot()["brc_serve_replied_total"]["series"]) == 2
+    _metrics.absorb(None, worker="5")   # dead worker: no-op

@@ -52,7 +52,7 @@ def test_validate_record_rejects_unknown_revision():
                                            "record_revision": bad})), bad
     # Every revision this build knows — including the legacy implied-v1
     # absence — stays valid.
-    for ok in (None, 0, 1, 2, 3, 4, 5, record.RECORD_REVISION):
+    for ok in (None, 0, 1, 2, 3, 4, 5, 6, record.RECORD_REVISION):
         doc = record.new_record("x")
         if ok is None:
             doc.pop("record_revision")
@@ -115,6 +115,45 @@ def test_validate_record_checks_fleet_block():
     assert any("per_worker row 0" in p
                for p in record.validate_record(torn))
     assert record.fleet_block(None) is None
+
+
+def test_validate_record_checks_metrics_block():
+    """Schema v1.7: a metrics block missing its required keys fails by
+    name; a real snapshot digest (with and without an SLO verdict)
+    validates; a torn slo (no 'ok') fails by name."""
+    bad = {**record.new_record("metrics_bench"), "metrics": {"names": []}}
+    problems = record.validate_record(bad)
+    assert any("metrics block missing" in p for p in problems), problems
+
+    snap = {
+        "brc_serve_replied_total": {
+            "type": "counter", "help": "x",
+            "series": [{"labels": {}, "value": 3.0}]},
+        "brc_serve_request_latency_seconds": {
+            "type": "histogram", "help": "x",
+            "series": [{"labels": {}, "buckets": [0.1, 1.0, 10.0],
+                        "counts": [1, 2, 0, 0], "sum": 1.4, "count": 3}]},
+    }
+    blk = record.metrics_block(snap)
+    assert blk is not None
+    assert blk["names"] == sorted(snap)
+    assert blk["series"] == 2
+    assert blk["p99_latency_ms"] is not None
+    good = {**record.new_record("metrics_bench"), "metrics": blk}
+    assert record.validate_record(good) == []
+
+    gated = {**record.new_record("metrics_bench"),
+             "metrics": record.metrics_block(
+                 snap, slo={"ok": True, "checks": {}})}
+    assert record.validate_record(gated) == []
+    assert gated["metrics"]["slo"]["ok"] is True
+    torn = {**good, "metrics": {**blk, "slo": {"checks": {}}}}
+    assert any("slo" in p and "ok" in p
+               for p in record.validate_record(torn)), \
+        record.validate_record(torn)
+
+    assert record.metrics_block(None) is None
+    assert record.metrics_block({}) is None
 
 
 def test_timing_block_maps_suspect_to_error():
@@ -208,14 +247,15 @@ def test_schema_census_every_committed_artifact_validates():
         problems = record.validate_record(payload)
         assert problems == [], (p.name, problems)
         checked.append(p.name)
-    # The v1+ era census as committed (r8-r15: ledger_r8, chaos_r9,
+    # The v1+ era census as committed (r8-r16: ledger_r8, chaos_r9,
     # batch_r10, compaction_r11, BENCH_r11, trace_r12, programs_r13,
-    # serve_r14, serve_fleet_r15): an accidentally narrowed glob must not
-    # silently pass on near-zero coverage — and the v1.4/v1.5/v1.6
-    # artifacts must be in the checked set, so the unknown-revision,
-    # serve-block, and fleet-block checks above provably ran against real
-    # revision-4/-5/-6 heads.
-    assert len(checked) >= 8, checked
+    # serve_r14, serve_fleet_r15, metrics_r16): an accidentally narrowed
+    # glob must not silently pass on near-zero coverage — and the
+    # v1.4/v1.5/v1.6/v1.7 artifacts must be in the checked set, so the
+    # unknown-revision, serve-block, fleet-block, and metrics-block checks
+    # above provably ran against real revision-4/-5/-6/-7 heads.
+    assert len(checked) >= 9, checked
     assert "programs_r13.json" in checked, checked
     assert "serve_r14.json" in checked, checked
     assert "serve_fleet_r15.json" in checked, checked
+    assert "metrics_r16.json" in checked, checked

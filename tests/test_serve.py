@@ -219,3 +219,119 @@ def test_compile_cache_concurrent_eviction_consistency():
     assert len(cache) == 4
     assert stats["compiles"] - stats["evictions"] == len(cache)
     assert stats["compiles"] + stats["hits"] == 6 * 200
+
+
+# ---------------------------------------------------------------------------
+# the live metrics plane (round 16)
+
+
+def test_serve_metrics_plane_bit_identical_and_exposed():
+    """Round 16: with the metrics registry enabled, replies stay
+    bit-identical to the offline path; GET /metrics serves the serve
+    families as valid exposition text; /stats carries the one-shape
+    per_worker row; health() reports liveness; rejected admissions land
+    in the labeled rejection counter."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from byzantinerandomizedconsensus_tpu.obs import metrics as _metrics
+    from byzantinerandomizedconsensus_tpu.serve.server import serve_http
+
+    _metrics.configure()
+    try:
+        with ConsensusServer(policy=_POLICY) as srv:
+            httpd = serve_http(srv, port=0)
+            t = threading.Thread(target=httpd.serve_forever, daemon=True)
+            t.start()
+            handles = [srv.submit(c) for c in _CFGS]
+            recs = [h.wait(timeout=600.0) for h in handles]
+            with pytest.raises(ValueError, match="service ceiling"):
+                srv.submit({"n": 4, "f": 1, "round_cap": 256})
+            port = httpd.server_address[1]
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"] == _metrics.CONTENT_TYPE
+                body = r.read().decode()
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+                health = _json.loads(r.read())
+            stats = srv.stats()
+            httpd.shutdown()
+            httpd.server_close()
+    finally:
+        _metrics.disable()
+
+    # bit-identity with the plane enabled
+    offline = get_backend("numpy")
+    for cfg, rec in zip(_CFGS, recs):
+        ref = offline.run(cfg)
+        assert rec["rounds"] == [int(r) for r in ref.rounds]
+        assert rec["decision"] == [int(d) for d in ref.decision]
+
+    # one-shape /stats: the single server reports the fleet row shape
+    assert stats["workers"] == 1 and stats["alive"] == 1
+    row = stats["per_worker"][0]
+    assert {"worker", "pid", "alive", "replied", "steals", "inflight",
+            "pending", "load"} <= set(row)
+    assert row["replied"] == len(_CFGS) and row["steals"] == 0
+
+    # live-endpoint health: single server, nothing dead
+    assert health["ok"] is True and health["dead_workers"] == []
+
+    # the scraped exposition parses back into the serve families
+    snap = _metrics.parse_text(body)
+    assert (snap["brc_serve_replied_total"]["series"][0]["value"]
+            == len(_CFGS))
+    lat = snap["brc_serve_request_latency_seconds"]["series"][0]
+    assert lat["count"] == len(_CFGS) and lat["sum"] > 0
+    qw = snap["brc_serve_queue_wait_seconds"]["series"][0]
+    sv = snap["brc_serve_service_seconds"]["series"][0]
+    assert qw["count"] == len(_CFGS) and sv["count"] == len(_CFGS)
+    rejected = {s["labels"].get("reason"): s["value"]
+                for s in snap["brc_serve_rejected_total"]["series"]}
+    assert rejected.get("cap_ceiling") == 1
+    # compile-cache activity: a cold process compiles, a warm one (earlier
+    # tests primed the shared cache) hits — either way the cache families
+    # must show the traffic
+    cache_traffic = (
+        (_metrics._sum_values(snap, "brc_compile_cache_compiles_total") or 0)
+        + (_metrics._sum_values(snap, "brc_compile_cache_hits_total") or 0))
+    assert cache_traffic > 0
+    s = _metrics.summary(snap)
+    assert s["replied"] == len(_CFGS) and s["error_rate"] == 0.0
+    assert s["p99_latency_ms"] is not None
+
+
+def test_serve_healthz_degrades_to_503_when_stopped():
+    """The /healthz contract: health() is duck-typed off the wrapped
+    server; a shut-down single server reports ok=False and worker 0 dead,
+    and the endpoint turns that into a 503 with the JSON naming it."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from byzantinerandomizedconsensus_tpu.serve.server import serve_http
+
+    srv = ConsensusServer(policy=_POLICY).start()
+    httpd = serve_http(srv, port=0)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    port = httpd.server_address[1]
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+            assert r.status == 200
+        srv.shutdown(drain=True)
+        assert srv.health()["ok"] is False
+        assert srv.health()["dead_workers"] == [0]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10)
+        assert ei.value.code == 503
+        doc = _json.loads(ei.value.read())
+        assert doc["ok"] is False and doc["dead_workers"] == [0]
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
