@@ -123,6 +123,17 @@ class CompactionPolicy:
                 "refill_threshold": self.refill_threshold}
 
 
+class WorkFeedOverflow(RuntimeError):
+    """Raised by :meth:`WorkFeed.push` when a bounded feed is full.
+
+    The named rejection is the backpressure seam (ROADMAP #4 seed, round 17):
+    a producer that can outdraw the grid — the adversary hunter's ask-ahead
+    loop is the first — gets a typed signal to throttle on instead of growing
+    the host queue without bound. Default feeds stay unbounded, so no
+    existing caller can see this without opting in via ``max_depth``.
+    """
+
+
 class WorkFeed:
     """Externally-fed work queue for :func:`run_bucket` — the serving seam
     (round 14, closing round 11's open leg (b)).
@@ -143,11 +154,17 @@ class WorkFeed:
     cap exceeds it, so no late request can mint a new program key.
     """
 
-    def __init__(self, round_cap_ceiling: int = 128):
+    def __init__(self, round_cap_ceiling: int = 128,
+                 max_depth: int | None = None):
         if round_cap_ceiling < 1:
             raise ValueError(
                 f"round_cap_ceiling={round_cap_ceiling} out of range (>= 1)")
+        if max_depth is not None and max_depth < 1:
+            raise ValueError(
+                f"max_depth={max_depth} out of range (>= 1, or None for "
+                "unbounded)")
         self.round_cap_ceiling = int(round_cap_ceiling)
+        self.max_depth = None if max_depth is None else int(max_depth)
         self._items: list = []
         self._cv = threading.Condition()
         self._closed = False
@@ -165,6 +182,12 @@ class WorkFeed:
         with self._cv:
             if self._closed:
                 raise RuntimeError("push on a closed WorkFeed")
+            if self.max_depth is not None and \
+                    len(self._items) >= self.max_depth:
+                raise WorkFeedOverflow(
+                    f"WorkFeed depth {len(self._items)} at max_depth="
+                    f"{self.max_depth}: producer must back off until the "
+                    "grid drains")
             self._items.append((cfg, ids, token))
             self._cv.notify_all()
 

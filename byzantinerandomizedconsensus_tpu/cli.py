@@ -355,12 +355,24 @@ def main(argv=None) -> int:
                         "decided fraction + rounds sparkline, per-worker "
                         "fleet table; read-only and survives a dead "
                         "endpoint")
+    sub.add_parser("hunt",
+                   help="closed-loop worst-case search driving the serving "
+                        "stack (hunt/): seeded ask/tell strategies "
+                        "(random|evolution|bandit) over the adversary × "
+                        "fault × delivery × shape space, ask-ahead "
+                        "pipelined generations vs a barriered control, "
+                        "per-reply safety verdicts, elite archive exported "
+                        "as replayable regression configs; emits the "
+                        "schema-v1.8 hunt artifact (exit 1 safety "
+                        "violation, 2 steady-state compiles, 3 invalid "
+                        "record, 4 replay drift)")
 
     if argv is None:
         argv = sys.argv[1:]
     if argv and argv[0] in ("accept", "slack", "product", "ledger", "chaos",
                             "compaction", "trace", "programs", "serve",
-                            "loadgen", "dash"):
+                            "loadgen", "dash", "hunt"):
+        from byzantinerandomizedconsensus_tpu.hunt import hunter as hunt_tool
         from byzantinerandomizedconsensus_tpu.serve import server as serve_tool
         from byzantinerandomizedconsensus_tpu.tools import (
             acceptance, bench_compaction, dash, ledger, loadgen, product,
@@ -375,7 +387,8 @@ def main(argv=None) -> int:
                 "product": product, "ledger": ledger,
                 "compaction": bench_compaction, "trace": trace_tool,
                 "programs": programs_tool, "serve": serve_tool,
-                "loadgen": loadgen, "dash": dash}[argv[0]]
+                "loadgen": loadgen, "dash": dash,
+                "hunt": hunt_tool}[argv[0]]
         return tool.main(argv[1:])
     args = ap.parse_args(argv)
     if getattr(args, "backend", "").startswith("jax"):

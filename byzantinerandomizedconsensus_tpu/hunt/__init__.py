@@ -1,0 +1,27 @@
+"""Adversary hunter (round 17, ROADMAP #1): a closed-loop worst-case
+search engine driving the serving stack.
+
+The subsystem splits along its seams:
+
+- :mod:`.space` — the declarative, seeded search space over the joint
+  adversary × §9 fault-schedule × delivery × shape axes. Candidates are
+  genomes that encode/decode to admissible ``SimConfig``\\ s through the one
+  ``validate()`` path, and sampling delegates to the shared chaos-generator
+  seam (tools/sampler.py) so hunt and soak can never drift.
+- :mod:`.strategies` — pluggable optimizers behind one ask/tell interface
+  (seeded random, mutation+crossover evolution, successive-halving bandit
+  over space regions), each deterministic from ``(strategy, seed)``.
+- :mod:`.hunter` — the closed loop: streams candidate generations into a
+  resident :class:`~byzantinerandomizedconsensus_tpu.serve.server.ConsensusServer`
+  grid, harvests fitness at retirement, pipelines ask-ahead so the next
+  generation is drawn while the last still occupies lanes. Also the
+  ``brc-tpu hunt`` CLI and the ``artifacts/hunt_r17.json`` runner.
+- :mod:`.archive` — the elite archive; exports found worst cases as pinned
+  regression configs (the way ``adaptive_min`` was born), replayable
+  bit-identically by a committed test.
+"""
+
+from byzantinerandomizedconsensus_tpu.hunt.archive import Archive  # noqa: F401
+from byzantinerandomizedconsensus_tpu.hunt.space import SearchSpace  # noqa: F401
+from byzantinerandomizedconsensus_tpu.hunt.strategies import (  # noqa: F401
+    STRATEGIES, make_strategy)

@@ -1,0 +1,170 @@
+"""The hunter's search space (round 17).
+
+One declarative object owns the joint adversary kind × spec-§9
+fault-schedule × delivery law × shape (n, f, round_cap) axes. Everything a
+strategy can propose flows through here, and three rules keep proposals
+honest:
+
+1. **Shared laws.** ``sample()`` delegates to the chaos generator's seam
+   (tools/sampler.py, the same ``(GENERATOR_VERSION, seed)`` contract the
+   soak pins) — a config the hunter can draw is by construction one the
+   chaos soak could have drawn, so hunt and soak can never drift.
+2. **One admissibility gate.** Every candidate — sampled, mutated, or
+   crossed over — decodes through ``SimConfig.validate()``; a genome the
+   gate rejects never reaches the grid. Mutation/crossover *repair*
+   (clamping f to the resilience ceiling, demoting the adversary when the
+   shape cannot host one) happens before the gate, so strategies always
+   receive admissible candidates, never exceptions.
+3. **Serving-shaped by construction.** n ≤ 40 folds every candidate into
+   the FUSED_SMALL_TIER, and round_cap ≤ 128 fits the default feed
+   ceiling — the *entire* bucket universe of the space is the 8-element
+   product (2 protocols × 4 deliveries), enumerable by :meth:`buckets`
+   for a complete warm-up. That is what makes the hunt's
+   0-steady-state-recompile pin achievable.
+"""
+
+from __future__ import annotations
+
+import random
+
+from byzantinerandomizedconsensus_tpu.backends.batch import FusedBucket
+from byzantinerandomizedconsensus_tpu.config import (
+    DELIVERY_KINDS, FAULT_KINDS, SimConfig)
+from byzantinerandomizedconsensus_tpu.tools import sampler as _sampler
+
+# Genome field order — also the crossover axis order, so it is part of the
+# determinism contract (reordering changes draw sequences).
+GENOME_FIELDS = ("protocol", "n", "f", "instances", "adversary", "coin",
+                 "init", "seed", "round_cap", "delivery", "faults",
+                 "crash_window")
+
+#: per-axis mutation domains (f and seed are handled specially)
+_MUTATION_DOMAINS = {
+    "protocol": _sampler._PROTOCOLS,
+    "adversary": _sampler._ADVERSARIES,
+    "coin": _sampler._COINS,
+    "init": _sampler._INITS,
+    "round_cap": _sampler._ROUND_CAPS,
+    "delivery": DELIVERY_KINDS,
+    "faults": FAULT_KINDS,
+    "crash_window": _sampler._CHAOS_WINDOWS,
+}
+
+
+def encode(cfg: SimConfig) -> dict:
+    """Config → genome: the mutable dict representation strategies edit."""
+    return {k: getattr(cfg, k) for k in GENOME_FIELDS}
+
+
+def decode(genome: dict) -> SimConfig:
+    """Genome → admissible config, through the one ``validate()`` gate."""
+    return SimConfig(**{k: genome[k] for k in GENOME_FIELDS}).validate()
+
+
+class SearchSpace:
+    """The declarative candidate space; all randomness comes from the
+    caller's ``random.Random`` so strategies stay deterministic from
+    ``(strategy, seed)``."""
+
+    generator_version = _sampler.GENERATOR_VERSION
+    max_n = _sampler.MAX_SOAK_N
+
+    def sample(self, rng: random.Random) -> SimConfig:
+        """One seeded draw — the chaos generator's laws, verbatim."""
+        return _sampler.random_config(rng, chaos=True)
+
+    def _repair(self, genome: dict) -> dict:
+        """Clamp a mutated/crossed genome back into the admissible region:
+        f into the resilience ceiling for (protocol, adversary, n), the
+        adversary demoted to "none" when the shape cannot host a faulty
+        set. Same ceilings the sampler redraws against."""
+        fmax = _sampler._f_ceiling(
+            genome["protocol"], genome["adversary"], genome["n"])
+        if fmax < 1 and genome["adversary"] != "none":
+            genome["adversary"] = "none"
+            fmax = _sampler._f_ceiling(
+                genome["protocol"], "none", genome["n"])
+        lo = 0 if genome["adversary"] == "none" else 1
+        genome["f"] = min(max(int(genome["f"]), lo), fmax)
+        return genome
+
+    def mutate(self, cfg: SimConfig, rng: random.Random) -> SimConfig:
+        """Redraw one axis of ``cfg`` (uniform over axes), repair, decode."""
+        genome = encode(cfg)
+        axis = rng.choice(GENOME_FIELDS)
+        if axis == "n":
+            genome["n"] = rng.randrange(4, self.max_n + 1)
+        elif axis == "f":
+            fmax = _sampler._f_ceiling(
+                genome["protocol"], genome["adversary"], genome["n"])
+            lo = 0 if genome["adversary"] == "none" else 1
+            if fmax >= lo:
+                genome["f"] = rng.randrange(lo, fmax + 1)
+        elif axis == "instances":
+            genome["instances"] = rng.randrange(
+                *_sampler._INSTANCES_RANGE)
+        elif axis == "seed":
+            genome["seed"] = rng.randrange(1 << 32)
+        else:
+            genome[axis] = rng.choice(_MUTATION_DOMAINS[axis])
+        return decode(self._repair(genome))
+
+    def crossover(self, a: SimConfig, b: SimConfig,
+                  rng: random.Random) -> SimConfig:
+        """Uniform per-axis recombination of two parents, repaired."""
+        ga, gb = encode(a), encode(b)
+        child = {k: (ga if rng.random() < 0.5 else gb)[k]
+                 for k in GENOME_FIELDS}
+        return decode(self._repair(child))
+
+    def regions(self) -> list:
+        """The successive-halving bandit's arms: the adversary × delivery
+        product — the axes the hunt question is *about* (which adversary
+        under which delivery law is worst)."""
+        return [(adv, d) for adv in _sampler._ADVERSARIES
+                for d in DELIVERY_KINDS]
+
+    def sample_region(self, region, rng: random.Random) -> SimConfig:
+        """One draw pinned to a region: the shared laws for every other
+        axis, the region's (adversary, delivery) forced, then repaired —
+        the bandit's within-arm sampler."""
+        adversary, delivery = region
+        genome = encode(self.sample(rng))
+        genome["adversary"] = adversary
+        genome["delivery"] = delivery
+        # Grow the shape rather than let repair demote the forced adversary
+        # (benor + a lying set needs n ≥ 6): region attribution must hold.
+        while adversary != "none" and _sampler._f_ceiling(
+                genome["protocol"], adversary, genome["n"]) < 1:
+            genome["n"] += 1
+        return decode(self._repair(genome))
+
+    def buckets(self) -> list:
+        """The complete compiled-program universe of this space: n ≤ 40
+        folds every candidate to the small fused tier, so 2 protocols × 4
+        deliveries is *all* the programs a hunt can touch. The hunter warms
+        exactly these before measuring, which is why the
+        0-steady-state-recompile pin is meaningful."""
+        probe = []
+        for protocol in _sampler._PROTOCOLS:
+            for delivery in DELIVERY_KINDS:
+                cfg = SimConfig(
+                    protocol=protocol, n=7, f=1, instances=8,
+                    adversary="crash", round_cap=32,
+                    delivery=delivery).validate()
+                probe.append(FusedBucket.of(cfg))
+        return probe
+
+    def doc(self) -> dict:
+        """The run-record ``space`` sub-block (schema v1.8)."""
+        return {
+            "generator_version": self.generator_version,
+            "max_n": self.max_n,
+            "protocols": list(_sampler._PROTOCOLS),
+            "adversaries": list(_sampler._ADVERSARIES),
+            "deliveries": list(DELIVERY_KINDS),
+            "faults": list(FAULT_KINDS),
+            "round_caps": list(_sampler._ROUND_CAPS),
+            "regions": len(self.regions()),
+            "buckets": len(self.buckets()),
+        }

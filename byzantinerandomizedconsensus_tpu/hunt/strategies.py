@@ -1,0 +1,178 @@
+"""Pluggable hunt optimizers behind one ask/tell interface (round 17).
+
+A strategy is a stream transformer: ``ask()`` yields the next candidate
+config, ``tell(cfg, fitness)`` feeds an evaluation back. The split is what
+lets the hunter pipeline — the loop can ask ahead for generation g+1 while
+generation g still occupies lanes, because ask never blocks on outstanding
+tells (strategies act on whatever has been told *so far*).
+
+Determinism contract: every strategy draws all randomness from one
+``random.Random(f"{name}:{seed}")`` stream (string seeding is stable across
+processes), and its behavior is a pure function of the tell sequence — so a
+whole hunt is reproducible from ``(strategy, seed)`` given the evaluator is
+deterministic (it is: the grids are bit-identical to the offline path).
+
+Three strategies ship:
+
+- ``random`` — the seeded baseline: i.i.d. draws from the space, no
+  learning. The control every smarter strategy must beat.
+- ``evolution`` — mutation+crossover over an elite pool with tournament
+  selection; the classic schedule-strength hill climber (the family that
+  found ``adaptive_min`` by hand in round 4, now automated).
+- ``bandit`` — successive halving over space *regions* (adversary ×
+  delivery arms): every arm gets a rung of evaluations, the weaker half is
+  dropped, the per-arm budget doubles, repeat until one region holds the
+  whole budget.
+"""
+
+from __future__ import annotations
+
+import random
+
+from byzantinerandomizedconsensus_tpu.hunt.space import SearchSpace
+
+
+class Strategy:
+    """Base ask/tell optimizer; subclasses override ``ask`` and may extend
+    ``tell`` (call super so best/evaluations bookkeeping stays right)."""
+
+    name = "base"
+
+    def __init__(self, space: SearchSpace, seed: int):
+        self.space = space
+        self.seed = int(seed)
+        self.rng = random.Random(f"{self.name}:{self.seed}")
+        self.evaluations = 0
+        self.best_fitness: float | None = None
+        self.best_cfg = None
+
+    def ask(self):
+        raise NotImplementedError
+
+    def tell(self, cfg, fitness: float) -> None:
+        self.evaluations += 1
+        if self.best_fitness is None or fitness > self.best_fitness:
+            self.best_fitness = float(fitness)
+            self.best_cfg = cfg
+
+    def doc(self) -> dict:
+        """The run-record ``strategy`` identity sub-block."""
+        return {"name": self.name, "seed": self.seed}
+
+
+class RandomStrategy(Strategy):
+    """Seeded i.i.d. sampling — the no-learning control."""
+
+    name = "random"
+
+    def ask(self):
+        return self.space.sample(self.rng)
+
+
+class EvolutionStrategy(Strategy):
+    """Elite-pool evolution: tournament-selected parents, uniform
+    crossover, single-axis mutation, with a floor of pure exploration so
+    the pool can never collapse onto one basin."""
+
+    name = "evolution"
+
+    POOL = 16          #: elite pool size
+    TOURNAMENT = 3     #: parents drawn per selection
+    P_EXPLORE = 0.2    #: fresh sample probability once the pool is warm
+    P_CROSSOVER = 0.5  #: crossover (vs lone mutation) probability
+    P_CHILD_MUTATE = 0.3  #: post-crossover mutation probability
+
+    def __init__(self, space: SearchSpace, seed: int):
+        super().__init__(space, seed)
+        self._pool: list = []  # (fitness, tiebreak, cfg), sorted desc
+
+    def _select(self):
+        contenders = [self._pool[self.rng.randrange(len(self._pool))]
+                      for _ in range(min(self.TOURNAMENT, len(self._pool)))]
+        return max(contenders)[2]
+
+    def ask(self):
+        if len(self._pool) < self.TOURNAMENT or \
+                self.rng.random() < self.P_EXPLORE:
+            return self.space.sample(self.rng)
+        if self.rng.random() < self.P_CROSSOVER:
+            child = self.space.crossover(self._select(), self._select(),
+                                         self.rng)
+            if self.rng.random() < self.P_CHILD_MUTATE:
+                child = self.space.mutate(child, self.rng)
+            return child
+        return self.space.mutate(self._select(), self.rng)
+
+    def tell(self, cfg, fitness: float) -> None:
+        super().tell(cfg, fitness)
+        # tiebreak on arrival order keeps the sort total without comparing
+        # configs (SimConfig defines no ordering)
+        self._pool.append((float(fitness), -self.evaluations, cfg))
+        self._pool.sort(reverse=True)
+        del self._pool[self.POOL:]
+
+
+class BanditStrategy(Strategy):
+    """Successive halving over the space's (adversary × delivery) regions:
+    round-robin rungs, drop the weaker half by mean fitness, double the
+    per-arm budget, repeat to one survivor — then exploit it.
+
+    Tells are attributed to a region by the candidate's own
+    (adversary, delivery) axes; a tell for a region already halved away
+    (possible under the hunter's ask-ahead pipelining) only updates the
+    global best, never a dead arm.
+    """
+
+    name = "bandit"
+
+    RUNG0 = 2  #: evaluations per region in the first rung
+
+    def __init__(self, space: SearchSpace, seed: int):
+        super().__init__(space, seed)
+        self._active = list(space.regions())
+        self._per = self.RUNG0
+        self._rung = 0
+        self._stats = {r: [0, 0.0] for r in self._active}  # count, sum
+        self._next = 0
+
+    def ask(self):
+        region = self._active[self._next % len(self._active)]
+        self._next += 1
+        return self.space.sample_region(region, self.rng)
+
+    def tell(self, cfg, fitness: float) -> None:
+        super().tell(cfg, fitness)
+        region = (cfg.adversary, cfg.delivery)
+        stat = self._stats.get(region)
+        if stat is None:
+            return  # region halved away while this candidate was in flight
+        stat[0] += 1
+        stat[1] += float(fitness)
+        if len(self._active) > 1 and \
+                all(self._stats[r][0] >= self._per for r in self._active):
+            ranked = sorted(
+                self._active,
+                key=lambda r: (-(self._stats[r][1] / self._stats[r][0]), r))
+            self._active = ranked[:max(1, len(self._active) // 2)]
+            self._rung += 1
+            self._per *= 2
+            self._stats = {r: [0, 0.0] for r in self._active}
+            self._next = 0
+
+    def doc(self) -> dict:
+        d = super().doc()
+        d["rung"] = self._rung
+        d["active_regions"] = [list(r) for r in self._active]
+        return d
+
+
+STRATEGIES = {cls.name: cls for cls in
+              (RandomStrategy, EvolutionStrategy, BanditStrategy)}
+
+
+def make_strategy(name: str, space: SearchSpace, seed: int) -> Strategy:
+    """The registry constructor behind ``brc-tpu hunt --strategy``."""
+    if name not in STRATEGIES:
+        raise ValueError(f"unknown strategy {name!r}; "
+                         f"use one of {'|'.join(sorted(STRATEGIES))}")
+    return STRATEGIES[name](space, seed)

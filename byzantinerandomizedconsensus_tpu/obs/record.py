@@ -83,6 +83,18 @@ future fleet-serving artifact. Same compatibility rule as v1.1–v1.5:
 ``record_version`` stays 1, the revision is declarative, and the block
 shape is checked only when present.
 
+Schema v1.8 (round 17) adds the **hunt** block (:func:`hunt_block` — the
+closed-loop adversary hunter, hunt/hunter.py + ``brc-tpu hunt``): the
+strategy identity ``(strategy, seed)`` the whole run is reproducible from,
+the evaluation/generation budget accounting, the best fitness found with
+its genome, the elite-archive size, and the two red-alarm pins — safety
+``violations`` (models/invariants.py verdicts harvested at retirement) and
+``steady_state_compiles`` (the v1.5 serving pin, now holding *while an
+optimizer drives the grid*). Carried by ``artifacts/hunt_r17.json`` and the
+exported ``artifacts/hunt_regressions.json`` archive. Same compatibility
+rule as v1.1–v1.7: ``record_version`` stays 1, the revision is declarative,
+and the block shape is checked only when present.
+
 tools/ledger.py consumes both this format and the legacy r1–r7 shapes;
 :func:`validate_record` is the schema check the tier-1 tests pin, and
 ``brc-tpu ledger --check`` (the regression sentinel) compares the committed
@@ -104,8 +116,10 @@ RECORD_VERSION = 1
 # v1.6 (round 15) the fleet block (multi-worker serving: per-worker compile/
 # steal/throughput rows behind the single admission path); v1.7 (round 16)
 # the metrics block (live metrics plane: registry snapshot digest, scraped
-# p99 / decided fraction, SLO verdict).
-RECORD_REVISION = 7
+# p99 / decided fraction, SLO verdict); v1.8 (round 17) the hunt block
+# (closed-loop adversary search: strategy identity, budget accounting,
+# best-fitness / violation / steady-compile pins).
+RECORD_REVISION = 8
 
 
 def env_fingerprint() -> dict:
@@ -421,6 +435,32 @@ def metrics_block(snapshot: dict | None, slo: dict | None = None
     return out
 
 
+#: The fields a schema-v1.8 ``hunt`` block must carry (the closed-loop
+#: adversary hunter of hunt/hunter.py: strategy identity, budget accounting,
+#: and the red-alarm pins the artifact's claims rest on).
+HUNT_BLOCK_KEYS = ("strategy", "seed", "budget", "evaluations",
+                   "generations", "best_fitness", "archive_size",
+                   "violations", "steady_state_compiles")
+
+
+def hunt_block(stats: dict | None) -> dict | None:
+    """The schema-v1.8 ``hunt`` block from a hunt-run stats dict
+    (hunt/hunter.py). None in, None out — a record without the block stays
+    a valid v1.x record. ``best_fitness`` is the hunt's objective (mean
+    rounds-to-decision plus the round_cap-weighted undecided fraction —
+    higher is worse-case); ``violations`` and ``steady_state_compiles``
+    are the pins whose committed value 0 is the round's claim."""
+    if stats is None:
+        return None
+    return {k: stats.get(k) for k in
+            (HUNT_BLOCK_KEYS + ("space", "best", "pipelined_wall_s",
+                                "barriered_wall_s", "pipeline_speedup",
+                                "baseline_mean_rounds", "rediscovery",
+                                "violation_detail", "generation_size",
+                                "duration_s"))
+            if k in stats}
+
+
 def validate_record(doc: dict) -> list:
     """Schema check: returns a list of problems (empty = valid v1 record)."""
     problems = []
@@ -534,6 +574,18 @@ def validate_record(doc: dict) -> list:
             if slo is not None and (not isinstance(slo, dict)
                                     or "ok" not in slo):
                 problems.append("metrics slo block missing 'ok'")
+    ht = doc.get("hunt")
+    if ht is not None:
+        if not isinstance(ht, dict):
+            problems.append("hunt block is not a dict")
+        else:
+            for key in HUNT_BLOCK_KEYS:
+                if key not in ht:
+                    problems.append(f"hunt block missing {key!r}")
+            best = ht.get("best")
+            if best is not None and (not isinstance(best, dict)
+                                     or "genome" not in best):
+                problems.append("hunt best entry missing 'genome'")
     pg = doc.get("programs")
     if pg is not None:
         if not isinstance(pg, dict):

@@ -66,12 +66,15 @@ class ServeRequest:
     submitting thread until then."""
 
     __slots__ = ("id", "cfg", "bucket", "t_submit", "t_dispatch", "t_reply",
-                 "result", "record", "error", "done")
+                 "result", "record", "error", "done", "check_invariants")
 
-    def __init__(self, rid: str, cfg, bucket):
+    def __init__(self, rid: str, cfg, bucket, check_invariants: bool = False):
         self.id = rid
         self.cfg = cfg
         self.bucket = bucket
+        # opt-in safety checking at retirement (round 17 satellite): the
+        # reply record carries an Agreement/Validity verdict summary
+        self.check_invariants = bool(check_invariants)
         self.t_submit = time.perf_counter()
         # stamped when the request enters a live grid (feed push or seed) —
         # splits latency into queue wait vs grid service for the histograms
@@ -176,17 +179,26 @@ class ConsensusServer:
 
     # -- submission --------------------------------------------------------
 
-    def submit(self, payload) -> ServeRequest:
+    def submit(self, payload, check_invariants: bool = False) -> ServeRequest:
         """Admit a request payload and queue it. Returns the
         :class:`ServeRequest` handle; ``handle.wait()`` blocks for the
-        reply record. Raises on invalid payloads or a stopped server."""
+        reply record. Raises on invalid payloads or a stopped server.
+
+        ``check_invariants`` (kwarg, or a ``"check_invariants"`` key in a
+        dict payload — the HTTP spelling) asks for the opt-in safety
+        summary: the reply record gains an ``"invariants"`` block with
+        Agreement/Validity verdicts computed at retirement (round 17)."""
+        if isinstance(payload, dict) and "check_invariants" in payload:
+            payload = dict(payload)
+            check_invariants = bool(payload.pop("check_invariants"))
         cfg = _admission.admit(payload, round_cap_ceiling=self._ceiling)
         bucket = _admission.bucket_of(cfg)
         with self._cv:
             if self._stop:
                 raise RuntimeError("server is shutting down")
             self._counter += 1
-            req = ServeRequest(f"r{self._counter:06d}", cfg, bucket)
+            req = ServeRequest(f"r{self._counter:06d}", cfg, bucket,
+                               check_invariants=check_invariants)
             self._submitted += 1
             _trace.event("serve.request", id=req.id, bucket=bucket.label(),
                          instances=int(cfg.instances))
@@ -295,7 +307,41 @@ class ConsensusServer:
         doc["rounds"] = [int(r) for r in result.rounds]
         doc["decision"] = [int(d) for d in result.decision]
         doc["latency_s"] = round(req.latency_s, 6)
+        if req.check_invariants:
+            doc["invariants"] = self._invariant_summary(req.cfg)
         return doc
+
+    @staticmethod
+    def _invariant_summary(cfg) -> dict:
+        """The opt-in reply safety block (round 17): re-run the config on
+        the full-state numpy checker (models/invariants.py) and fold the
+        verdicts into Agreement/Validity booleans plus a per-kind count —
+        a second pass the *client* no longer has to make."""
+        from byzantinerandomizedconsensus_tpu.models import (
+            invariants as _invariants)
+        rep = _invariants.check_config(cfg, backend="numpy")
+        viols = rep["violations"]
+        by_kind: dict = {}
+        for v in viols:
+            by_kind[v["kind"]] = by_kind.get(v["kind"], 0) + 1
+        if _metrics.enabled():
+            _metrics.counter(
+                "brc_serve_invariant_checks_total",
+                "Opt-in reply invariant checks run at retirement").inc()
+            if viols:
+                _metrics.counter(
+                    "brc_serve_invariant_violations_total",
+                    "Safety violations surfaced by reply invariant "
+                    "checks").inc(len(viols))
+        return {
+            "checked_instances": rep["checked_instances"],
+            "violations": len(viols),
+            "by_kind": by_kind,
+            "agreement_ok": by_kind.get("agreement", 0) == 0,
+            "validity_ok": by_kind.get("validity", 0) == 0,
+            # enough detail to reproduce the first few offenders standalone
+            "detail": viols[:8],
+        }
 
     # -- monitoring --------------------------------------------------------
 

@@ -312,6 +312,30 @@ def _metrics_rows_of(name: str, doc) -> list:
     return rows
 
 
+def _hunt_rows_of(name: str, doc) -> list:
+    """Schema-v1.8 ``hunt`` blocks of one artifact: (path, strategy, seed,
+    evaluations, best fitness, archive size, violations, steady-state
+    compiles, pipeline speedup) rows — the ledger's worst-case-search
+    columns."""
+    from byzantinerandomizedconsensus_tpu.obs import record as _record
+
+    rows = []
+    for path, ht in _blocks_of(doc, "hunt", _record.HUNT_BLOCK_KEYS):
+        rows.append({
+            "artifact": name,
+            "path": path,
+            "strategy": ht.get("strategy"),
+            "seed": ht.get("seed"),
+            "evaluations": ht.get("evaluations"),
+            "best_fitness": ht.get("best_fitness"),
+            "archive_size": ht.get("archive_size"),
+            "violations": ht.get("violations"),
+            "steady_state_compiles": ht.get("steady_state_compiles"),
+            "pipeline_speedup": ht.get("pipeline_speedup"),
+        })
+    return rows
+
+
 def sentinel_verdict(bench: dict, wall_chain: list,
                      programs_rows: list) -> dict:
     """The ``--check`` verdict: wall-chain regressions past
@@ -541,6 +565,12 @@ def build_ledger(root=None) -> dict:
     for name, doc in sorted(docs.items()):
         metrics_rows.extend(_metrics_rows_of(name, doc))
 
+    # ---- hunt worst-case columns (schema v1.8, round 17): every committed
+    # artifact carrying a closed-loop adversary-hunt block.
+    hunt_rows = []
+    for name, doc in sorted(docs.items()):
+        hunt_rows.extend(_hunt_rows_of(name, doc))
+
     from byzantinerandomizedconsensus_tpu.obs import record
 
     return {
@@ -557,6 +587,7 @@ def build_ledger(root=None) -> dict:
         "serve_rows": serve_rows,
         "fleet_rows": fleet_rows,
         "metrics_rows": metrics_rows,
+        "hunt_rows": hunt_rows,
         "bench_rounds": {str(r): bench[r] for r in rounds_seen},
         "wall_chain": chain,
         "device_chain": device_chain,
@@ -684,6 +715,22 @@ def format_report(doc: dict) -> str:
                 f"{row['families']} families / {row['series']} series, "
                 f"p99 {row['p99_latency_ms']} ms, "
                 f"decided {row['decided_fraction']}, slo {slo_s}")
+    # Present only once an artifact carries the v1.8 hunt block.
+    if doc.get("hunt_rows"):
+        lines.append("hunt worst-case columns (schema v1.8 — "
+                     "artifact[path]: strategy/seed evaluations "
+                     "best-fitness archive violations steady-state "
+                     "compiles speedup):")
+        for row in doc["hunt_rows"]:
+            lines.append(
+                f"  {row['artifact']}[{row['path']}]: "
+                f"{row['strategy']}/{row['seed']}, "
+                f"{row['evaluations']} evaluations, "
+                f"best {row['best_fitness']}, "
+                f"archive {row['archive_size']}, "
+                f"{row['violations']} violations, "
+                f"{row['steady_state_compiles']} steady-state compiles, "
+                f"pipeline {row['pipeline_speedup']}x")
     sent = doc.get("sentinel")
     if sent is not None:
         lines.append(

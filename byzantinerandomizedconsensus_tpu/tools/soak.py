@@ -50,70 +50,19 @@ from byzantinerandomizedconsensus_tpu.backends import get_backend
 from byzantinerandomizedconsensus_tpu.config import (
     DELIVERY_KINDS, FAULT_KINDS, SimConfig)
 from byzantinerandomizedconsensus_tpu.obs import trace as _trace
+# The seeded config-draw laws moved to the shared sampler seam in round 17 so
+# the chaos soak and the adversary hunter (hunt/space.py) can never drift;
+# the names are re-exported here because they ARE this module's public
+# reproducibility contract (tests/test_soak.py pins the population).
+from byzantinerandomizedconsensus_tpu.tools.sampler import (  # noqa: F401
+    GENERATOR_VERSION, MAX_SOAK_N, _ADVERSARIES, _CHAOS_WINDOWS, _COINS,
+    _INITS, _PROTOCOLS, _f_ceiling, random_config)
 from byzantinerandomizedconsensus_tpu.utils.rounds import default_artifact
-
-# Bumped whenever the draw sequence below changes shape: an artifact's config
-# population is reproducible only by (generator_version, seed) together —
-# plus the chaos flag: chaos appends fault-axis draws *after* the legacy
-# sequence, so non-chaos populations are unchanged since v1.
-GENERATOR_VERSION = 1
-
-MAX_SOAK_N = 40
 
 # Chaos-child defaults: one wall-clock budget per subprocess attempt and the
 # base of the exponential backoff before the single retry.
 CHAOS_TIMEOUT_S = 180.0
 CHAOS_BACKOFF_S = 0.5
-
-_PROTOCOLS = ("benor", "bracha")
-_ADVERSARIES = ("none", "crash", "byzantine", "adaptive", "adaptive_min")
-_COINS = ("local", "shared")
-_INITS = ("random", "all0", "all1", "split")
-_CHAOS_WINDOWS = (1, 2, 4, 8, 16)
-
-
-def _f_ceiling(protocol: str, adversary: str, n: int) -> int:
-    """Largest valid f for the resilience bound (config.validate §5.1/§5.2)."""
-    lying = adversary in ("byzantine", "adaptive", "adaptive_min")
-    if protocol == "bracha":
-        return (n - 1) // 3
-    if lying:
-        return (n - 1) // 5
-    return (n - 1) // 2
-
-
-def random_config(rng: random.Random, chaos: bool = False) -> SimConfig:
-    """One uniform-ish draw over the supported semantic surface, n ≤ 40.
-
-    ``chaos`` appends the spec-§9 fault axis (all four kinds, "none"
-    included as the in-population baseline) and a crash_window draw covering
-    the window edges — appended *after* the legacy draws, so the non-chaos
-    population of a (generator_version, seed) pair never moves.
-    """
-    while True:
-        protocol = rng.choice(_PROTOCOLS)
-        adversary = rng.choice(_ADVERSARIES)
-        n = rng.randrange(4, MAX_SOAK_N + 1)
-        fmax = _f_ceiling(protocol, adversary, n)
-        if fmax < 1 and adversary != "none":
-            continue  # too small to host a faulty set; redraw
-        f = rng.randrange(0, fmax + 1) if adversary == "none" \
-            else rng.randrange(1, fmax + 1)
-        cfg = SimConfig(
-            protocol=protocol, n=n, f=f,
-            instances=rng.randrange(8, 33),
-            adversary=adversary,
-            coin=rng.choice(_COINS),
-            init=rng.choice(_INITS),
-            seed=rng.randrange(1 << 32),
-            round_cap=rng.choice((32, 64, 128)),
-            delivery=rng.choice(DELIVERY_KINDS),
-        )
-        if chaos:
-            cfg = dataclasses.replace(
-                cfg, faults=rng.choice(FAULT_KINDS),
-                crash_window=rng.choice(_CHAOS_WINDOWS))
-        return cfg.validate()
 
 
 def _leg_summary(res) -> dict:

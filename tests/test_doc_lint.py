@@ -54,9 +54,11 @@ def test_span_kind_census_is_nontrivial_and_complete():
                      "serve.dispatch", "serve.reply", "fleet.spawn",
                      "fleet.backoff", "fleet.route", "fleet.dispatch",
                      "fleet.steal", "fleet.worker_lost", "fleet.readmit",
-                     "fleet.shutdown"):
+                     "fleet.shutdown", "hunt.run", "hunt.generation",
+                     "hunt.harvest", "hunt.best", "hunt.violation",
+                     "hunt.done"):
         assert expected in kinds, (expected, sorted(kinds))
-    assert len(kinds) >= 32
+    assert len(kinds) >= 38
 
 
 def test_every_emitted_span_kind_is_documented():
@@ -113,9 +115,15 @@ def test_metric_name_census_is_nontrivial_and_complete():
                      "brc_consensus_rounds", "brc_consensus_decided_total",
                      "brc_consensus_fault_silenced_total",
                      "brc_fleet_workers_alive", "brc_fleet_worker_up",
-                     "brc_fleet_steals_total", "brc_fleet_respawns_total"):
+                     "brc_fleet_steals_total", "brc_fleet_respawns_total",
+                     "brc_hunt_generations_total",
+                     "brc_hunt_evaluations_total",
+                     "brc_hunt_violations_total", "brc_hunt_best_fitness",
+                     "brc_hunt_archive_size",
+                     "brc_serve_invariant_checks_total",
+                     "brc_serve_invariant_violations_total"):
         assert expected in names, (expected, sorted(names))
-    assert len(names) >= 28
+    assert len(names) >= 35
 
 
 def test_every_registered_metric_is_documented():
@@ -146,6 +154,7 @@ def test_every_record_block_key_is_documented():
         "serve": record.SERVE_BLOCK_KEYS,
         "fleet": record.FLEET_BLOCK_KEYS,
         "metrics": record.METRICS_BLOCK_KEYS,
+        "hunt": record.HUNT_BLOCK_KEYS,
         "counters": ("supported", "totals"),
     }
     missing = []

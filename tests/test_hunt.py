@@ -1,0 +1,302 @@
+"""Round-17 adversary hunter: space admissibility, strategy determinism,
+archive replay (numpy + jax, against the committed regressions artifact),
+the bounded-WorkFeed backpressure seam, and a seeded in-process mini-hunt
+smoke over the real serving stack."""
+
+import json
+import pathlib
+import random
+
+import pytest
+
+from byzantinerandomizedconsensus_tpu.backends.batch import FusedBucket
+from byzantinerandomizedconsensus_tpu.backends.compaction import (
+    CompactionPolicy, WorkFeed, WorkFeedOverflow)
+from byzantinerandomizedconsensus_tpu.config import SimConfig
+from byzantinerandomizedconsensus_tpu.hunt import archive as hunt_archive
+from byzantinerandomizedconsensus_tpu.hunt import space as hunt_space
+from byzantinerandomizedconsensus_tpu.hunt.archive import Archive
+from byzantinerandomizedconsensus_tpu.hunt.hunter import Hunter, fitness_of
+from byzantinerandomizedconsensus_tpu.hunt.space import SearchSpace
+from byzantinerandomizedconsensus_tpu.hunt.strategies import (
+    STRATEGIES, make_strategy)
+from byzantinerandomizedconsensus_tpu.tools import sampler
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+_POLICY = CompactionPolicy(width=8, segment=1)
+
+
+def _fake_fitness(cfg) -> float:
+    """A deterministic stand-in evaluator: a pure function of the genome,
+    so strategy determinism can be tested without a grid."""
+    blob = json.dumps(hunt_space.encode(cfg), sort_keys=True)
+    return float(sum(blob.encode()) % 997)
+
+
+# ---- space ----------------------------------------------------------------
+
+
+def test_space_shares_the_chaos_sampler_laws():
+    """The hunt space draws THROUGH tools/sampler.py — same draw sequence,
+    same (generator_version, seed) contract as `brc-tpu chaos`."""
+    sp = SearchSpace()
+    assert sp.generator_version == sampler.GENERATOR_VERSION
+    assert sp.sample(random.Random(123)) == sampler.random_config(
+        random.Random(123), chaos=True)
+
+
+def test_space_candidates_are_admissible_everywhere():
+    """Sampled, mutated, crossed and region-pinned candidates all pass
+    validate() and stay inside the serving envelope (one fused tier,
+    round_cap within the default feed ceiling)."""
+    sp = SearchSpace()
+    rng = random.Random(42)
+    pool = [sp.sample(rng) for _ in range(30)]
+    pool.extend(sp.mutate(cfg, rng) for cfg in list(pool))
+    for a, b in zip(pool[:20], pool[20:40]):
+        pool.append(sp.crossover(a, b, rng))
+    for region in sp.regions():
+        pool.append(sp.sample_region(region, rng))
+    for cfg in pool:
+        cfg.validate()  # raises on an inadmissible candidate
+        assert cfg.n <= sp.max_n
+        assert cfg.round_cap <= 128
+        assert FusedBucket.of(cfg) in sp.buckets()
+
+
+def test_space_bucket_universe_is_complete_and_tiny():
+    """n ≤ 40 folds everything to one tier: the whole compiled-program
+    universe is 2 protocols × 4 deliveries — what makes a complete warm-up
+    (and hence the 0-steady-state-recompile pin) possible."""
+    sp = SearchSpace()
+    buckets = sp.buckets()
+    assert len(buckets) == 8
+    assert len(set(buckets)) == 8
+    rng = random.Random(7)
+    for _ in range(60):
+        assert FusedBucket.of(sp.sample(rng)) in buckets
+
+
+def test_space_region_pinning_survives_repair():
+    """sample_region must return a candidate IN the region (the bandit
+    attributes tells by the candidate's own axes) even where the forced
+    adversary needs a larger shape."""
+    sp = SearchSpace()
+    rng = random.Random(5)
+    for region in sp.regions():
+        for _ in range(5):
+            cfg = sp.sample_region(region, rng)
+            assert (cfg.adversary, cfg.delivery) == region
+
+
+def test_genome_roundtrip():
+    sp = SearchSpace()
+    cfg = sp.sample(random.Random(9))
+    assert hunt_space.decode(hunt_space.encode(cfg)) == cfg
+
+
+# ---- strategies -----------------------------------------------------------
+
+
+@pytest.mark.parametrize("name", sorted(STRATEGIES))
+def test_strategy_deterministic_from_name_and_seed(name):
+    """Two strategies built from the same (strategy, seed) produce the
+    identical candidate stream under the identical tell stream — the
+    reproducibility contract the committed artifact rests on."""
+    def run(seed):
+        st = make_strategy(name, SearchSpace(), seed)
+        out = []
+        for _ in range(40):
+            cfg = st.ask()
+            st.tell(cfg, _fake_fitness(cfg))
+            out.append(hunt_space.encode(cfg))
+        return out, st.best_fitness
+
+    a, best_a = run(11)
+    b, best_b = run(11)
+    assert a == b
+    assert best_a == best_b
+    c, _ = run(12)
+    assert a != c  # a different seed moves the stream
+
+
+@pytest.mark.parametrize("name", sorted(STRATEGIES))
+def test_strategy_candidates_admissible(name):
+    st = make_strategy(name, SearchSpace(), 3)
+    for _ in range(30):
+        cfg = st.ask()
+        cfg.validate()
+        st.tell(cfg, _fake_fitness(cfg))
+
+
+def test_bandit_halves_regions():
+    sp = SearchSpace()
+    st = make_strategy("bandit", sp, 1)
+    n0 = len(st._active)
+    for _ in range(len(sp.regions()) * st.RUNG0):
+        cfg = st.ask()
+        st.tell(cfg, _fake_fitness(cfg))
+    assert len(st._active) == max(1, n0 // 2)
+    assert st._rung == 1
+
+
+def test_unknown_strategy_rejected():
+    with pytest.raises(ValueError, match="unknown strategy"):
+        make_strategy("gradient", SearchSpace(), 0)
+
+
+# ---- archive --------------------------------------------------------------
+
+
+def test_archive_keeps_topk_sorted_and_dedupes():
+    sp = SearchSpace()
+    rng = random.Random(2)
+    a = Archive(4)
+    cfgs = [sp.sample(rng) for _ in range(12)]
+    for i, cfg in enumerate(cfgs):
+        a.offer(cfg, float(i), [1, 2], [1, 1])
+    assert len(a) == 4
+    fits = [e["fitness"] for e in a.entries()]
+    assert fits == sorted(fits, reverse=True)
+    assert fits == [11.0, 10.0, 9.0, 8.0]
+    # re-offering an archived genome is a no-op (distinct worst cases only)
+    assert a.offer(cfgs[-1], 99.0, [1, 2], [1, 1]) is False
+    assert len(a) == 4
+
+
+def test_archive_replay_detects_drift():
+    sp = SearchSpace()
+    cfg = sp.sample(random.Random(31))
+    from byzantinerandomizedconsensus_tpu.backends import get_backend
+    res = get_backend("numpy").run(cfg)
+    a = Archive(2)
+    a.offer(cfg, 5.0, res.rounds, res.decision)
+    entry = a.best()
+    assert hunt_archive.replay(entry, backend="numpy")["ok"]
+    tampered = dict(entry)
+    tampered["rounds"] = [r + 1 for r in entry["rounds"]]
+    verdict = hunt_archive.replay(tampered, backend="numpy")
+    assert not verdict["ok"] and verdict["mismatches"] > 0
+
+
+def _committed_regressions():
+    p = ROOT / "artifacts" / "hunt_regressions.json"
+    if not p.exists():
+        pytest.skip("no committed hunt_regressions.json")
+    return json.loads(p.read_text())
+
+
+def test_committed_archive_replays_bit_identically_numpy():
+    """Every archived worst case in the committed artifact replays
+    bit-identically on the numpy reference — the regression-pin contract
+    (the way adaptive_min became a preset)."""
+    doc = _committed_regressions()
+    assert doc["entries"], "committed archive is empty"
+    for entry in doc["entries"]:
+        verdict = hunt_archive.replay(entry, backend="numpy")
+        assert verdict["ok"], (entry["genome"], verdict)
+
+
+def test_committed_archive_replays_bit_identically_jax():
+    """The top archived worst case replays bit-identically on the jax
+    backend too — cross-backend, same arrays (the soak's differential
+    claim, applied to the hunter's finds)."""
+    doc = _committed_regressions()
+    verdict = hunt_archive.replay(doc["entries"][0], backend="jax")
+    assert verdict["ok"], verdict
+
+
+# ---- fitness --------------------------------------------------------------
+
+
+def test_fitness_weights_liveness_cliff():
+    cfg = SimConfig(protocol="benor", n=7, f=1, instances=4,
+                    adversary="crash", round_cap=64).validate()
+    decided = fitness_of(cfg, [3, 5, 4, 4], [1, 0, 1, 1])
+    capped = fitness_of(cfg, [64, 64, 64, 64], [2, 2, 2, 2])
+    assert decided["undecided_fraction"] == 0.0
+    assert capped["undecided_fraction"] == 1.0
+    # an undecided-at-cap population dominates any decided one
+    assert capped["fitness"] > decided["fitness"] + cfg.round_cap / 2
+
+
+# ---- bounded WorkFeed (backpressure satellite) ----------------------------
+
+
+def test_workfeed_default_stays_unbounded():
+    feed = WorkFeed(round_cap_ceiling=64)
+    assert feed.max_depth is None
+    cfg = SimConfig(protocol="benor", n=4, f=0, instances=1,
+                    round_cap=32).validate()
+    for _ in range(300):  # far past any plausible implicit bound
+        feed.push(cfg)
+    assert feed.pending() == 300
+
+
+def test_workfeed_bounded_rejects_overflow_by_name():
+    feed = WorkFeed(round_cap_ceiling=64, max_depth=2)
+    cfg = SimConfig(protocol="benor", n=4, f=0, instances=1,
+                    round_cap=32).validate()
+    feed.push(cfg)
+    feed.push(cfg)
+    with pytest.raises(WorkFeedOverflow, match="max_depth"):
+        feed.push(cfg)
+    # a drain (pull) frees depth again
+    assert len(feed.pull()) == 2
+    feed.push(cfg)
+    with pytest.raises(ValueError, match="max_depth"):
+        WorkFeed(max_depth=0)
+
+
+# ---- the closed loop ------------------------------------------------------
+
+
+@pytest.fixture()
+def server():
+    from byzantinerandomizedconsensus_tpu.serve.server import ConsensusServer
+    srv = ConsensusServer(backend="jax", policy=_POLICY)
+    srv.start()
+    yield srv
+    srv.shutdown(drain=True)
+
+
+def test_mini_hunt_smoke_pipelined(server):
+    """A seeded in-process mini-hunt over the real serving stack: budget
+    harvested exactly, all archive entries admissible, elite fitness
+    monotone non-increasing down the archive, best == archive head, and
+    the safety alarm quiet."""
+    sp = SearchSpace()
+    hunter = Hunter(server, make_strategy("evolution", sp, 5), space=sp,
+                    archive=Archive(4), generation=6, pipelined=True,
+                    check_invariants=True)
+    stats = hunter.run(18)
+    assert stats["evaluations"] == 18
+    assert stats["generations"] == 3
+    assert stats["violations"] == 0
+    assert 1 <= stats["archive_size"] <= 4
+    fits = [e["fitness"] for e in hunter.archive.entries()]
+    assert fits == sorted(fits, reverse=True)
+    assert stats["best_fitness"] == pytest.approx(fits[0])
+    for entry in hunter.archive.entries():
+        hunt_space.decode(entry["genome"])  # replayable genome
+    # the stats dict is a valid schema-v1.8 hunt block
+    from byzantinerandomizedconsensus_tpu.obs import record
+    stats["steady_state_compiles"] = 0
+    doc = record.new_record("hunt")
+    doc["hunt"] = record.hunt_block(stats)
+    assert record.validate_record(doc) == []
+
+
+def test_mini_hunt_reply_invariants_flow_to_hunter(server):
+    """check_invariants=True rides the round-17 serve satellite: the reply
+    record itself carries the verdict block (no client second pass)."""
+    sp = SearchSpace()
+    cfg = sp.sample(random.Random(77))
+    rec = server.submit(cfg, check_invariants=True).wait(timeout=600)
+    inv = rec["invariants"]
+    assert inv["checked_instances"] == cfg.instances
+    assert inv["agreement_ok"] is True and inv["validity_ok"] is True
+    assert inv["violations"] == 0
+    # and stays opt-in: a plain submit carries no invariants block
+    rec2 = server.submit(cfg).wait(timeout=600)
+    assert "invariants" not in rec2

@@ -510,3 +510,46 @@ def test_census_includes_fleet_artifact():
 
     report = ledger.format_report(doc)
     assert "fleet per-worker columns" in report
+
+
+def test_census_includes_hunt_artifact():
+    """The round-17 hunt artifact: parsed with zero errors, the
+    zero-violation / zero-steady-state-recompile pins and the pipelined
+    speedup on the record, and the schema-v1.8 hunt worst-case columns
+    reconstructed by the ledger."""
+    import pathlib
+
+    from byzantinerandomizedconsensus_tpu.utils.rounds import repo_root
+
+    doc = ledger.build_ledger()
+    assert doc["parse_errors"] == []
+    rows = {r["artifact"]: r for r in doc["hunt_rows"]}
+    assert "artifacts/hunt_r17.json" in rows, \
+        "hunt_r17.json must yield hunt worst-case columns"
+    row = rows["artifacts/hunt_r17.json"]
+    assert row["strategy"] == "evolution" and row["seed"] == 17
+    assert isinstance(row["evaluations"], int) and row["evaluations"] >= 500
+    assert row["best_fitness"] > 0
+    assert row["archive_size"] >= 1
+    assert row["violations"] == 0            # the round-17 safety claim
+    assert row["steady_state_compiles"] == 0  # under adversarial search
+    assert row["pipeline_speedup"] > 1        # ask-ahead beats the barrier
+
+    hv = json.loads(
+        (pathlib.Path(repo_root()) / "artifacts/hunt_r17.json").read_text())
+    assert hv["kind"] == "hunt"
+    assert record.validate_record(hv) == []
+    assert hv["record_revision"] >= 8  # schema v1.8
+    assert hv["hunt"]["rediscovery"]["above_baseline"] is True
+    assert all(r["ok"] for r in hv["replay_check"])
+
+    # the pinned regression archive rides the same schema head
+    rg = json.loads((pathlib.Path(repo_root())
+                     / "artifacts/hunt_regressions.json").read_text())
+    assert rg["kind"] == "hunt_regressions"
+    assert record.validate_record(rg) == []
+    assert len(rg["entries"]) == rg["k"] == 8
+
+    report = ledger.format_report(doc)
+    assert "hunt worst-case columns" in report
+    assert "steady-state compiles" in report

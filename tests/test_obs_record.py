@@ -52,7 +52,7 @@ def test_validate_record_rejects_unknown_revision():
                                            "record_revision": bad})), bad
     # Every revision this build knows — including the legacy implied-v1
     # absence — stays valid.
-    for ok in (None, 0, 1, 2, 3, 4, 5, 6, record.RECORD_REVISION):
+    for ok in (None, 0, 1, 2, 3, 4, 5, 6, 7, record.RECORD_REVISION):
         doc = record.new_record("x")
         if ok is None:
             doc.pop("record_revision")
@@ -156,6 +156,34 @@ def test_validate_record_checks_metrics_block():
     assert record.metrics_block({}) is None
 
 
+def test_validate_record_checks_hunt_block():
+    """Schema v1.8: a hunt block missing its required keys fails by name;
+    a real hunter stats dict validates (including the optional best
+    genome); a best entry without a genome fails by name."""
+    bad = {**record.new_record("hunt"), "hunt": {"strategy": "evolution"}}
+    problems = record.validate_record(bad)
+    assert any("hunt block missing" in p for p in problems), problems
+    assert any(p.startswith("hunt block is not a dict") for p in
+               record.validate_record(
+                   {**record.new_record("hunt"), "hunt": []}))
+
+    stats = {"strategy": "evolution", "seed": 17, "budget": 32,
+             "evaluations": 32, "generations": 2, "best_fitness": 256.0,
+             "archive_size": 8, "violations": 0,
+             "steady_state_compiles": 0,
+             "best": {"fitness": 256.0, "genome": {"protocol": "benor"}},
+             "pipeline_speedup": 2.2}
+    good = {**record.new_record("hunt"), "hunt": record.hunt_block(stats)}
+    assert record.validate_record(good) == []
+    assert good["hunt"]["pipeline_speedup"] == 2.2  # extras pass through
+
+    torn = {**good, "hunt": {**good["hunt"], "best": {"fitness": 1.0}}}
+    assert any("genome" in p for p in record.validate_record(torn)), \
+        record.validate_record(torn)
+
+    assert record.hunt_block(None) is None
+
+
 def test_timing_block_maps_suspect_to_error():
     """Absence-of-signal device 0.0s must land as errors (VERDICT r5 weak #1),
     real measurements as device_busy_s — the one mapping every tool shares."""
@@ -247,15 +275,18 @@ def test_schema_census_every_committed_artifact_validates():
         problems = record.validate_record(payload)
         assert problems == [], (p.name, problems)
         checked.append(p.name)
-    # The v1+ era census as committed (r8-r16: ledger_r8, chaos_r9,
+    # The v1+ era census as committed (r8-r17: ledger_r8, chaos_r9,
     # batch_r10, compaction_r11, BENCH_r11, trace_r12, programs_r13,
-    # serve_r14, serve_fleet_r15, metrics_r16): an accidentally narrowed
-    # glob must not silently pass on near-zero coverage — and the
-    # v1.4/v1.5/v1.6/v1.7 artifacts must be in the checked set, so the
-    # unknown-revision, serve-block, fleet-block, and metrics-block checks
-    # above provably ran against real revision-4/-5/-6/-7 heads.
-    assert len(checked) >= 9, checked
+    # serve_r14, serve_fleet_r15, metrics_r16, hunt_r17 +
+    # hunt_regressions): an accidentally narrowed glob must not silently
+    # pass on near-zero coverage — and the v1.4/v1.5/v1.6/v1.7/v1.8
+    # artifacts must be in the checked set, so the unknown-revision,
+    # serve-block, fleet-block, metrics-block, and hunt-block checks
+    # above provably ran against real revision-4..8 heads.
+    assert len(checked) >= 11, checked
     assert "programs_r13.json" in checked, checked
     assert "serve_r14.json" in checked, checked
     assert "serve_fleet_r15.json" in checked, checked
     assert "metrics_r16.json" in checked, checked
+    assert "hunt_r17.json" in checked, checked
+    assert "hunt_regressions.json" in checked, checked

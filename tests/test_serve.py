@@ -107,6 +107,30 @@ def test_admission_rejects_bad_requests():
     assert admission.bucket_of(cfg).protocol == "bracha"
 
 
+def test_serve_reply_carries_optin_invariant_summary():
+    """Round-17 satellite: ``submit(cfg, check_invariants=True)`` (or the
+    ``check_invariants`` key in a dict payload, the HTTP spelling) makes
+    the reply record carry the Agreement/Validity verdicts from the numpy
+    reference checker — and stays strictly opt-in."""
+    with ConsensusServer(policy=_POLICY) as srv:
+        flagged = srv.submit(_CFGS[0], check_invariants=True)
+        via_dict = srv.submit({"protocol": "bracha", "n": 7, "f": 2,
+                               "instances": 3, "round_cap": 32,
+                               "check_invariants": True})
+        plain = srv.submit(_CFGS[1])
+        rec = flagged.wait(timeout=600.0)
+        rec_d = via_dict.wait(timeout=600.0)
+        rec_plain = plain.wait(timeout=600.0)
+    for doc, n_inst in ((rec, _CFGS[0].instances), (rec_d, 3)):
+        inv = doc["invariants"]
+        assert inv["checked_instances"] == n_inst
+        assert inv["violations"] == 0 and inv["detail"] == []
+        assert inv["agreement_ok"] is True
+        assert inv["validity_ok"] is True
+        assert inv["by_kind"] == {}  # per-kind counts of observed offenders
+    assert "invariants" not in rec_plain
+
+
 def test_serve_span_kinds_emitted():
     """The §3e serve kinds ride every request: request + admit at intake,
     one dispatch span per grid, one reply per retirement."""
