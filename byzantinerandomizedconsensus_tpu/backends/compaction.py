@@ -166,13 +166,17 @@ class WorkFeed:
         self.round_cap_ceiling = int(round_cap_ceiling)
         self.max_depth = None if max_depth is None else int(max_depth)
         self._items: list = []
+        self._cancelled: list = []
         self._cv = threading.Condition()
         self._closed = False
 
-    def push(self, cfg, ids=None, token=None) -> None:
+    def push(self, cfg, ids=None, token=None, force: bool = False) -> None:
         """Enqueue one config (its instances become queued lane work).
         ``ids`` defaults to the config's full instance range; ``token`` is
-        returned verbatim to ``on_retire`` when the config completes."""
+        returned verbatim to ``on_retire`` when the config completes.
+        ``force=True`` bypasses the ``max_depth`` bound — the server's
+        rotation seed uses it, because seeded requests were admitted
+        before this feed existed (round 18)."""
         if cfg.round_cap > self.round_cap_ceiling:
             raise ValueError(
                 f"round_cap={cfg.round_cap} exceeds the feed ceiling "
@@ -182,7 +186,7 @@ class WorkFeed:
         with self._cv:
             if self._closed:
                 raise RuntimeError("push on a closed WorkFeed")
-            if self.max_depth is not None and \
+            if not force and self.max_depth is not None and \
                     len(self._items) >= self.max_depth:
                 raise WorkFeedOverflow(
                     f"WorkFeed depth {len(self._items)} at max_depth="
@@ -206,6 +210,34 @@ class WorkFeed:
         read (serve/server.py stats, serve/fleet.py)."""
         with self._cv:
             return len(self._items)
+
+    def cancel(self, token) -> bool:
+        """Mark ``token``'s work dead (round 18, the cancellation seam).
+
+        Items still queued in the feed are removed here, synchronously —
+        they never reach a lane. Items already pulled into a flying grid
+        are reclaimed by :func:`run_bucket` at its next segment boundary:
+        the lane is dropped from the host bookkeeping (no result is ever
+        recorded, ``on_retire`` never fires) and freed at the next
+        compaction refill. Returns True when the token was still queued
+        here (the cheap case); False means the grid owns it now — or never
+        saw it — and the boundary reap is the reclaim path. Survivors are
+        bit-identical either way: lane placement never enters a draw.
+        """
+        with self._cv:
+            n = len(self._items)
+            self._items = [it for it in self._items if it[2] is not token]
+            self._cancelled.append(token)
+            self._cv.notify_all()
+            return len(self._items) < n
+
+    def pop_cancelled(self) -> list:
+        """Drain the cancel marks since the last call — run_bucket's
+        segment-boundary reap reads them (tokens, verbatim)."""
+        with self._cv:
+            out = self._cancelled
+            self._cancelled = []
+            return out
 
     def pull(self, block: bool = False):
         """Everything pushed since the last pull: a list of
@@ -636,6 +668,42 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
     device_rounds = useful_rounds = 0
     n_carry = _n_carry(counters)
 
+    # Cancellation (round 18): config indices whose token was cancelled.
+    # Their queued stream entries are dropped, their live lanes reclaimed at
+    # the segment boundary (freed at the next refill), and no result is ever
+    # recorded for them — survivors stay bit-identical because placement
+    # never enters a draw.
+    dead: set = set()
+    cancelled_lanes = 0
+
+    def _reap() -> bool:
+        """Process feed.cancel() marks at the segment boundary. Returns
+        True when any lane or queued entry was reclaimed."""
+        nonlocal work_cfg, work_pos, work_iid, total, cancelled_lanes
+        changed = False
+        for token in feed.pop_cancelled():
+            for ci, t in enumerate(tokens):
+                if t is not token or ci in dead:
+                    continue
+                dead.add(ci)
+                tail = work_cfg[head:]
+                keep = tail != ci
+                dropped = int((~keep).sum())
+                if dropped:
+                    work_cfg = np.concatenate([work_cfg[:head], tail[keep]])
+                    work_pos = np.concatenate(
+                        [work_pos[:head], work_pos[head:][keep]])
+                    work_iid = np.concatenate(
+                        [work_iid[:head], work_iid[head:][keep]])
+                    total -= dropped
+                lanes = int((owner_cfg == ci).sum())
+                cancelled_lanes += lanes
+                owner_cfg[owner_cfg == ci] = -1
+                changed = True
+                _trace.event("compaction.cancel", cfg_index=ci,
+                             lanes=lanes, queued_dropped=dropped)
+        return changed
+
     # Fill the whole grid, then alternate segment dispatches with
     # compaction+refill dispatches whenever the retired fraction crosses the
     # policy threshold (always when the grid fully drains).
@@ -674,6 +742,8 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
             retire = np.asarray(fin_h, dtype=bool) & (owner_cfg >= 0)
             for ci in np.unique(owner_cfg[retire]):
                 ci = int(ci)
+                if ci in dead:
+                    continue  # cancelled: reclaim silently, never record
                 sel = retire & (owner_cfg == ci)
                 rows = owner_pos[sel]
                 rounds_out[ci][rows] = rounds_h[sel]
@@ -730,6 +800,9 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
                      f"{total - head} queued")
         if feed is not None:
             _ingest()  # arrivals during the dispatch join the queue
+            if _reap():  # cancels land at the same boundary
+                live = owner_cfg >= 0
+                free = W - int(live.sum())
         if head >= total and not live.any():
             # Grid idle. Offline that is the end; a live feed parks here
             # (blocking pull) until new work arrives or the feed closes.
@@ -798,6 +871,8 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
         "width": W,
         "segments": segments,
         "refills": refills,
+        "cancelled_cfgs": len(dead),
+        "cancelled_lanes": cancelled_lanes,
         "device_lane_rounds": device_rounds,
         "useful_lane_rounds": useful_rounds,
         "occupancy": (round(useful_rounds / device_rounds, 4)

@@ -346,7 +346,10 @@ def main(argv=None) -> int:
                         "1,2,4 sweeps the fleet and pins the scaling "
                         "curve (schema-v1.6 fleet block); --slo-p99-ms / "
                         "--slo-error-rate gate the run against a live "
-                        "/metrics scrape (exit 5 on breach)")
+                        "/metrics scrape (exit 5 on breach); --scenario "
+                        "flash_crowd|heavy_tail|bucket_churn|tenant_hog|"
+                        "cancel_storm|all runs the hostile-load suite "
+                        "(tools/hostile.py, schema-v1.9 hostile block)")
     sub.add_parser("dash",
                    help="live terminal dashboard over a serving endpoint's "
                         "GET /metrics (tools/dash.py): request p50/p99 + "

@@ -95,6 +95,18 @@ exported ``artifacts/hunt_regressions.json`` archive. Same compatibility
 rule as v1.1–v1.7: ``record_version`` stays 1, the revision is declarative,
 and the block shape is checked only when present.
 
+Schema v1.9 (round 18) adds the **hostile** block (:func:`hostile_block` —
+the hostile-load suite, tools/hostile.py + ``brc-tpu loadgen --scenario``):
+the suite seed, and one row per scenario (``flash_crowd`` / ``heavy_tail``
+/ ``bucket_churn`` / ``tenant_hog`` / ``cancel_storm``) carrying its
+request counts, named 429/backpressure rejections, cancellation counts,
+the deadline hit rate, the per-tenant p99 split (``tenant_hog``'s fairness
+pin), and the two standing pins — safety ``mismatches`` vs the offline
+differential and ``steady_state_compiles``. Carried by
+``artifacts/hostile_r18.json``. Same compatibility rule as v1.1–v1.8:
+``record_version`` stays 1, the revision is declarative, and the block
+shape is checked only when present.
+
 tools/ledger.py consumes both this format and the legacy r1–r7 shapes;
 :func:`validate_record` is the schema check the tier-1 tests pin, and
 ``brc-tpu ledger --check`` (the regression sentinel) compares the committed
@@ -118,8 +130,10 @@ RECORD_VERSION = 1
 # the metrics block (live metrics plane: registry snapshot digest, scraped
 # p99 / decided fraction, SLO verdict); v1.8 (round 17) the hunt block
 # (closed-loop adversary search: strategy identity, budget accounting,
-# best-fitness / violation / steady-compile pins).
-RECORD_REVISION = 8
+# best-fitness / violation / steady-compile pins); v1.9 (round 18) the
+# hostile block (hostile-load suite: per-scenario rejection / fairness /
+# deadline-hit-rate rows + mismatch / steady-compile pins).
+RECORD_REVISION = 9
 
 
 def env_fingerprint() -> dict:
@@ -461,6 +475,35 @@ def hunt_block(stats: dict | None) -> dict | None:
             if k in stats}
 
 
+#: The fields a schema-v1.9 ``hostile`` block must carry (the hostile-load
+#: suite of tools/hostile.py: suite identity, per-scenario rows, and the
+#: suite-wide mismatch / steady-compile / backpressure pins).
+HOSTILE_BLOCK_KEYS = ("suite_seed", "scenarios", "rejected_overflow",
+                      "mismatches", "steady_state_compiles")
+
+#: The fields every row of a hostile block's ``scenarios`` list must carry
+#: (one row per seeded scenario; the ledger's hostile columns).
+HOSTILE_SCENARIO_KEYS = ("scenario", "seed", "requests", "replied",
+                         "rejected", "cancelled", "mismatches",
+                         "steady_state_compiles", "slo_ok")
+
+
+def hostile_block(stats: dict | None) -> dict | None:
+    """The schema-v1.9 ``hostile`` block from a hostile-suite stats dict
+    (tools/hostile.py). None in, None out — a record without the block
+    stays a valid v1.x record. ``rejected_overflow`` is the suite-wide
+    count of named 429 overflow rejections (the acceptance gate requires
+    it nonzero in at least one scenario); ``mismatches`` and
+    ``steady_state_compiles`` are the pins whose committed value 0 is the
+    round's claim."""
+    if stats is None:
+        return None
+    return {k: stats.get(k) for k in
+            (HOSTILE_BLOCK_KEYS + ("generator_version", "duration_s",
+                                   "deadline_hit_rate", "fairness"))
+            if k in stats}
+
+
 def validate_record(doc: dict) -> list:
     """Schema check: returns a list of problems (empty = valid v1 record)."""
     problems = []
@@ -586,6 +629,29 @@ def validate_record(doc: dict) -> list:
             if best is not None and (not isinstance(best, dict)
                                      or "genome" not in best):
                 problems.append("hunt best entry missing 'genome'")
+    hb = doc.get("hostile")
+    if hb is not None:
+        if not isinstance(hb, dict):
+            problems.append("hostile block is not a dict")
+        else:
+            for key in HOSTILE_BLOCK_KEYS:
+                if key not in hb:
+                    problems.append(f"hostile block missing {key!r}")
+            rows = hb.get("scenarios")
+            if rows is not None:
+                if not isinstance(rows, list):
+                    problems.append("hostile scenarios is not a list")
+                else:
+                    for i, row in enumerate(rows):
+                        if not isinstance(row, dict):
+                            problems.append(
+                                f"hostile scenario row {i} is not a dict")
+                            continue
+                        for key in HOSTILE_SCENARIO_KEYS:
+                            if key not in row:
+                                problems.append(
+                                    f"hostile scenario row {i} missing "
+                                    f"{key!r}")
     pg = doc.get("programs")
     if pg is not None:
         if not isinstance(pg, dict):

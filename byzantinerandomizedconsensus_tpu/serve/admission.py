@@ -42,6 +42,83 @@ def _reject(reason: str) -> None:
 #: The payload keys a dict request may carry — exactly the SimConfig fields.
 REQUEST_FIELDS = tuple(f.name for f in dataclasses.fields(SimConfig))
 
+#: Request-envelope keys (round 18): scheduling hints that ride a dict
+#: payload NEXT TO the SimConfig fields and are popped before config
+#: validation — they must never become SimConfig fields, because SimConfig
+#: feeds the PRF draw coordinates and the fused bucket key (bit-identity
+#: and the zero-recompile pin both depend on that separation).
+ENVELOPE_FIELDS = ("check_invariants", "tenant", "deadline_ms", "priority")
+
+#: The tenant every envelope-less request belongs to — its behavior is
+#: pinned bit-for-bit against the pre-round-18 server.
+DEFAULT_TENANT = "default"
+
+
+class Backpressure(RuntimeError):
+    """The service is over a configured bound — retry later.
+
+    Raised by ``ConsensusServer.submit`` / ``FleetServer.submit`` when the
+    bounded pending-rotation queue or a per-tenant in-flight cap is hit
+    (``reason`` names which). The HTTP front end maps it — and the feed's
+    :class:`~byzantinerandomizedconsensus_tpu.backends.compaction
+    .WorkFeedOverflow` — to **429** with a ``Retry-After`` hint of
+    ``retry_after_s`` seconds (seeded jitter, so a synchronized crowd of
+    rejected clients decorrelates instead of re-stampeding).
+    """
+
+    def __init__(self, msg: str, reason: str = "overflow",
+                 retry_after_s: float = 0.1):
+        super().__init__(msg)
+        self.reason = reason
+        self.retry_after_s = float(retry_after_s)
+
+
+def envelope(payload):
+    """Split a request payload into (config payload, envelope dict).
+
+    Dict payloads may carry the :data:`ENVELOPE_FIELDS` scheduling keys;
+    they are validated and popped here so :func:`admit` sees pure SimConfig
+    fields. Non-dict payloads (an in-process SimConfig) get the default
+    envelope. Raises ``ValueError`` (named ``bad_envelope`` rejection) on
+    malformed values.
+    """
+    env = {"check_invariants": False, "tenant": DEFAULT_TENANT,
+           "deadline_ms": None, "priority": 0}
+    if not isinstance(payload, dict):
+        return payload, env
+    payload = dict(payload)
+    if "check_invariants" in payload:
+        env["check_invariants"] = bool(payload.pop("check_invariants"))
+    if "tenant" in payload:
+        tenant = payload.pop("tenant")
+        if tenant is None:
+            tenant = DEFAULT_TENANT
+        if not isinstance(tenant, str) or not tenant or len(tenant) > 64:
+            _reject("bad_envelope")
+            raise ValueError(
+                f"tenant must be a non-empty string (<= 64 chars), "
+                f"got {tenant!r}")
+        env["tenant"] = tenant
+    if "deadline_ms" in payload:
+        deadline = payload.pop("deadline_ms")
+        if deadline is not None:
+            if isinstance(deadline, bool) or \
+                    not isinstance(deadline, (int, float)) or deadline <= 0:
+                _reject("bad_envelope")
+                raise ValueError(
+                    f"deadline_ms must be a positive number, "
+                    f"got {deadline!r}")
+            env["deadline_ms"] = float(deadline)
+    if "priority" in payload:
+        prio = payload.pop("priority")
+        if isinstance(prio, bool) or not isinstance(prio, int) \
+                or not (-8 <= prio <= 8):
+            _reject("bad_envelope")
+            raise ValueError(
+                f"priority must be an int in [-8, 8], got {prio!r}")
+        env["priority"] = prio
+    return payload, env
+
 
 def admit(payload, round_cap_ceiling: int | None = None) -> SimConfig:
     """Validate a request payload into a :class:`SimConfig` or raise.

@@ -58,6 +58,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import random
 import subprocess
 import sys
 import threading
@@ -83,13 +84,24 @@ class FleetRequest:
     stealing, and re-admission after a worker loss."""
 
     __slots__ = ("id", "cfg", "bucket", "t_submit", "t_reply", "record",
-                 "error", "done")
+                 "error", "done", "tenant", "deadline_ms", "priority",
+                 "t_deadline", "cancelled")
 
-    def __init__(self, rid: str, cfg, bucket):
+    def __init__(self, rid: str, cfg, bucket,
+                 tenant: str = _admission.DEFAULT_TENANT,
+                 deadline_ms: Optional[float] = None, priority: int = 0):
         self.id = rid
         self.cfg = cfg
         self.bucket = bucket
+        # scheduling envelope (round 18) — routing/ordering hints only;
+        # nothing here enters the config or the PRF draws
+        self.tenant = tenant
+        self.deadline_ms = deadline_ms
+        self.priority = int(priority)
+        self.cancelled = False
         self.t_submit = time.perf_counter()
+        self.t_deadline = (None if deadline_ms is None
+                           else self.t_submit + deadline_ms / 1000.0)
         self.t_reply: Optional[float] = None
         self.record: Optional[dict] = None
         self.error: Optional[str] = None
@@ -253,6 +265,11 @@ class _ProcessWorker(_WorkerBase):
         self._emit({"op": "submit", "id": req.id,
                     "cfg": dataclasses.asdict(req.cfg)})
 
+    def send_cancel(self, rid: str) -> None:
+        # the child's inner cancel answers through a fail(cancelled) frame;
+        # a dead pipe resolves via _worker_lost's cancelled-orphan path
+        self._emit({"op": "cancel", "id": rid})
+
     def _read_loop(self) -> None:
         proc = self.proc
         for line in proc.stdout:
@@ -346,6 +363,7 @@ class _ThreadWorker(_WorkerBase):
         super().__init__(fleet, idx)
         self.inner: Optional[ConsensusServer] = None
         self._ids: dict = {}                # inner id -> fleet id
+        self._handles: dict = {}            # fleet id -> inner handle
         self._ids_cv = threading.Condition()
         self.final_stats: Optional[dict] = None
 
@@ -378,17 +396,26 @@ class _ThreadWorker(_WorkerBase):
             return
         with self._ids_cv:
             self._ids[handle.id] = req.id
+            self._handles[req.id] = handle
             self._ids_cv.notify_all()
         # inner failures (dispatch errors) set the handle without a reply
         # callback; a per-request waiter forwards them
         threading.Thread(target=self._watch, args=(req.id, handle),
                          daemon=True).start()
 
+    def send_cancel(self, rid: str) -> None:
+        with self._ids_cv:
+            handle = self._handles.get(rid)
+        if handle is not None and self.inner is not None:
+            # inner cancel sets error="cancelled"; _watch forwards it
+            self.inner.cancel(handle.id)
+
     def _on_inner_reply(self, inner_req) -> None:
         with self._ids_cv:
             while inner_req.id not in self._ids:
                 self._ids_cv.wait()
             fid = self._ids.pop(inner_req.id)
+            self._handles.pop(fid, None)
         rec = dict(inner_req.record)
         rec["request_id"] = fid
         self.fleet._resolve(self, fid, record=rec)
@@ -396,6 +423,9 @@ class _ThreadWorker(_WorkerBase):
     def _watch(self, fid: str, handle) -> None:
         handle.done.wait()
         if handle.error is not None:
+            with self._ids_cv:
+                self._ids.pop(handle.id, None)
+                self._handles.pop(fid, None)
             self.fleet._resolve(self, fid, error=handle.error)
 
     def live_stats(self) -> Optional[dict]:
@@ -435,7 +465,10 @@ class FleetServer:
                  spawn_timeout_s: float = CHAOS_TIMEOUT_S,
                  spawn_retries: int = 1,
                  backoff_s: float = CHAOS_BACKOFF_S,
-                 rotation_cap: Optional[int] = None):
+                 rotation_cap: Optional[int] = None,
+                 rotation_queue_depth: Optional[int] = None,
+                 tenant_inflight_cap: Optional[int] = None,
+                 aging_s: float = 5.0):
         if workers < 1:
             raise ValueError(f"workers={workers} out of range (>= 1)")
         if mode not in ("process", "thread"):
@@ -466,15 +499,27 @@ class FleetServer:
         # 1/its-weight-share regardless of worker count
         # (docs/SERVING.md §Fleet).
         self._rotation_cap = rotation_cap
+        # round-18 traffic bounds, same semantics as the single server:
+        # total parent-side pending rotations / per-tenant outstanding
+        self._rotation_queue_depth = (None if not rotation_queue_depth
+                                      else int(rotation_queue_depth))
+        self._tenant_cap = (None if not tenant_inflight_cap
+                            else int(tenant_inflight_cap))
+        self._aging_s = float(aging_s)
+        self._retry_rng = random.Random(0xF1E + workers)
         self._cv = threading.Condition()
         self._workers: list = []
         self._where: dict = {}          # bucket -> worker (sticky affinity)
         self._requests: list = []
+        self._byid: dict = {}           # fleet id -> unfinished FleetRequest
+        self._tenant_inflight: dict = {}
+        self._tenant_served: dict = {}
         self._counter = 0
         self._rpc_counter = 0
         self._submitted = 0
         self._replied = 0
         self._failed = 0
+        self._cancelled_n = 0
         self._steals = 0
         self._readmitted = 0
         self._lost_workers = 0
@@ -519,7 +564,14 @@ class FleetServer:
                ) -> FleetRequest:
         """Admit a payload and route it. ``pin_worker`` bypasses affinity
         routing (the warm-up seam: the loadgen warms every bucket on every
-        worker before measuring)."""
+        worker before measuring).
+
+        Dict payloads may carry the round-18 scheduling envelope
+        (``tenant``/``deadline_ms``/``priority``); a configured
+        rotation-queue bound or per-tenant cap rejects with
+        :class:`~byzantinerandomizedconsensus_tpu.serve.admission
+        .Backpressure` (HTTP 429 + Retry-After)."""
+        payload, env = _admission.envelope(payload)
         cfg = _admission.admit(payload, round_cap_ceiling=self._ceiling)
         bucket = _admission.bucket_of(cfg)
         with self._cv:
@@ -527,12 +579,112 @@ class FleetServer:
                 raise RuntimeError("fleet is shutting down")
             if not self._started:
                 raise RuntimeError("fleet not started")
+            tenant = env["tenant"]
+            if self._tenant_cap is not None and \
+                    self._tenant_inflight.get(tenant, 0) >= self._tenant_cap:
+                self._backpressure_locked(
+                    "tenant_cap",
+                    f"tenant {tenant!r} is at its in-flight cap "
+                    f"({self._tenant_cap})")
+            if self._rotation_queue_depth is not None and \
+                    sum(len(v) for w in self._workers
+                        for v in w.pending.values()) \
+                    >= self._rotation_queue_depth:
+                # coarse overload bound: while the parent-side backlog is
+                # at depth, all new work backs off (even would-be live
+                # joins — under overload that is the point)
+                self._backpressure_locked(
+                    "overflow",
+                    f"fleet rotation backlog is at its bound "
+                    f"({self._rotation_queue_depth})")
             self._counter += 1
-            req = FleetRequest(f"f{self._counter:06d}", cfg, bucket)
+            req = FleetRequest(f"f{self._counter:06d}", cfg, bucket,
+                               tenant=tenant,
+                               deadline_ms=env["deadline_ms"],
+                               priority=env["priority"])
             self._requests.append(req)
+            self._byid[req.id] = req
+            self._tenant_inflight[tenant] = \
+                self._tenant_inflight.get(tenant, 0) + 1
             self._submitted += 1
             self._route_locked(req, pin_worker=pin_worker)
         return req
+
+    def _backpressure_locked(self, reason: str, msg: str) -> None:
+        """Named rejection + ``serve.backpressure`` event + seeded-jitter
+        Retry-After hint (caller holds ``self._cv``)."""
+        _admission._reject(reason)
+        retry_after = round(0.05 + self._retry_rng.random() * 0.45, 3)
+        _trace.event("serve.backpressure", reason=reason,
+                     retry_after_s=retry_after)
+        raise _admission.Backpressure(
+            f"{msg}; retry after {retry_after}s",
+            reason=reason, retry_after_s=retry_after)
+
+    def _release_locked(self, req: FleetRequest) -> None:
+        self._byid.pop(req.id, None)
+        n = self._tenant_inflight.get(req.tenant, 0) - 1
+        if n > 0:
+            self._tenant_inflight[req.tenant] = n
+        else:
+            self._tenant_inflight.pop(req.tenant, None)
+
+    def cancel(self, rid: str) -> dict:
+        """Cancel an unfinished fleet request. Parent-side queued work is
+        removed immediately; work already handed to a worker is forwarded
+        as a ``cancel`` protocol op — the worker's inner server kills it
+        at the feed or reclaims its lanes at the next segment boundary,
+        and the resulting ``fail(cancelled)`` frame resolves the handle.
+        Same ack shape as ``ConsensusServer.cancel``."""
+        if _metrics.enabled():
+            _metrics.counter("brc_serve_cancel_requested_total",
+                             "Cancellations requested").inc()
+        forward = None
+        with self._cv:
+            req = self._byid.get(rid)
+            if req is None or req.done.is_set():
+                if _metrics.enabled():
+                    _metrics.counter(
+                        "brc_serve_cancel_too_late_total",
+                        "Cancellations that missed (unknown or already "
+                        "done)").inc()
+                return {"id": rid, "found": req is not None,
+                        "cancelled": False,
+                        "done": req is not None and req.done.is_set()}
+            req.cancelled = True
+            where = None
+            for w in self._workers:
+                reqs = w.pending.get(req.bucket)
+                if reqs is not None and req in reqs:
+                    reqs.remove(req)
+                    if not reqs:
+                        del w.pending[req.bucket]
+                    where = "queued"
+                    break
+                if rid in w.inflight:
+                    # stays in w.inflight until the worker's fail frame
+                    # resolves it — re-admission after a worker loss must
+                    # still see it (and will drop it, being cancelled)
+                    where = "live"
+                    forward = w
+                    break
+            if where is None:
+                where = "queued"  # routed nowhere (all workers dead)
+            if where == "queued":
+                req.error = "cancelled"
+                self._cancelled_n += 1
+                self._release_locked(req)
+                req.done.set()
+            self._cv.notify_all()
+        if forward is not None:
+            forward.send_cancel(rid)
+        if _metrics.enabled():
+            _metrics.counter("brc_serve_cancelled_total",
+                             "Requests cancelled before their reply",
+                             where=where).inc()
+        _trace.event("serve.cancel", id=rid, where=where,
+                     bucket=req.bucket.label())
+        return {"id": rid, "found": True, "cancelled": True, "where": where}
 
     def _route_locked(self, req: FleetRequest,
                       pin_worker: Optional[int] = None) -> None:
@@ -571,6 +723,7 @@ class FleetServer:
             # anything is measured — the cap is a steady-state
             # scheduling knob, not a warm-up one
             w.inflight[req.id] = req
+            self._mark_served_locked([req])
             w.send(req)
         elif w.current_bucket is None and not w.pending:
             self._dispatch_locked(w, req.bucket, [req])
@@ -615,10 +768,26 @@ class FleetServer:
         w.current_bucket = bucket
         for req in reqs:
             w.inflight[req.id] = req
+        self._mark_served_locked(reqs)
         _trace.event("fleet.dispatch", worker=w.idx, bucket=bucket.label(),
                      requests=len(reqs))
         for req in reqs:
             w.send(req)
+
+    def _mark_served_locked(self, reqs) -> None:
+        """Credit each request's tenant with its dispatched lane-round
+        weight — the deficit side of the fairness ordering. Re-admitted
+        requests (worker loss) are credited again; the bias is toward the
+        unlucky tenant's *competitors*, which only errs safe."""
+        for req in reqs:
+            w = int(req.cfg.round_cap) * int(req.cfg.instances)
+            self._tenant_served[req.tenant] = \
+                self._tenant_served.get(req.tenant, 0) + w
+            if _metrics.enabled():
+                _metrics.counter(
+                    "brc_serve_tenant_served_weight_total",
+                    "Lane-round weight dispatched, by tenant",
+                    tenant=req.tenant).inc(w)
 
     # -- reply / steal path ------------------------------------------------
 
@@ -630,11 +799,17 @@ class FleetServer:
             req = w.inflight.pop(fid, None)
             if req is None:
                 return  # stale: already re-admitted elsewhere
-            if record is not None:
+            self._release_locked(req)
+            if record is not None and not req.cancelled:
                 req.t_reply = time.perf_counter()
                 req.record = record
                 self._replied += 1
                 w.replied += 1
+            elif req.cancelled:
+                # a forwarded cancel coming home (fail frame, or a reply
+                # that raced the cancel and lost): counted at cancel()
+                req.error = "cancelled"
+                self._cancelled_n += 1
             else:
                 req.error = error or "worker error"
                 self._failed += 1
@@ -653,7 +828,7 @@ class FleetServer:
             cb = self._on_reply
             self._cv.notify_all()
         req.done.set()
-        if record is not None and cb is not None:
+        if req.record is not None and cb is not None:
             cb(req)
 
     @staticmethod
@@ -665,15 +840,30 @@ class FleetServer:
         return (max(r.cfg.round_cap for r in reqs),
                 sum(r.cfg.instances for r in reqs))
 
+    def _rotation_key_locked(self, bucket, reqs) -> tuple:
+        """Pending-rotation pick order (round 18): EDF urgency (deadline,
+        or ``t_submit + aging_s`` — priority shifts by aging windows),
+        quantized to 100 ms so near-ties fall to the tenant deficit, then
+        the pre-18 LPT chain weight (negated: longest chain first)."""
+        urgency = min((r.t_deadline if r.t_deadline is not None
+                       else r.t_submit + self._aging_s)
+                      - r.priority * self._aging_s for r in reqs)
+        deficit = min(self._tenant_served.get(r.tenant, 0) for r in reqs)
+        chain = self._chain_locked(reqs)
+        return (round(urgency, 1), deficit, -chain[0], -chain[1],
+                bucket.label())
+
     def _pump_locked(self, w) -> None:
-        """An idle worker takes its own longest pending rotation, else
-        steals the longest rotation from the live peer with the heaviest
-        stealable backlog (lane-round weight, see Worker.load)."""
+        """An idle worker takes its own most urgent (EDF; LPT among ties)
+        pending rotation, else steals the most urgent rotation from the
+        live peer with the heaviest stealable backlog (lane-round weight,
+        see Worker.load)."""
         if not w.alive:
             return
         if w.pending:
-            bucket = max(w.pending,
-                         key=lambda b: self._chain_locked(w.pending[b]))
+            bucket = min(w.pending,
+                         key=lambda b: self._rotation_key_locked(
+                             b, w.pending[b]))
             reqs = w.pending.pop(bucket)
             self._dispatch_locked(w, bucket, reqs)
             if bucket not in w.pending:
@@ -697,8 +887,9 @@ class FleetServer:
                        for b in stealable(o) for r in o.pending[b])
 
         victim = max(victims, key=lambda o: (backlog(o), -o.idx))
-        bucket = max(stealable(victim),       # longest stealable rotation
-                     key=lambda b: self._chain_locked(victim.pending[b]))
+        bucket = min(stealable(victim),   # most urgent stealable rotation
+                     key=lambda b: self._rotation_key_locked(
+                         b, victim.pending[b]))
         reqs = victim.pending.pop(bucket)
         self._where[bucket] = w
         w.steals += 1
@@ -750,6 +941,14 @@ class FleetServer:
                                  bucket=bucket.label() if bucket else None,
                                  requests=len(reqs))
                     for req in reqs:
+                        if req.cancelled:
+                            # a forwarded cancel orphaned by the loss:
+                            # complete it here instead of re-admitting
+                            req.error = "cancelled"
+                            self._cancelled_n += 1
+                            self._release_locked(req)
+                            req.done.set()
+                            continue
                         self._readmitted += 1
                         _metrics.counter(
                             "brc_fleet_readmitted_total",
@@ -761,6 +960,7 @@ class FleetServer:
     def _fail_locked(self, req: FleetRequest, why: str) -> None:
         req.error = why
         self._failed += 1
+        self._release_locked(req)
         _metrics.counter("brc_serve_failed_total",
                          "Requests failed after admission").inc()
         req.done.set()
@@ -828,12 +1028,22 @@ class FleetServer:
                 "submitted": self._submitted,
                 "replied": self._replied,
                 "failed": self._failed,
+                "cancelled": self._cancelled_n,
                 "steals": self._steals,
                 "readmitted": self._readmitted,
                 "lost_workers": self._lost_workers,
                 "policy": self._policy.doc(),
                 "round_cap_ceiling": self._ceiling,
                 "rotation_cap": self._rotation_cap,
+                "tenants": {
+                    t: self._tenant_inflight.get(t, 0)
+                    for t in set(self._tenant_inflight)
+                    | set(self._tenant_served)},
+                "bounds": {
+                    "feed_depth": None,
+                    "rotation_queue_depth": self._rotation_queue_depth,
+                    "tenant_inflight_cap": self._tenant_cap,
+                },
             }
         for w, alive, replied, steals, inflight, pending, load in rows:
             row = {"worker": w.idx, "pid": w.pid, "alive": alive,
@@ -869,6 +1079,13 @@ class FleetServer:
         with self._cv:
             rows = [(w, w.idx, w.alive, w.load(), len(w.inflight))
                     for w in self._workers]
+            tenants = {t: self._tenant_inflight.get(t, 0)
+                       for t in set(self._tenant_inflight)
+                       | set(self._tenant_served)}
+        for tenant, n in tenants.items():
+            _metrics.gauge("brc_serve_tenant_inflight",
+                           "Outstanding requests per tenant",
+                           tenant=tenant).set(n)
         _metrics.gauge("brc_fleet_workers_alive",
                        "Live fleet workers").set(
                            sum(1 for r in rows if r[2]))

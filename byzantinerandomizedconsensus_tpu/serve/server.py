@@ -46,6 +46,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import threading
 import time
 from typing import Optional
@@ -66,16 +67,28 @@ class ServeRequest:
     submitting thread until then."""
 
     __slots__ = ("id", "cfg", "bucket", "t_submit", "t_dispatch", "t_reply",
-                 "result", "record", "error", "done", "check_invariants")
+                 "result", "record", "error", "done", "check_invariants",
+                 "tenant", "deadline_ms", "priority", "t_deadline",
+                 "cancelled")
 
-    def __init__(self, rid: str, cfg, bucket, check_invariants: bool = False):
+    def __init__(self, rid: str, cfg, bucket, check_invariants: bool = False,
+                 tenant: str = _admission.DEFAULT_TENANT,
+                 deadline_ms: Optional[float] = None, priority: int = 0):
         self.id = rid
         self.cfg = cfg
         self.bucket = bucket
         # opt-in safety checking at retirement (round 17 satellite): the
         # reply record carries an Agreement/Validity verdict summary
         self.check_invariants = bool(check_invariants)
+        # envelope (round 18): scheduling hints only — none of these enter
+        # the PRF draws or the bucket key, so replies stay bit-identical
+        self.tenant = tenant
+        self.deadline_ms = deadline_ms
+        self.priority = int(priority)
+        self.cancelled = False
         self.t_submit = time.perf_counter()
+        self.t_deadline = (None if deadline_ms is None
+                           else self.t_submit + deadline_ms / 1000.0)
         # stamped when the request enters a live grid (feed push or seed) —
         # splits latency into queue wait vs grid service for the histograms
         self.t_dispatch: Optional[float] = None
@@ -110,7 +123,11 @@ class ConsensusServer:
 
     def __init__(self, backend: str = "jax", policy=None,
                  round_cap_ceiling: int = DEFAULT_ROUND_CAP_CEILING,
-                 on_reply=None, segment_hook=None):
+                 on_reply=None, segment_hook=None,
+                 feed_depth: Optional[int] = None,
+                 rotation_queue_depth: Optional[int] = None,
+                 tenant_inflight_cap: Optional[int] = None,
+                 aging_s: float = 5.0):
         from byzantinerandomizedconsensus_tpu.backends.base import get_backend
 
         self._backend = get_backend(backend)
@@ -124,6 +141,24 @@ class ConsensusServer:
         # stub injects its synthetic per-dispatch device latency here
         # (serve/fleet.py) — nothing flows back into the simulation math.
         self._segment_hook = segment_hook
+        # -- traffic bounds (round 18; None everywhere = pre-18, pinned) --
+        # active WorkFeed bound: a same-bucket push over it raises
+        # WorkFeedOverflow, surfaced as Backpressure/429
+        self._feed_depth = (None if not feed_depth else int(feed_depth))
+        # total requests allowed to wait for a grid rotation
+        self._rotation_queue_depth = (None if not rotation_queue_depth
+                                      else int(rotation_queue_depth))
+        # per-tenant outstanding-request cap
+        self._tenant_cap = (None if not tenant_inflight_cap
+                            else int(tenant_inflight_cap))
+        # EDF aging: a request with no deadline behaves as if its deadline
+        # were t_submit + aging_s, bounding starvation under EDF; priority
+        # shifts the effective deadline by whole aging windows
+        self._aging_s = float(aging_s)
+        # seeded jitter for Retry-After hints: deterministic per server, so
+        # hostile-suite runs are reproducible while a rejected crowd of
+        # clients still decorrelates
+        self._retry_rng = random.Random(0xB9C + int(round_cap_ceiling))
         self._cv = threading.Condition()
         # bucket -> [ServeRequest] queued while another bucket holds the grid
         self._pending: dict = {}
@@ -135,6 +170,15 @@ class ConsensusServer:
         self._submitted = 0
         self._replied = 0
         self._failed = 0
+        self._cancelled_n = 0
+        # id -> unfinished ServeRequest, for cancel(rid); entries leave at
+        # retire/fail/cancel so memory stays bounded by in-flight work
+        self._byid: dict = {}
+        # tenant -> outstanding requests / cumulative dispatched lane-round
+        # weight (round_cap × instances, the r15 balancing currency) — the
+        # deficit side of the fairness ordering
+        self._tenant_inflight: dict = {}
+        self._tenant_served: dict = {}
         self._thread: Optional[threading.Thread] = None
         # The persistent XLA compilation cache (BRC_COMPILATION_CACHE) keeps
         # warm-up compiles across server restarts, not just across requests.
@@ -187,42 +231,200 @@ class ConsensusServer:
         ``check_invariants`` (kwarg, or a ``"check_invariants"`` key in a
         dict payload — the HTTP spelling) asks for the opt-in safety
         summary: the reply record gains an ``"invariants"`` block with
-        Agreement/Validity verdicts computed at retirement (round 17)."""
-        if isinstance(payload, dict) and "check_invariants" in payload:
-            payload = dict(payload)
-            check_invariants = bool(payload.pop("check_invariants"))
+        Agreement/Validity verdicts computed at retirement (round 17).
+
+        Dict payloads may also carry the round-18 envelope fields
+        (``tenant``, ``deadline_ms``, ``priority`` —
+        serve/admission.py ``envelope()``); they steer *scheduling* only
+        and never enter the config, so replies stay bit-identical. Raises
+        :class:`~byzantinerandomizedconsensus_tpu.serve.admission
+        .Backpressure` when a configured bound (rotation queue, per-tenant
+        in-flight cap) is hit, and
+        :class:`~byzantinerandomizedconsensus_tpu.backends.compaction
+        .WorkFeedOverflow` when a bounded active feed is full — the HTTP
+        front end maps both to 429 + Retry-After."""
+        payload, env = _admission.envelope(payload)
+        if check_invariants:
+            env["check_invariants"] = True
         cfg = _admission.admit(payload, round_cap_ceiling=self._ceiling)
         bucket = _admission.bucket_of(cfg)
+        weight = int(cfg.round_cap) * int(cfg.instances)
         with self._cv:
             if self._stop:
                 raise RuntimeError("server is shutting down")
+            tenant = env["tenant"]
+            if self._tenant_cap is not None and \
+                    self._tenant_inflight.get(tenant, 0) >= self._tenant_cap:
+                self._backpressure_locked(
+                    "tenant_cap",
+                    f"tenant {tenant!r} is at its in-flight cap "
+                    f"({self._tenant_cap})")
             self._counter += 1
             req = ServeRequest(f"r{self._counter:06d}", cfg, bucket,
-                               check_invariants=check_invariants)
-            self._submitted += 1
-            _trace.event("serve.request", id=req.id, bucket=bucket.label(),
-                         instances=int(cfg.instances))
+                               check_invariants=env["check_invariants"],
+                               tenant=tenant,
+                               deadline_ms=env["deadline_ms"],
+                               priority=env["priority"])
             placed = False
             if self._active is not None and self._active[0] == bucket:
                 try:
                     self._active[1].push(cfg, token=req)
                     req.t_dispatch = time.perf_counter()
                     self._active[2].append(req)
+                    self._tenant_served[tenant] = \
+                        self._tenant_served.get(tenant, 0) + weight
+                    if _metrics.enabled():
+                        _metrics.counter(
+                            "brc_serve_tenant_served_weight_total",
+                            "Lane-round weight dispatched, by tenant",
+                            tenant=tenant).inc(weight)
                     placed = True
+                except _compaction.WorkFeedOverflow:
+                    # a bounded feed refuses the join outright: queueing it
+                    # anyway would defeat backpressure, so the client is
+                    # told to retry (it likely lands in the next rotation)
+                    self._backpressure_locked(
+                        "overflow",
+                        f"active feed for {bucket.label()} is at its bound "
+                        f"({self._feed_depth})")
                 except RuntimeError:
                     # the feed closed under us (rotation/shutdown race):
                     # the request queues for the bucket's next grid
                     placed = False
             if not placed:
+                if self._rotation_queue_depth is not None and \
+                        sum(len(v) for v in self._pending.values()) \
+                        >= self._rotation_queue_depth:
+                    self._backpressure_locked(
+                        "overflow",
+                        f"rotation queue is at its bound "
+                        f"({self._rotation_queue_depth})")
                 self._pending.setdefault(bucket, []).append(req)
                 if self._active is not None and self._active[0] != bucket:
                     # rotation: the resident grid stops refilling, drains
                     # its stragglers, and yields to this bucket
                     self._active[1].close()
+            self._submitted += 1
+            self._byid[req.id] = req
+            self._tenant_inflight[tenant] = \
+                self._tenant_inflight.get(tenant, 0) + 1
+            _trace.event("serve.request", id=req.id, bucket=bucket.label(),
+                         instances=int(cfg.instances), tenant=tenant)
             self._cv.notify_all()
         return req
 
+    def _backpressure_locked(self, reason: str, msg: str) -> None:
+        """Reject over a traffic bound: named rejection metric, a
+        ``serve.backpressure`` event, and a seeded-jitter Retry-After hint
+        (raises :class:`~byzantinerandomizedconsensus_tpu.serve.admission
+        .Backpressure`). Caller holds ``self._cv``."""
+        _admission._reject(reason)
+        retry_after = round(0.05 + self._retry_rng.random() * 0.45, 3)
+        _trace.event("serve.backpressure", reason=reason,
+                     retry_after_s=retry_after)
+        raise _admission.Backpressure(
+            f"{msg}; retry after {retry_after}s",
+            reason=reason, retry_after_s=retry_after)
+
+    def _release_locked(self, req: ServeRequest) -> None:
+        """Drop a finished request from the in-flight books (caller holds
+        ``self._cv``): the cancel registry and its tenant's count."""
+        self._byid.pop(req.id, None)
+        n = self._tenant_inflight.get(req.tenant, 0) - 1
+        if n > 0:
+            self._tenant_inflight[req.tenant] = n
+        else:
+            self._tenant_inflight.pop(req.tenant, None)
+
+    # -- cancellation ------------------------------------------------------
+
+    def cancel(self, rid: str) -> dict:
+        """Cancel an unfinished request by id (round 18). Queued work dies
+        immediately (pending rotation queue) or at the feed; a request
+        already holding live lanes is reclaimed by the grid at the next
+        segment boundary (``run_bucket``'s reap seam) — its lanes refill
+        from the feed and its reply is never produced. Replies that
+        already streamed are too late to cancel.
+
+        Returns an ack dict: ``{"id", "found", "cancelled", "where"}``
+        with ``where`` one of ``"queued"``/``"live"`` (or absent when
+        nothing was cancelled)."""
+        if _metrics.enabled():
+            _metrics.counter("brc_serve_cancel_requested_total",
+                             "Cancellations requested").inc()
+        with self._cv:
+            req = self._byid.get(rid)
+            if req is None or req.done.is_set():
+                if _metrics.enabled():
+                    _metrics.counter(
+                        "brc_serve_cancel_too_late_total",
+                        "Cancellations that missed (unknown or already "
+                        "done)").inc()
+                return {"id": rid, "found": req is not None,
+                        "cancelled": False,
+                        "done": req is not None and req.done.is_set()}
+            req.cancelled = True
+            where = "live"
+            reqs = self._pending.get(req.bucket)
+            if reqs is not None and req in reqs:
+                reqs.remove(req)
+                if not reqs:
+                    del self._pending[req.bucket]
+                where = "queued"
+            elif self._active is not None and self._active[0] == req.bucket:
+                # feed.cancel() strips a still-queued item (True: it never
+                # reached a lane) and leaves a reap marker either way — a
+                # live lane owner is reclaimed at the next segment boundary
+                where = ("queued" if self._active[1].cancel(req)
+                         else "live")
+            req.error = "cancelled"
+            self._cancelled_n += 1
+            self._release_locked(req)
+            req.done.set()
+            self._cv.notify_all()
+        if _metrics.enabled():
+            _metrics.counter(
+                "brc_serve_cancelled_total",
+                "Requests cancelled before their reply",
+                where=where).inc()
+        _trace.event("serve.cancel", id=rid, where=where,
+                     bucket=req.bucket.label())
+        return {"id": rid, "found": True, "cancelled": True, "where": where}
+
     # -- dispatcher --------------------------------------------------------
+
+    def _next_bucket_locked(self):
+        """Pick the bucket for the next grid rotation (round 18).
+
+        Pre-18 this was FIFO dict order; now each pending bucket is keyed
+        by (quantized urgency, tenant deficit, arrival, label):
+
+        - **urgency** — the bucket's most urgent request under EDF: its
+          deadline, or ``t_submit + aging_s`` when it has none (the aging
+          term bounds starvation — after one aging window a FIFO request
+          looks like an expired deadline and beats any future one).
+          ``priority`` shifts urgency by whole aging windows. Quantized to
+          100 ms so the fairness term can break near-ties.
+        - **tenant deficit** — the least cumulative dispatched lane-round
+          weight (``round_cap×instances``) among the bucket's tenants: a
+          hog tenant's buckets lose ties to starved tenants' buckets.
+
+        Ordering here only chooses *which* grid runs next; same-bucket
+        joins stay arrival-timing-free, so program cache keys — and the
+        zero-recompile pin — are untouched. Caller holds ``self._cv``."""
+        def key(item):
+            bucket, reqs = item
+            urgency = min(
+                (r.t_deadline if r.t_deadline is not None
+                 else r.t_submit + self._aging_s)
+                - r.priority * self._aging_s
+                for r in reqs)
+            deficit = min(self._tenant_served.get(r.tenant, 0)
+                          for r in reqs)
+            t0 = min(r.t_submit for r in reqs)
+            return (round(urgency, 1), deficit, t0, bucket.label())
+
+        return min(self._pending.items(), key=key)[0]
 
     def _loop(self) -> None:
         while True:
@@ -231,14 +433,27 @@ class ConsensusServer:
                     self._cv.wait()
                 if not self._pending:
                     return  # stopped and drained
-                bucket = next(iter(self._pending))
+                bucket = self._next_bucket_locked()
                 reqs = self._pending.pop(bucket)
-                feed = _compaction.WorkFeed(round_cap_ceiling=self._ceiling)
+                feed = _compaction.WorkFeed(round_cap_ceiling=self._ceiling,
+                                            max_depth=self._feed_depth)
                 # seed before the feed is visible to submitters: a rotation
-                # close cannot land mid-seed
+                # close cannot land mid-seed (seeding ignores the depth
+                # bound — these requests were already admitted)
                 for req in reqs:
-                    feed.push(req.cfg, token=req)
+                    feed.push(req.cfg, token=req, force=True)
                     req.t_dispatch = time.perf_counter()
+                    w = int(req.cfg.round_cap) * int(req.cfg.instances)
+                    self._tenant_served[req.tenant] = \
+                        self._tenant_served.get(req.tenant, 0) + w
+                    if _metrics.enabled():
+                        _metrics.counter(
+                            "brc_serve_tenant_served_weight_total",
+                            "Lane-round weight dispatched, by tenant",
+                            tenant=req.tenant).inc(w)
+                _trace.event("serve.rotate", bucket=bucket.label(),
+                             seeded=len(reqs),
+                             pending_buckets=len(self._pending))
                 run_reqs = list(reqs)
                 self._active = (bucket, feed, run_reqs)
                 # keep the feed open only when this bucket is the sole
@@ -264,12 +479,29 @@ class ConsensusServer:
                 self._cv.notify_all()
 
     def _retire(self, req: ServeRequest, result) -> None:
-        req.t_reply = time.perf_counter()
+        with self._cv:
+            if req.cancelled or req.done.is_set():
+                # cancel() won the race (its reap marker lands at a later
+                # boundary than this retirement): the reply is dropped —
+                # the request already answered "cancelled"
+                return
+            req.t_reply = time.perf_counter()
+            self._replied += 1
+            self._release_locked(req)
         req.result = result
         req.record = self._reply_record(req, result)
-        with self._cv:
-            self._replied += 1
         if _metrics.enabled():
+            if req.t_deadline is not None:
+                if req.t_reply <= req.t_deadline:
+                    _metrics.counter(
+                        "brc_serve_deadline_met_total",
+                        "Replies that beat their deadline_ms "
+                        "envelope").inc()
+                else:
+                    _metrics.counter(
+                        "brc_serve_deadline_missed_total",
+                        "Replies that missed their deadline_ms "
+                        "envelope").inc()
             _metrics.counter("brc_serve_replied_total",
                              "Replies streamed back at retire").inc()
             _metrics.histogram(
@@ -292,8 +524,10 @@ class ConsensusServer:
             self._on_reply(req)
 
     def _fail(self, req: ServeRequest, why: str) -> None:
+        # caller holds self._cv (shutdown and the dispatch-error path)
         req.error = why
         self._failed += 1
+        self._release_locked(req)
         _metrics.counter("brc_serve_failed_total",
                          "Requests failed after admission").inc()
         req.done.set()
@@ -365,8 +599,22 @@ class ConsensusServer:
                 "feed_depth": feed_depth,
                 "replied": self._replied,
                 "failed": self._failed,
+                "cancelled": self._cancelled_n,
                 "active_bucket": active,
                 "pending": pending,
+                # round-18 traffic plane: per-tenant outstanding requests
+                # (zero entries kept for ever-seen tenants so the gauge
+                # falls back to 0) and the configured bounds (all None =
+                # pre-18 behavior)
+                "tenants": {
+                    t: self._tenant_inflight.get(t, 0)
+                    for t in set(self._tenant_inflight)
+                    | set(self._tenant_served)},
+                "bounds": {
+                    "feed_depth": self._feed_depth,
+                    "rotation_queue_depth": self._rotation_queue_depth,
+                    "tenant_inflight_cap": self._tenant_cap,
+                },
                 "policy": self._policy.doc(),
                 "round_cap_ceiling": self._ceiling,
                 # one-shape rule (round 16): the single-grid server reports
@@ -405,6 +653,10 @@ class ConsensusServer:
         _metrics.gauge("brc_compile_cache_entries",
                        "Programs resident in the CompileCache").set(
                            st["compile_cache"]["entries"])
+        for tenant, n in st.get("tenants", {}).items():
+            _metrics.gauge("brc_serve_tenant_inflight",
+                           "Outstanding requests per tenant",
+                           tenant=tenant).set(n)
 
     def compile_count(self) -> int:
         """Compiles so far — the loadgen's zero-steady-state probe."""
@@ -412,6 +664,19 @@ class ConsensusServer:
 
 
 # -- stdlib HTTP front end -------------------------------------------------
+
+#: Largest accepted request body. A SimConfig-fields JSON object is a few
+#: hundred bytes; anything near this bound is hostile or broken, and is
+#: rejected 413 with the named ``body_too_large`` rejection metric before
+#: a byte of it is read (round 18 satellite).
+MAX_BODY_BYTES = 1 << 20
+
+
+class _BodyTooLarge(Exception):
+    def __init__(self, length: int):
+        super().__init__(f"request body {length} bytes exceeds the "
+                         f"{MAX_BODY_BYTES}-byte cap")
+        self.length = length
 
 
 def serve_http(server: ConsensusServer, host: str = "127.0.0.1",
@@ -428,25 +693,35 @@ def serve_http(server: ConsensusServer, host: str = "127.0.0.1",
         def log_message(self, *a):  # quiet: the trace is the log
             pass
 
-        def _reply(self, code: int, doc: dict) -> None:
+        def _reply(self, code: int, doc: dict, headers=None) -> None:
             body = json.dumps(doc).encode()
-            self.send_response(code)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            try:
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+            except (BrokenPipeError, ConnectionResetError):
+                pass  # client went away mid-reply; nothing to salvage
 
         def _reply_text(self, code: int, text: str,
                         content_type: str = _metrics.CONTENT_TYPE) -> None:
             body = text.encode("utf-8")
-            self.send_response(code)
-            self.send_header("Content-Type", content_type)
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            try:
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            except (BrokenPipeError, ConnectionResetError):
+                pass  # client went away mid-reply; nothing to salvage
 
         def _read_payload(self):
             length = int(self.headers.get("Content-Length") or 0)
+            if length > MAX_BODY_BYTES:
+                raise _BodyTooLarge(length)
             raw = self.rfile.read(length) if length else b"{}"
             return json.loads(raw.decode() or "{}")
 
@@ -481,14 +756,38 @@ def serve_http(server: ConsensusServer, host: str = "127.0.0.1",
             return self._reply(404, {"error": f"unknown path {self.path!r}"})
 
         def do_POST(self):  # noqa: N802 — stdlib handler name
+            if self.path.startswith("/cancel/"):
+                rid = self.path[len("/cancel/"):]
+                with lock:
+                    known = rid in requests
+                cancel = getattr(server, "cancel", None)
+                if not known or cancel is None:
+                    # same 404-with-JSON contract as /result/<id>
+                    return self._reply(404, {"error": f"unknown id {rid!r}"})
+                return self._reply(200, cancel(rid))
             if self.path not in ("/submit", "/run"):
                 return self._reply(404,
                                    {"error": f"unknown path {self.path!r}"})
             try:
                 payload = self._read_payload()
                 req = server.submit(payload)
+            except _BodyTooLarge as e:
+                _admission._reject("body_too_large")
+                return self._reply(413, {"error": str(e)})
             except (ValueError, TypeError, json.JSONDecodeError) as e:
                 return self._reply(400, {"error": str(e)})
+            except (_compaction.WorkFeedOverflow,
+                    _admission.Backpressure) as e:
+                # backpressure, not failure: 429 + a Retry-After hint
+                # (seeded jitter) — before the RuntimeError→503 arm, since
+                # both types subclass RuntimeError
+                retry_after = getattr(e, "retry_after_s", 0.1)
+                return self._reply(
+                    429,
+                    {"error": str(e),
+                     "reason": getattr(e, "reason", "overflow"),
+                     "retry_after_s": retry_after},
+                    headers={"Retry-After": f"{retry_after:.3f}"})
             except RuntimeError as e:
                 return self._reply(503, {"error": str(e)})
             with lock:
@@ -534,6 +833,16 @@ def main(argv=None) -> int:
                          ">1 the fleet dispatcher (serve/fleet.py — "
                          "subprocess workers, bucket-affinity routing, "
                          "work stealing; docs/SERVING.md §Fleet)")
+    ap.add_argument("--feed-depth", type=int, default=0,
+                    help="bound the active WorkFeed: same-bucket joins "
+                         "over this depth answer 429 + Retry-After "
+                         "(0 = unbounded, the pinned default)")
+    ap.add_argument("--rotation-queue-depth", type=int, default=0,
+                    help="bound the total requests waiting for a grid "
+                         "rotation (0 = unbounded, the pinned default)")
+    ap.add_argument("--tenant-cap", type=int, default=0,
+                    help="per-tenant outstanding-request cap "
+                         "(0 = uncapped, the pinned default)")
     args = ap.parse_args(argv)
 
     if args.trace_dir:
@@ -551,10 +860,18 @@ def main(argv=None) -> int:
         server_cm = FleetServer(workers=args.workers, backend=args.backend,
                                 policy=policy,
                                 round_cap_ceiling=args.round_cap_ceiling,
-                                trace_dir=args.trace_dir)
+                                trace_dir=args.trace_dir,
+                                rotation_queue_depth=(
+                                    args.rotation_queue_depth or None),
+                                tenant_inflight_cap=args.tenant_cap or None)
     else:
         server_cm = ConsensusServer(backend=args.backend, policy=policy,
-                                    round_cap_ceiling=args.round_cap_ceiling)
+                                    round_cap_ceiling=args.round_cap_ceiling,
+                                    feed_depth=args.feed_depth or None,
+                                    rotation_queue_depth=(
+                                        args.rotation_queue_depth or None),
+                                    tenant_inflight_cap=args.tenant_cap
+                                    or None)
     with server_cm as srv:
         httpd = serve_http(srv, host=args.host, port=args.port)
         print(f"brc-tpu serve: listening on http://{args.host}:{args.port} "

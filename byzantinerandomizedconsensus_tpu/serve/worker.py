@@ -14,6 +14,8 @@ spawn discipline as the chaos subprocess ladder in tools/soak.py):
 
 parent → worker
     ``{"op": "submit", "id": fid, "cfg": {...SimConfig fields...}}``
+    ``{"op": "cancel", "id": fid}``   (round 18: inner server.cancel —
+    the answer comes back as a ``fail`` frame with error "cancelled")
     ``{"op": "stats", "rpc": k}``
     ``{"op": "shutdown"}``
 
@@ -116,6 +118,7 @@ def main(argv=None) -> int:
     # mapping under this condition instead of racing it.
     ids: dict = {}
     ids_cv = threading.Condition()
+    handles: dict = {}  # fleet id -> inner handle (the cancel-op map)
     watch: "queue.Queue" = queue.Queue()
 
     def on_reply(req) -> None:
@@ -123,6 +126,7 @@ def main(argv=None) -> int:
             while req.id not in ids:
                 ids_cv.wait()
             fid = ids.pop(req.id)
+            handles.pop(fid, None)
         rec = dict(req.record)
         rec["request_id"] = fid
         emit({"op": "reply", "id": fid, "record": rec})
@@ -184,8 +188,16 @@ def main(argv=None) -> int:
                     continue
                 with ids_cv:
                     ids[handle.id] = fid
+                    handles[fid] = handle
                     ids_cv.notify_all()
                 watch.put((fid, handle))
+            elif op == "cancel":
+                with ids_cv:
+                    handle = handles.get(msg.get("id"))
+                if handle is not None:
+                    # cancel sets error="cancelled" + done; the watcher
+                    # thread then emits the fail frame the parent expects
+                    server.cancel(handle.id)
             elif op == "stats":
                 emit({"op": "stats", "rpc": msg.get("rpc"),
                       "stats": worker_stats()})

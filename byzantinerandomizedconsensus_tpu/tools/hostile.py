@@ -1,0 +1,640 @@
+"""Hostile-load suite for the consensus service (round 18).
+
+Where tools/loadgen.py measures the service under *friendly* traffic,
+this suite drives it with the five hostile shapes ISSUE-14 names — each a
+seeded, reproducible scenario with its own server, its own warm-up, and
+its own exit-code-enforced gates:
+
+``flash_crowd``
+    A synchronized burst of same-bucket clients against a **bounded**
+    server (``feed_depth``/``rotation_queue_depth``) over live HTTP. The
+    crowd is larger than the bounds on purpose: clients must see real
+    **429 + Retry-After** answers, honor the hint, and retry until
+    accepted. Gate: at least one named ``overflow`` rejection (exit 6 if
+    backpressure was never demonstrated) and every eventually-accepted
+    request replied bit-identically.
+``heavy_tail``
+    A mixed population carrying the round-18 request envelope —
+    ``deadline_ms`` and ``priority`` scheduling hints — so the EDF
+    rotation order is exercised; the recorded ``deadline_hit_rate`` is
+    the suite's deadline-scheduling witness.
+``bucket_churn``
+    Requests round-robined across three fused buckets: a rotation storm.
+    The zero-steady-state-recompile pin must hold through every rotation
+    (this is the tier-1 smoke scenario — no timing sensitivity).
+``tenant_hog``
+    One tenant floods the service with heavy work while an interactive
+    tenant submits small deadline-carrying requests. The per-tenant
+    in-flight cap plus deficit-weighted rotation ordering must keep the
+    non-hog tenant's p99 inside the fairness bound (exit 4 on breach).
+``cancel_storm``
+    A seeded ~40% of a two-bucket burst is cancelled at staggered
+    delays — some still queued (killed at the feed / pending rotation),
+    some live in lanes (reclaimed at the next segment boundary). Every
+    request must resolve (reply or ``cancelled``) and every *surviving*
+    reply must stay bit-identical to the offline path.
+
+Every scenario's population is a pure function of ``(suite seed,
+scenario index)``; observed counts (rejections, cancel timing splits)
+are measurements, the gates are the claims. The committed artifact::
+
+    python -m byzantinerandomizedconsensus_tpu.tools.hostile \\
+        --seed 18 --out artifacts/hostile_r18.json
+
+``brc-tpu loadgen --scenario <name>`` delegates here, so the hostile
+suite rides the existing loadgen entry point.
+
+Exit codes: 1 differential mismatch, 2 steady-state compiles, 3 invalid
+record, 4 tenant fairness breach, 5 scenario SLO gate failed, 6 no
+overflow rejection demonstrated (backpressure never engaged).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import pathlib
+import random
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from byzantinerandomizedconsensus_tpu.backends import compaction as _compaction
+from byzantinerandomizedconsensus_tpu.config import SimConfig
+from byzantinerandomizedconsensus_tpu.obs import metrics as _metrics
+from byzantinerandomizedconsensus_tpu.obs import record
+from byzantinerandomizedconsensus_tpu.serve import admission as _admission
+from byzantinerandomizedconsensus_tpu.utils import metrics
+from byzantinerandomizedconsensus_tpu.utils.rounds import default_artifact
+
+# Bumped whenever any scenario's draw sequence changes shape: a hostile
+# artifact's populations are reproducible only by
+# (generator_version, suite seed) together.
+HOSTILE_GENERATOR_VERSION = 1
+
+SCENARIOS = ("flash_crowd", "heavy_tail", "bucket_churn", "tenant_hog",
+             "cancel_storm")
+
+#: Admitted round_cap ceiling for the hostile servers — half the serving
+#: default: the suite's populations are many small requests, and the
+#: ceiling is the drain-segment length every warm-up must pay for.
+ROUND_CAP_CEILING = 64
+
+#: Per-scenario request counts, (full, --smoke).
+_SIZES = {
+    "flash_crowd": (28, 10),
+    "heavy_tail": (30, 10),
+    "bucket_churn": (18, 9),
+    "tenant_hog": (24, 10),   # hog 2/3, interactive 1/3
+    "cancel_storm": (24, 10),
+}
+
+#: The fairness bound (tenant_hog): the interactive tenant's p99 must stay
+#: under max(half the hog's p99, this floor) — the floor keeps the gate
+#: robust on slow shared CI boxes where everything is uniformly slow.
+_FAIRNESS_FLOOR_MS = 2000.0
+
+
+def _cfg(protocol: str, n: int, f: int, seed: int, *, instances: int = 4,
+         round_cap: int = 32, delivery: str = "keys",
+         adversary: str = "none") -> SimConfig:
+    return SimConfig(protocol=protocol, n=n, f=f, instances=instances,
+                     adversary=adversary, coin="local", init="random",
+                     seed=seed, round_cap=round_cap,
+                     delivery=delivery).validate()
+
+
+def _warm_config(bucket, seq: int) -> SimConfig:
+    """Like loadgen's warm config, at the hostile ceiling: enough
+    instances to overflow the grid width (refill program) and the ceiling
+    cap (rotation closes catch live lanes → drain program)."""
+    n = min(7, bucket.n_pad)
+    return SimConfig(
+        protocol=bucket.protocol, n=n, f=1, instances=16,
+        adversary="none", coin="local", init="random", seed=1000 + seq,
+        round_cap=ROUND_CAP_CEILING, delivery=bucket.delivery).validate()
+
+
+def _warm(server, buckets, burst: int = 4) -> int:
+    """Compile every steady-state program for every bucket. Phase one is
+    the loadgen chaining (same-bucket bursts, submitted back-to-back so
+    bucket-to-bucket rotations close grids mid-flight); phase two closes
+    EVERY bucket's grid live — one long config per bucket, the next
+    bucket's closer submitted only once the previous is dispatched, so
+    each rotation catches live lanes and compiles that bucket's drain leg
+    (a closer submitted too early would live-join the still-active grid
+    instead of forcing a rotation). ``burst`` stays under any feed /
+    tenant bound the scenario's server carries. Returns the warm-up
+    compile count."""
+    handles = []
+    seq = 0
+    for bucket in buckets:
+        for _ in range(burst):
+            handles.append(server.submit(_warm_config(bucket, seq)))
+            seq += 1
+    for h in handles:
+        h.wait(timeout=1800.0)
+    if len(buckets) > 1:
+        closers = [server.submit(_warm_config(buckets[0], seq))]
+        seq += 1
+        for bucket in list(buckets[1:]) + [buckets[0]]:
+            t0 = time.monotonic()
+            while (closers[-1].t_dispatch is None
+                   and time.monotonic() - t0 < 600.0):
+                time.sleep(0.005)
+            closers.append(server.submit(_warm_config(bucket, seq)))
+            seq += 1
+        for h in closers:
+            h.wait(timeout=1800.0)
+    return server.compile_count()
+
+
+def _mismatch_count(pairs) -> int:
+    """Surviving replies vs the per-config offline numpy path, bit-for-bit
+    (``pairs`` is ``[(SimConfig, reply record dict)]``)."""
+    from byzantinerandomizedconsensus_tpu.backends.base import get_backend
+
+    be = get_backend("numpy")
+    bad = 0
+    for cfg, rec in pairs:
+        ref = be.run(cfg)
+        if (rec["rounds"] != [int(r) for r in ref.rounds]
+                or rec["decision"] != [int(d) for d in ref.decision]):
+            bad += 1
+    return bad
+
+
+def _counter_total(name: str, **labels) -> float:
+    """Sum of a counter's matching series in the live registry (0.0 when
+    the metric has not been touched)."""
+    ent = _metrics.snapshot().get(name)
+    if not ent:
+        return 0.0
+    total = 0.0
+    for s in ent.get("series", []):
+        if all(s.get("labels", {}).get(k) == v for k, v in labels.items()):
+            total += s.get("value", 0.0)
+    return total
+
+
+def _row(name: str, seed: int, requests: int, replied: int, *,
+         rejected: int = 0, cancelled: int = 0, mismatches: int = 0,
+         steady: int = 0, slo_ok: bool = True, **extra) -> dict:
+    row = {"scenario": name, "seed": seed, "requests": requests,
+           "replied": replied, "rejected": rejected, "cancelled": cancelled,
+           "mismatches": mismatches, "steady_state_compiles": steady,
+           "slo_ok": bool(slo_ok)}
+    row.update(extra)
+    return row
+
+
+# ---------------------------------------------------------------- HTTP --
+
+def _http(method: str, url: str, doc=None, timeout: float = 120.0):
+    """One request; returns (status, parsed JSON body, headers dict) —
+    HTTP error statuses are answers here (429 is the point), not
+    exceptions."""
+    data = None if doc is None else json.dumps(doc).encode()
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if data else {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return (resp.status,
+                    json.loads(resp.read().decode() or "{}"),
+                    dict(resp.headers))
+    except urllib.error.HTTPError as e:
+        raw = e.read().decode() or "{}"
+        try:
+            body = json.loads(raw)
+        except ValueError:
+            body = {"error": raw}
+        return e.code, body, dict(e.headers or {})
+
+
+def _submit_retrying(base: str, payload: dict, max_tries: int = 200):
+    """POST /submit until accepted, honoring the Retry-After hint on every
+    429. Returns (request id, number of 429s absorbed)."""
+    rejected = 0
+    for _ in range(max_tries):
+        code, body, headers = _http("POST", base + "/submit", payload)
+        if code == 200:
+            return body["id"], rejected
+        if code == 429:
+            rejected += 1
+            hint = headers.get("Retry-After", body.get("retry_after_s", 0.1))
+            time.sleep(float(hint))
+            continue
+        raise RuntimeError(f"unexpected HTTP {code}: {body}")
+    raise RuntimeError(f"submit never accepted after {max_tries} tries")
+
+
+def _fetch_result(base: str, rid: str, timeout: float = 900.0) -> dict:
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        code, body, _ = _http("GET", base + f"/result/{rid}")
+        if code == 200:
+            return body
+        if code != 202:
+            raise RuntimeError(f"result {rid}: HTTP {code}: {body}")
+        time.sleep(0.05)
+    raise TimeoutError(f"result {rid} not done after {timeout}s")
+
+
+# ----------------------------------------------------------- scenarios --
+
+def _scenario_flash_crowd(args, seed: int) -> dict:
+    """The synchronized crowd against a bounded server, over live HTTP."""
+    from byzantinerandomizedconsensus_tpu.serve.server import (
+        ConsensusServer, serve_http)
+
+    n_req = _SIZES["flash_crowd"][1 if args.smoke else 0]
+    cfgs = [_cfg("benor", 5, 1, seed * 1000 + i) for i in range(n_req)]
+    before = _counter_total("brc_serve_rejected_total", reason="overflow")
+
+    with ConsensusServer(backend=args.backend, policy=args.policy,
+                         round_cap_ceiling=ROUND_CAP_CEILING,
+                         feed_depth=4, rotation_queue_depth=8) as srv:
+        # burst=3 stays under the feed bound during warm-up (seed + 3
+        # same-bucket joins never exceed depth 4)
+        warm_compiles = _warm(srv, [_admission.bucket_of(cfgs[0])], burst=3)
+        httpd = serve_http(srv, host="127.0.0.1", port=0)
+        threading.Thread(target=httpd.serve_forever,
+                         name="brc-hostile-http", daemon=True).start()
+        host, port = httpd.server_address[:2]
+        base = f"http://{host}:{port}"
+        try:
+            results: dict = {}
+            errors: list = []
+            lock = threading.Lock()
+
+            def crowd(part) -> None:
+                try:
+                    for i in part:
+                        payload = dataclasses.asdict(cfgs[i])
+                        rid, rej = _submit_retrying(base, payload)
+                        with lock:
+                            results[i] = (rid, rej)
+                except Exception as e:  # noqa: BLE001 — surfaced below
+                    with lock:
+                        errors.append(str(e))
+
+            threads = [threading.Thread(
+                target=crowd, args=([i for i in range(n_req) if i % 6 == t],),
+                name=f"brc-crowd-{t}") for t in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if errors:
+                raise RuntimeError(f"flash crowd client errors: {errors}")
+            pairs = [(cfgs[i], _fetch_result(base, rid))
+                     for i, (rid, _) in sorted(results.items())]
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+        steady = srv.compile_count() - warm_compiles
+
+    rejected = int(_counter_total("brc_serve_rejected_total",
+                                  reason="overflow") - before)
+    mism = _mismatch_count(pairs)
+    return _row("flash_crowd", seed, n_req, len(pairs), rejected=rejected,
+                mismatches=mism, steady=steady,
+                slo_ok=(len(pairs) == n_req),
+                client_retries=sum(r for _, r in results.values()))
+
+
+def _scenario_heavy_tail(args, seed: int) -> dict:
+    """Deadline/priority envelopes over a mixed population — the EDF
+    scheduling witness (records the deadline hit rate)."""
+    from byzantinerandomizedconsensus_tpu.serve.server import ConsensusServer
+
+    n_req = _SIZES["heavy_tail"][1 if args.smoke else 0]
+    rng = random.Random(seed)
+    cfgs, envs = [], []
+    for i in range(n_req):
+        if i % 2 == 0:
+            cfgs.append(_cfg("benor", 5, 1, seed * 1000 + i))
+        else:
+            cfgs.append(_cfg("bracha", 7, 2, seed * 1000 + i,
+                             delivery="urn", instances=6, round_cap=48))
+        draw = rng.random()
+        if draw < 0.5:
+            envs.append({"deadline_ms": rng.uniform(3000.0, 10000.0)})
+        elif draw < 0.8:
+            envs.append({"deadline_ms": rng.uniform(15000.0, 45000.0)})
+        else:
+            envs.append({"priority": rng.randint(-4, 4)})
+
+    with ConsensusServer(backend=args.backend, policy=args.policy,
+                         round_cap_ceiling=ROUND_CAP_CEILING) as srv:
+        buckets = []
+        for c in cfgs:
+            b = _admission.bucket_of(c)
+            if b not in buckets:
+                buckets.append(b)
+        warm_compiles = _warm(srv, buckets)
+        handles = [srv.submit({**dataclasses.asdict(c), **env})
+                   for c, env in zip(cfgs, envs)]
+        for h in handles:
+            h.wait(timeout=900.0)
+        steady = srv.compile_count() - warm_compiles
+
+    with_deadline = [h for h in handles if h.t_deadline is not None]
+    hits = sum(1 for h in with_deadline if h.t_reply <= h.t_deadline)
+    hit_rate = (round(hits / len(with_deadline), 4)
+                if with_deadline else None)
+    mism = _mismatch_count([(c, h.record) for c, h in zip(cfgs, handles)])
+    slo_ok = hit_rate is None or hit_rate >= 0.5
+    return _row("heavy_tail", seed, n_req, len(handles), mismatches=mism,
+                steady=steady, slo_ok=slo_ok, deadline_hit_rate=hit_rate,
+                deadlines=len(with_deadline))
+
+
+def _scenario_bucket_churn(args, seed: int) -> dict:
+    """Rotation storm: round-robin across three fused buckets; the
+    zero-recompile pin must survive every rotation."""
+    from byzantinerandomizedconsensus_tpu.serve.server import ConsensusServer
+
+    n_req = _SIZES["bucket_churn"][1 if args.smoke else 0]
+    families = (
+        lambda s: _cfg("benor", 5, 1, s),
+        lambda s: _cfg("bracha", 7, 2, s, delivery="urn"),
+        lambda s: _cfg("benor", 9, 3, s, instances=6, round_cap=48,
+                       adversary="crash"),
+    )
+    cfgs = [families[i % 3](seed * 1000 + i) for i in range(n_req)]
+
+    with ConsensusServer(backend=args.backend, policy=args.policy,
+                         round_cap_ceiling=ROUND_CAP_CEILING) as srv:
+        buckets = []
+        for c in cfgs:
+            b = _admission.bucket_of(c)
+            if b not in buckets:
+                buckets.append(b)
+        warm_compiles = _warm(srv, buckets)
+        handles = [srv.submit(c) for c in cfgs]
+        for h in handles:
+            h.wait(timeout=900.0)
+        steady = srv.compile_count() - warm_compiles
+
+    mism = _mismatch_count([(c, h.record) for c, h in zip(cfgs, handles)])
+    return _row("bucket_churn", seed, n_req, len(handles), mismatches=mism,
+                steady=steady, slo_ok=(len(handles) == n_req),
+                buckets=len(buckets))
+
+
+def _scenario_tenant_hog(args, seed: int) -> dict:
+    """One tenant floods, the interactive tenant must stay responsive:
+    per-tenant cap + deficit-weighted rotations, p99 fairness gate."""
+    from byzantinerandomizedconsensus_tpu.serve.server import ConsensusServer
+
+    n_req = _SIZES["tenant_hog"][1 if args.smoke else 0]
+    n_hog = (2 * n_req) // 3
+    n_int = n_req - n_hog
+    hog_cfgs = [_cfg("benor", 9, 3, seed * 1000 + i, instances=8,
+                     round_cap=ROUND_CAP_CEILING) for i in range(n_hog)]
+    int_cfgs = [_cfg("benor", 5, 1, seed * 1000 + 500 + i, instances=2,
+                     round_cap=16) for i in range(n_int)]
+    before = _counter_total("brc_serve_rejected_total", reason="tenant_cap")
+
+    with ConsensusServer(backend=args.backend, policy=args.policy,
+                         round_cap_ceiling=ROUND_CAP_CEILING,
+                         tenant_inflight_cap=8) as srv:
+        buckets = [_admission.bucket_of(hog_cfgs[0]),
+                   _admission.bucket_of(int_cfgs[0])]
+        warm_compiles = _warm(srv, buckets, burst=3)
+        hog_handles: list = []
+        int_handles: list = []
+        errors: list = []
+
+        def hog() -> None:
+            try:
+                for c in hog_cfgs:
+                    payload = {**dataclasses.asdict(c), "tenant": "hog"}
+                    while True:
+                        try:
+                            hog_handles.append(srv.submit(payload))
+                            break
+                        except _admission.Backpressure as e:
+                            time.sleep(e.retry_after_s)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(f"hog: {e}")
+
+        def interactive() -> None:
+            try:
+                time.sleep(0.1)  # let the hog flood establish itself
+                for c in int_cfgs:
+                    payload = {**dataclasses.asdict(c),
+                               "tenant": "interactive",
+                               "deadline_ms": 8000.0}
+                    int_handles.append(srv.submit(payload))
+                    time.sleep(0.05)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(f"interactive: {e}")
+
+        threads = [threading.Thread(target=hog, name="brc-hog"),
+                   threading.Thread(target=interactive, name="brc-int")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise RuntimeError(f"tenant_hog submit errors: {errors}")
+        for h in hog_handles + int_handles:
+            h.wait(timeout=900.0)
+        steady = srv.compile_count() - warm_compiles
+
+    rejected = int(_counter_total("brc_serve_rejected_total",
+                                  reason="tenant_cap") - before)
+    (hog_p99,) = metrics.percentiles(
+        [h.latency_s * 1000.0 for h in hog_handles], (99,))
+    (int_p99,) = metrics.percentiles(
+        [h.latency_s * 1000.0 for h in int_handles], (99,))
+    bound = max(0.5 * hog_p99, _FAIRNESS_FLOOR_MS)
+    fairness = {"hog_p99_ms": round(hog_p99, 3),
+                "non_hog_p99_ms": round(int_p99, 3),
+                "bound_ms": round(bound, 3),
+                "rejected_tenant_cap": rejected,
+                "ok": int_p99 <= bound}
+    mism = _mismatch_count(
+        [(c, h.record) for c, h in zip(hog_cfgs, hog_handles)]
+        + [(c, h.record) for c, h in zip(int_cfgs, int_handles)])
+    return _row("tenant_hog", seed, n_req,
+                len(hog_handles) + len(int_handles), rejected=rejected,
+                mismatches=mism, steady=steady, slo_ok=fairness["ok"],
+                fairness=fairness)
+
+
+def _scenario_cancel_storm(args, seed: int) -> dict:
+    """A seeded slice of a two-bucket burst is cancelled at staggered
+    delays — queued kills at the feed/pending seam, live kills reclaimed
+    at the next segment boundary; survivors stay bit-identical."""
+    from byzantinerandomizedconsensus_tpu.serve.server import ConsensusServer
+
+    n_req = _SIZES["cancel_storm"][1 if args.smoke else 0]
+    rng = random.Random(seed)
+    # Heavy enough that the burst queues deep (instances ≫ grid width):
+    # cancels land while victims are still queued or live, not after.
+    cfgs = [(_cfg("benor", 5, 1, seed * 1000 + i, instances=8,
+                  round_cap=48) if i % 2 == 0 else
+             _cfg("bracha", 7, 2, seed * 1000 + i, delivery="urn",
+                  instances=8, round_cap=48))
+            for i in range(n_req)]
+    victims = sorted(rng.sample(range(n_req), max(2, (2 * n_req) // 5)))
+
+    with ConsensusServer(backend=args.backend, policy=args.policy,
+                         round_cap_ceiling=ROUND_CAP_CEILING) as srv:
+        buckets = [_admission.bucket_of(cfgs[0]),
+                   _admission.bucket_of(cfgs[1])]
+        warm_compiles = _warm(srv, buckets)
+        # Warm the reap seam too: cancelling a live request exercises the
+        # segment-boundary lane reclaim before the measured phase.
+        pre = srv.submit(_warm_config(buckets[0], 999))
+        time.sleep(0.05)
+        srv.cancel(pre.id)
+        pre.done.wait(timeout=900.0)
+        warm_compiles = srv.compile_count()
+
+        handles = [srv.submit(c) for c in cfgs]
+        where = {"queued": 0, "live": 0}
+        cancelled_ok = 0
+        for i in victims:
+            time.sleep(rng.uniform(0.0, 0.05))
+            ack = srv.cancel(handles[i].id)
+            if ack["cancelled"]:
+                cancelled_ok += 1
+                where[ack["where"]] += 1
+        for h in handles:
+            h.done.wait(timeout=900.0)
+        steady = srv.compile_count() - warm_compiles
+
+    survivors = [(c, h.record) for c, h in zip(cfgs, handles)
+                 if h.record is not None]
+    mism = _mismatch_count(survivors)
+    resolved = all(h.done.is_set() for h in handles)
+    return _row("cancel_storm", seed, n_req, len(survivors),
+                cancelled=cancelled_ok, mismatches=mism, steady=steady,
+                slo_ok=(resolved and cancelled_ok >= 1
+                        and len(survivors) + cancelled_ok == n_req),
+                cancel_where=where)
+
+
+_RUNNERS = {
+    "flash_crowd": _scenario_flash_crowd,
+    "heavy_tail": _scenario_heavy_tail,
+    "bucket_churn": _scenario_bucket_churn,
+    "tenant_hog": _scenario_tenant_hog,
+    "cancel_storm": _scenario_cancel_storm,
+}
+
+
+# ---------------------------------------------------------------- main --
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="brc-tpu loadgen --scenario",
+        description="Hostile-load suite: backpressure, fairness, deadline "
+                    "scheduling and cancellation under adversarial "
+                    "traffic, every gate exit-code enforced.")
+    ap.add_argument("--scenario", default="all",
+                    choices=SCENARIOS + ("all",))
+    ap.add_argument("--seed", type=int, default=18)
+    ap.add_argument("--backend", default="jax")
+    ap.add_argument("--policy", default="width=8,segment=1",
+                    help="compaction policy spec (small grid: the hostile "
+                         "populations are many small requests)")
+    ap.add_argument("--out", default=None,
+                    help=f"artifact path (default "
+                         f"{default_artifact('hostile')})")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny fast run (CI): ~10 requests per scenario")
+    # swallowed when delegated from `brc-tpu loadgen` with loadgen flags
+    args, _extra = ap.parse_known_args(argv)
+
+    from byzantinerandomizedconsensus_tpu.utils import devices as _devices
+
+    # The rejection/fairness/cancel gates read the live metrics plane.
+    _metrics.configure()
+    _devices.ensure_live_backend()
+    args.policy = _compaction.CompactionPolicy.parse(args.policy)
+
+    names = SCENARIOS if args.scenario == "all" else (args.scenario,)
+    out = pathlib.Path(args.out or default_artifact("hostile"))
+    out.parent.mkdir(parents=True, exist_ok=True)
+
+    t0 = time.perf_counter()
+    rows = []
+    for i, name in enumerate(names):
+        seed = args.seed * 100 + i
+        print(f"hostile: [{name}] seed {seed} …")
+        row = _RUNNERS[name](args, seed)
+        rows.append(row)
+        print(f"hostile: [{name}] replied {row['replied']}/{row['requests']}"
+              f", rejected {row['rejected']}, cancelled {row['cancelled']}, "
+              f"mismatches {row['mismatches']}, steady compiles "
+              f"{row['steady_state_compiles']}, "
+              f"slo {'OK' if row['slo_ok'] else 'BREACH'}")
+
+    hit_rates = [r["deadline_hit_rate"] for r in rows
+                 if r.get("deadline_hit_rate") is not None]
+    fairness = next((r["fairness"] for r in rows if "fairness" in r), None)
+    stats = {
+        "suite_seed": args.seed,
+        "generator_version": HOSTILE_GENERATOR_VERSION,
+        "scenarios": rows,
+        "rejected_overflow": int(_counter_total(
+            "brc_serve_rejected_total", reason="overflow")),
+        "mismatches": sum(r["mismatches"] for r in rows),
+        "steady_state_compiles": sum(r["steady_state_compiles"]
+                                     for r in rows),
+        "duration_s": round(time.perf_counter() - t0, 3),
+        "deadline_hit_rate": hit_rates[0] if hit_rates else None,
+        "fairness": fairness,
+    }
+
+    doc = {
+        **record.new_record(
+            "hostile",
+            description="Hostile-load suite: seeded adversarial traffic "
+                        "(flash crowd, heavy tail, bucket churn, tenant "
+                        "hog, cancel storm) through the bounded "
+                        "continuous-batching consensus service."),
+        "seed": args.seed,
+        "smoke": bool(args.smoke),
+        "backend": args.backend,
+        "policy": args.policy.doc(),
+        "round_cap_ceiling": ROUND_CAP_CEILING,
+        "hostile": record.hostile_block(stats),
+    }
+    problems = record.validate_record(doc)
+    if problems:
+        print(f"hostile: INVALID RECORD: {problems}", file=sys.stderr)
+        return 3
+    out.write_text(json.dumps(doc, indent=1) + "\n")
+    print(f"hostile: wrote {out}")
+
+    if stats["mismatches"]:
+        print("hostile: DIFFERENTIAL MISMATCH", file=sys.stderr)
+        return 1
+    if stats["steady_state_compiles"]:
+        print("hostile: STEADY-STATE RECOMPILES", file=sys.stderr)
+        return 2
+    if fairness is not None and not fairness["ok"]:
+        print(f"hostile: FAIRNESS BREACH: {fairness}", file=sys.stderr)
+        return 4
+    if not all(r["slo_ok"] for r in rows):
+        print("hostile: SCENARIO SLO BREACH", file=sys.stderr)
+        return 5
+    if "flash_crowd" in names and stats["rejected_overflow"] == 0:
+        print("hostile: backpressure never engaged (0 overflow "
+              "rejections)", file=sys.stderr)
+        return 6
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -336,6 +336,32 @@ def _hunt_rows_of(name: str, doc) -> list:
     return rows
 
 
+def _hostile_rows_of(name: str, doc) -> list:
+    """Schema-v1.9 ``hostile`` blocks of one artifact: (path, suite seed,
+    scenarios, overflow rejections, deadline hit rate, fairness verdict,
+    mismatches, steady-state compiles) rows — the ledger's
+    hostile-traffic columns."""
+    from byzantinerandomizedconsensus_tpu.obs import record as _record
+
+    rows = []
+    for path, hb in _blocks_of(doc, "hostile", _record.HOSTILE_BLOCK_KEYS):
+        scen = hb.get("scenarios")
+        fairness = hb.get("fairness")
+        rows.append({
+            "artifact": name,
+            "path": path,
+            "suite_seed": hb.get("suite_seed"),
+            "scenarios": (len(scen) if isinstance(scen, list) else None),
+            "rejected_overflow": hb.get("rejected_overflow"),
+            "deadline_hit_rate": hb.get("deadline_hit_rate"),
+            "fairness_ok": (fairness.get("ok")
+                            if isinstance(fairness, dict) else None),
+            "mismatches": hb.get("mismatches"),
+            "steady_state_compiles": hb.get("steady_state_compiles"),
+        })
+    return rows
+
+
 def sentinel_verdict(bench: dict, wall_chain: list,
                      programs_rows: list) -> dict:
     """The ``--check`` verdict: wall-chain regressions past
@@ -571,6 +597,12 @@ def build_ledger(root=None) -> dict:
     for name, doc in sorted(docs.items()):
         hunt_rows.extend(_hunt_rows_of(name, doc))
 
+    # ---- hostile-traffic columns (schema v1.9, round 18): every committed
+    # artifact carrying a hostile-load-suite block.
+    hostile_rows = []
+    for name, doc in sorted(docs.items()):
+        hostile_rows.extend(_hostile_rows_of(name, doc))
+
     from byzantinerandomizedconsensus_tpu.obs import record
 
     return {
@@ -588,6 +620,7 @@ def build_ledger(root=None) -> dict:
         "fleet_rows": fleet_rows,
         "metrics_rows": metrics_rows,
         "hunt_rows": hunt_rows,
+        "hostile_rows": hostile_rows,
         "bench_rounds": {str(r): bench[r] for r in rounds_seen},
         "wall_chain": chain,
         "device_chain": device_chain,
@@ -731,6 +764,24 @@ def format_report(doc: dict) -> str:
                 f"{row['violations']} violations, "
                 f"{row['steady_state_compiles']} steady-state compiles, "
                 f"pipeline {row['pipeline_speedup']}x")
+    # Present only once an artifact carries the v1.9 hostile block.
+    if doc.get("hostile_rows"):
+        lines.append("hostile-traffic columns (schema v1.9 — "
+                     "artifact[path]: seed scenarios overflow-rejections "
+                     "deadline-hit-rate fairness mismatches steady-state "
+                     "compiles):")
+        for row in doc["hostile_rows"]:
+            fair = row["fairness_ok"]
+            fair_s = "n/a" if fair is None else ("OK" if fair else "FAIL")
+            lines.append(
+                f"  {row['artifact']}[{row['path']}]: "
+                f"seed {row['suite_seed']}, "
+                f"{row['scenarios']} scenarios, "
+                f"{row['rejected_overflow']} overflow rejections, "
+                f"deadline hit rate {row['deadline_hit_rate']}, "
+                f"fairness {fair_s}, "
+                f"{row['mismatches']} mismatches, "
+                f"{row['steady_state_compiles']} steady-state compiles")
     sent = doc.get("sentinel")
     if sent is not None:
         lines.append(

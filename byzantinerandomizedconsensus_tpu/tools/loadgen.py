@@ -80,6 +80,14 @@ fresh registry, so a p99 regression at any width fails the run. The
 artifact records the scraped digest + verdict as the schema-v1.7
 ``metrics`` block.
 
+**Hostile mode (round 18)** — ``--scenario
+flash_crowd|heavy_tail|bucket_churn|tenant_hog|cancel_storm|all``
+delegates the whole invocation to the hostile-load suite
+(tools/hostile.py): seeded adversarial traffic against *bounded* servers
+— 429 + Retry-After backpressure, per-tenant fairness, EDF deadline
+scheduling, cancellation storms — with its own exit-code ladder (see
+that module's docstring) and the committed ``artifacts/hostile_r18.json``.
+
 Exit codes: 1 differential mismatch, 2 steady-state compiles, 3 invalid
 record, 4 fleet scaling below ``--min-scaling``, 5 SLO breach
 (``--slo-p99-ms`` / ``--slo-error-rate`` vs the live ``/metrics`` scrape).
@@ -683,6 +691,12 @@ def _run_fleet(args, policy, workers_list, stream, digest, cfgs, buckets,
 
 
 def main(argv=None) -> int:
+    raw = sys.argv[1:] if argv is None else list(argv)
+    if any(a == "--scenario" or a.startswith("--scenario=") for a in raw):
+        # `brc-tpu loadgen --scenario <name>` is the hostile-load suite
+        # (round 18); it owns its own flags, so hand over the whole argv.
+        from byzantinerandomizedconsensus_tpu.tools import hostile
+        return hostile.main(raw)
     ap = argparse.ArgumentParser(
         prog="brc-tpu loadgen",
         description="Seeded open-loop load generator for brc-tpu serve: "

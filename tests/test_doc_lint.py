@@ -56,9 +56,10 @@ def test_span_kind_census_is_nontrivial_and_complete():
                      "fleet.steal", "fleet.worker_lost", "fleet.readmit",
                      "fleet.shutdown", "hunt.run", "hunt.generation",
                      "hunt.harvest", "hunt.best", "hunt.violation",
-                     "hunt.done"):
+                     "hunt.done", "serve.backpressure", "serve.cancel",
+                     "serve.rotate", "compaction.cancel"):
         assert expected in kinds, (expected, sorted(kinds))
-    assert len(kinds) >= 38
+    assert len(kinds) >= 42
 
 
 def test_every_emitted_span_kind_is_documented():
@@ -121,9 +122,16 @@ def test_metric_name_census_is_nontrivial_and_complete():
                      "brc_hunt_violations_total", "brc_hunt_best_fitness",
                      "brc_hunt_archive_size",
                      "brc_serve_invariant_checks_total",
-                     "brc_serve_invariant_violations_total"):
+                     "brc_serve_invariant_violations_total",
+                     "brc_serve_tenant_served_weight_total",
+                     "brc_serve_tenant_inflight",
+                     "brc_serve_cancel_requested_total",
+                     "brc_serve_cancelled_total",
+                     "brc_serve_cancel_too_late_total",
+                     "brc_serve_deadline_met_total",
+                     "brc_serve_deadline_missed_total"):
         assert expected in names, (expected, sorted(names))
-    assert len(names) >= 35
+    assert len(names) >= 42
 
 
 def test_every_registered_metric_is_documented():
@@ -155,6 +163,7 @@ def test_every_record_block_key_is_documented():
         "fleet": record.FLEET_BLOCK_KEYS,
         "metrics": record.METRICS_BLOCK_KEYS,
         "hunt": record.HUNT_BLOCK_KEYS,
+        "hostile": record.HOSTILE_BLOCK_KEYS,
         "counters": ("supported", "totals"),
     }
     missing = []

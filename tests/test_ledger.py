@@ -553,3 +553,43 @@ def test_census_includes_hunt_artifact():
     report = ledger.format_report(doc)
     assert "hunt worst-case columns" in report
     assert "steady-state compiles" in report
+
+
+def test_census_includes_hostile_artifact():
+    """The round-18 hostile-traffic artifact: parsed with zero errors, all
+    five scenarios on the record with the zero-mismatch /
+    zero-steady-state-recompile pins, backpressure demonstrated (overflow
+    rejections > 0), the fairness verdict OK, and the schema-v1.9 hostile
+    columns reconstructed by the ledger."""
+    import pathlib
+
+    from byzantinerandomizedconsensus_tpu.utils.rounds import repo_root
+
+    doc = ledger.build_ledger()
+    assert doc["parse_errors"] == []
+    rows = {r["artifact"]: r for r in doc["hostile_rows"]}
+    assert "artifacts/hostile_r18.json" in rows, \
+        "hostile_r18.json must yield hostile-traffic columns"
+    row = rows["artifacts/hostile_r18.json"]
+    assert row["suite_seed"] == 18
+    assert row["scenarios"] == 5             # the full hostile suite
+    assert row["rejected_overflow"] >= 1     # backpressure really fired
+    assert row["fairness_ok"] is True        # hog could not starve others
+    assert row["deadline_hit_rate"] is None or row["deadline_hit_rate"] > 0
+    assert row["mismatches"] == 0            # survivors bit-identical
+    assert row["steady_state_compiles"] == 0  # under hostile load
+
+    hv = json.loads((pathlib.Path(repo_root())
+                     / "artifacts/hostile_r18.json").read_text())
+    assert hv["kind"] == "hostile"
+    assert record.validate_record(hv) == []
+    assert hv["record_revision"] >= 9  # schema v1.9
+    scen = {r["scenario"]: r for r in hv["hostile"]["scenarios"]}
+    assert set(scen) == {"flash_crowd", "heavy_tail", "bucket_churn",
+                         "tenant_hog", "cancel_storm"}
+    assert all(r["slo_ok"] for r in scen.values())
+    assert scen["cancel_storm"]["cancelled"] >= 1
+
+    report = ledger.format_report(doc)
+    assert "hostile-traffic columns" in report
+    assert "overflow rejections" in report
