@@ -57,8 +57,11 @@ from byzantinerandomizedconsensus_tpu.ops import prf
 
 # Supported n tiers: a lane's n is padded up to the next tier so that nearby
 # sizes share one compiled program. Powers of two from the smallest legal
-# quorum shape to the spec §2 v2 ceiling.
-N_TIERS = (4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+# quorum shape to the spec §2 v3 ceiling; tiers above 4096 are reachable only
+# by the §10 committee family (config.validate gates every full-mesh delivery
+# at the v2 ceiling), so the full-mesh program set is exactly what it was.
+N_TIERS = (4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096,
+           8192, 16384, 32768, 65536, 131072, 262144, 524288, 1048576)
 
 # Environment knob for the opt-in persistent XLA compilation cache (see
 # :func:`enable_persistent_compilation_cache`): retries, resumes and chaos
@@ -72,6 +75,15 @@ def n_tier(n: int) -> int:
         if n <= t:
             return t
     raise ValueError(f"n={n} exceeds the largest supported tier {N_TIERS[-1]}")
+
+
+def _bucket_committee_c(delivery: str, n_pad: int) -> int:
+    """C(n_pad) for committee buckets, 0 otherwise (see ShapeBucket docs)."""
+    if delivery != "committee":
+        return 0
+    from byzantinerandomizedconsensus_tpu.ops.committee import committee_size
+
+    return committee_size(n_pad)
 
 
 def lane_tier(lanes: int) -> int:
@@ -93,6 +105,13 @@ class ShapeBucket:
     law and init law select different code paths), so they ride the bucket
     even though the ISSUE's minimal law doesn't name them — a bucket must
     never compile a program that branches on a lane value it cannot trace.
+
+    ``committee_c`` is the §10.1 committee-size ceiling C(n_pad) for
+    committee-delivery buckets (0 otherwise). A lane's realized C derives
+    from its *traced* n_eff inside the program (ops/committee.py), so this
+    field is a pure function of (delivery, n_pad) — it adds committee params
+    to the bucket identity/label without ever splitting programs, which is
+    what keeps committee serve admission at 0 steady-state recompiles.
     """
 
     protocol: str
@@ -105,6 +124,7 @@ class ShapeBucket:
     faults: str
     counters: bool
     pack_version: int
+    committee_c: int = 0
 
     @classmethod
     def of(cls, cfg: SimConfig, counters: bool = False) -> "ShapeBucket":
@@ -112,13 +132,17 @@ class ShapeBucket:
                    round_cap=cfg.round_cap, delivery=cfg.delivery,
                    adversary=cfg.adversary, coin=cfg.coin, init=cfg.init,
                    faults=cfg.faults, counters=counters,
-                   pack_version=cfg.pack_version)
+                   pack_version=cfg.pack_version,
+                   committee_c=_bucket_committee_c(cfg.delivery,
+                                                   n_tier(cfg.n)))
 
     def label(self) -> str:
         """Compact human key for reports/ledger columns."""
         tag = f"{self.protocol}/n{self.n_pad}/c{self.round_cap}/" \
               f"{self.delivery}/{self.adversary}/{self.coin}/{self.init}/" \
               f"f{self.faults}/p{self.pack_version}"
+        if self.committee_c:
+            tag += f"/C{self.committee_c}"
         return tag + ("/counters" if self.counters else "")
 
 
@@ -748,15 +772,19 @@ class FusedBucket:
     n_pad: int
     delivery: str
     pack_version: int
+    committee_c: int = 0
 
     @classmethod
     def of(cls, cfg: SimConfig) -> "FusedBucket":
         return cls(protocol=cfg.protocol, n_pad=fused_tier(cfg.n),
-                   delivery=cfg.delivery, pack_version=cfg.pack_version)
+                   delivery=cfg.delivery, pack_version=cfg.pack_version,
+                   committee_c=_bucket_committee_c(cfg.delivery,
+                                                   fused_tier(cfg.n)))
 
     def label(self) -> str:
-        return (f"fused/{self.protocol}/n{self.n_pad}/{self.delivery}/"
-                f"p{self.pack_version}")
+        tag = (f"fused/{self.protocol}/n{self.n_pad}/{self.delivery}/"
+               f"p{self.pack_version}")
+        return tag + (f"/C{self.committee_c}" if self.committee_c else "")
 
     #: duck-typing for _chunk_instances
     counters = False

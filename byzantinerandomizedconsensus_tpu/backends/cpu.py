@@ -7,6 +7,8 @@ the arbiter implementation the JAX/TPU backend must bit-match (BASELINE.json:5).
 
 from __future__ import annotations
 
+import math
+
 from typing import Optional
 
 import numpy as np
@@ -17,6 +19,14 @@ from byzantinerandomizedconsensus_tpu.core.adversary import make_adversary
 from byzantinerandomizedconsensus_tpu.core.network import Network
 from byzantinerandomizedconsensus_tpu.core.replica import Replica
 from byzantinerandomizedconsensus_tpu.ops import prf
+
+
+def _committee_nf(n: int, f: int):
+    """Oracle-side (C, f_C) — spec §10.1/§10.3. Independent of
+    ops/committee.py: bit_length()/math.isqrt vs the static compare-sums."""
+    cn = min(n, max(16, 8 * (n - 1).bit_length()))
+    fc = f if cn == n else (cn * f + n - 1) // n + math.isqrt(cn)
+    return cn, fc
 
 
 class CpuBackend(SimulatorBackend):
@@ -62,11 +72,14 @@ class CpuBackend(SimulatorBackend):
         return res, _counters.counters_doc(cfg, totals, backend=self.name)
 
     @staticmethod
-    def _invalid(cfg: SimConfig, t: int, values: np.ndarray, g_prev) -> np.ndarray:
+    def _invalid(cfg: SimConfig, t: int, values: np.ndarray, g_prev,
+                 nf=None) -> np.ndarray:
         """Per-sender invalidity per spec §5.1b, from the previous step's global
         live-valid counts (g0, g1). Independent scalar re-implementation of
-        models/validation.py for the oracle cross-check."""
-        n, f = cfg.n, cfg.f
+        models/validation.py for the oracle cross-check. ``nf`` overrides the
+        (n, f) pair the intervals derive from — the committee path passes
+        (C, f_C) so validity matches the committee-scoped G counts (§10.3)."""
+        n, f = nf if nf is not None else (cfg.n, cfg.f)
         q = n - f
         g0, g1 = g_prev
         if t == 1:
@@ -104,13 +117,19 @@ class CpuBackend(SimulatorBackend):
 
         two_faced = cfg.count_level and cfg.adversary == "byzantine" \
             and cfg.protocol != "bracha"
+        committee = cfg.delivery == "committee"
+        cm_nf = _committee_nf(cfg.n, cfg.f) if committee else None
 
         if collect is not None:
             from byzantinerandomizedconsensus_tpu.obs.counters import (
                 phase_names)
 
             phases = phase_names(cfg)
-            k_quota = cfg.n - cfg.f - 1
+            # Every delivery law waits for a quota of k live messages per
+            # receiver: n−f−1 for the full mesh (spec §4), the committee
+            # k_C = C − f_C − 1 under §10.2.
+            k_quota = (cm_nf[0] - cm_nf[1] - 1) if committee \
+                else cfg.n - cfg.f - 1
 
             def note(name: str, inc: int) -> None:
                 collect[name] = collect.get(name, 0) + int(inc)
@@ -127,10 +146,21 @@ class CpuBackend(SimulatorBackend):
                 values, silent, bias = adv.inject(r, t, honest)
                 if fsil is not None:
                     silent = silent | fsil
+                if committee:
+                    # spec §10.4 composition order: membership silence joins
+                    # after the §9 fault silences, before §5.1b validation —
+                    # non-members of this step's committee do not broadcast.
+                    rep_ids = np.arange(cfg.n, dtype=np.uint32)
+                    mw = prf.prf_u32(cfg.seed, instance, r, t, rep_ids, 0,
+                                     prf.COMMITTEE, xp=np,
+                                     pack=cfg.pack_version)
+                    silent = silent | ((mw % np.uint32(cfg.n))
+                                       >= np.uint32(cm_nf[0]))
                 if cfg.protocol == "bracha":
                     # spec §5.1b: invalid messages are silenced before delivery.
                     if t > 0:
-                        silent = silent | self._invalid(cfg, t, values, g_prev)
+                        silent = silent | self._invalid(cfg, t, values, g_prev,
+                                                        nf=cm_nf)
                     live = ~silent
                     g_prev = (int(np.count_nonzero(live & (values == 0))),
                               int(np.count_nonzero(live & (values == 1))))
@@ -140,9 +170,12 @@ class CpuBackend(SimulatorBackend):
                         send = np.arange(cfg.n, dtype=np.uint32)
                         vbc = []
                         for h in (0, 1):
-                            e = prf.prf_u32(cfg.seed, instance, r, t, h, send,
-                                            prf.BYZ_VALUE, xp=np,
-                                            pack=cfg.pack_version)
+                            # Sender-addressed: prf_sender puts the sender
+                            # index in the wide field under §2 v3 (bit-
+                            # identical at pack ≤ 2).
+                            e = prf.prf_sender(cfg.seed, instance, r, t, h,
+                                               send, prf.BYZ_VALUE, xp=np,
+                                               pack=cfg.pack_version)
                             vh = (e % np.uint32(3)).astype(np.uint8)
                             vbc.append(np.where(adv.faulty, vh, honest).astype(np.uint8))
                     else:
@@ -155,7 +188,8 @@ class CpuBackend(SimulatorBackend):
                     else:
                         strata, minority = "none", 0
                     counts = {"urn": net.urn_counts, "urn2": net.urn2_counts,
-                              "urn3": net.urn3_counts}[cfg.delivery]
+                              "urn3": net.urn3_counts,
+                              "committee": net.committee_counts}[cfg.delivery]
                     c0, c1 = counts(r, t, vbc, silent,
                                     strata=strata, minority=minority,
                                     fside=fside)

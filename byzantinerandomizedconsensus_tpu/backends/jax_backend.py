@@ -119,8 +119,8 @@ class JaxBackend(JitChunkedBackend):
         # inside the same law by construction.
         from byzantinerandomizedconsensus_tpu.ops import prf
 
-        pack_cap = (prf.V2_MAX_INSTANCES if cfg.pack_version == 2
-                    else prf.MAX_INSTANCES)
+        pack_cap = {1: prf.MAX_INSTANCES, 2: prf.V2_MAX_INSTANCES,
+                    3: prf.V3_MAX_INSTANCES}[cfg.pack_version]
         max_chunk = min(self.max_chunk, pack_cap)
         if cfg.count_level:
             # No O(B·n²) transient at all — state is O(B·n). Measured optimum
@@ -140,11 +140,15 @@ class JaxBackend(JitChunkedBackend):
     def _make_fn(self, cfg: SimConfig):
         if self.kernel != "xla":
             # The custom-kernel paths compute delivery in-kernel and have no
-            # fault-schedule channel — fail loudly, never fall back silently.
+            # fault-schedule or committee channel — fail loudly, never fall
+            # back silently.
+            from byzantinerandomizedconsensus_tpu.models.committee import (
+                check_committee_supported)
             from byzantinerandomizedconsensus_tpu.models.faults import (
                 check_faults_supported)
 
             check_faults_supported(cfg, f"kernel={self.kernel!r}")
+            check_committee_supported(cfg, f"kernel={self.kernel!r}")
         counts_fn = None
         if cfg.count_level:
             # counts_fn=None routes the round bodies to ops/urn.py or
@@ -349,8 +353,8 @@ class CompactedJaxBackend(JaxBackend):
         # segment + refill compiles inside the timed window.
         from byzantinerandomizedconsensus_tpu.ops import prf
 
-        pack_cap = (prf.V2_MAX_INSTANCES if cfg.pack_version == 2
-                    else prf.MAX_INSTANCES)
+        pack_cap = {1: prf.MAX_INSTANCES, 2: prf.V2_MAX_INSTANCES,
+                    3: prf.V3_MAX_INSTANCES}[cfg.pack_version]
         return min(2 * self._resolved_width(cfg), pack_cap)
 
     def run(self, cfg: SimConfig, inst_ids=None) -> "SimResult":

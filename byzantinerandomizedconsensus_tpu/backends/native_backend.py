@@ -93,10 +93,13 @@ class NativeBackend(SimulatorBackend):
 
     def run(self, cfg: SimConfig, inst_ids: Optional[np.ndarray] = None) -> SimResult:
         cfg = cfg.validate()
+        from byzantinerandomizedconsensus_tpu.models.committee import (
+            check_committee_supported)
         from byzantinerandomizedconsensus_tpu.models.faults import (
             check_faults_supported)
 
         check_faults_supported(cfg, "the native core (ABI v5)")
+        check_committee_supported(cfg, "the native core (ABI v5)")
         lib = _load()
         ids = np.ascontiguousarray(self._resolve_inst_ids(cfg, inst_ids))
         rounds = np.empty(len(ids), dtype=np.int32)

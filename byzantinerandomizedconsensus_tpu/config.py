@@ -11,7 +11,7 @@ Protocol = Literal["benor", "bracha"]
 AdversaryKind = Literal["none", "crash", "byzantine", "adaptive", "adaptive_min"]
 CoinKind = Literal["local", "shared"]
 InitKind = Literal["random", "all0", "all1", "split"]
-DeliveryKind = Literal["keys", "urn", "urn2", "urn3"]
+DeliveryKind = Literal["keys", "urn", "urn2", "urn3", "committee"]
 FaultKind = Literal["none", "recover", "partition", "omission"]
 
 # The delivery registry: every scheduling model a SimConfig may name, in spec
@@ -21,7 +21,10 @@ FaultKind = Literal["none", "recover", "partition", "omission"]
 # bodies' counts dispatch all derive from these two tuples, so adding a
 # delivery model is a one-line registration here plus its sampler
 # implementations (ops/, core/network.py, native/simcore.cpp).
-COUNT_LEVEL_DELIVERIES = ("urn", "urn2", "urn3")
+# "committee" (spec §10) is the sampled-quorum family: per-round, per-phase
+# PRF-drawn committees with thresholds over committee counts — the only
+# family admitted past the full-mesh n ≤ 4096 ceiling (spec §2 v3).
+COUNT_LEVEL_DELIVERIES = ("urn", "urn2", "urn3", "committee")
 DELIVERY_KINDS = ("keys",) + COUNT_LEVEL_DELIVERIES
 
 # The fault-schedule registry (spec §9): an axis orthogonal to the §6
@@ -118,9 +121,10 @@ class SimConfig:
     @property
     def pack_version(self) -> int:
         """The spec §2 packing law this config draws under: 1 (the frozen
-        original) for n ≤ 1024, 2 (spec §2 v2, wider recv/send fields) above.
-        Every consumer of PRF coordinates — the vectorized ops, the oracle,
-        the Pallas kernels, the native core — must thread this through as the
+        original) for n ≤ 1024, 2 (spec §2 v2, wider recv/send fields) for
+        1024 < n ≤ 4096, 3 (spec §2 v3, 20-bit replica field) above. Every
+        consumer of PRF coordinates — the vectorized ops, the oracle, the
+        Pallas kernels, the native core — must thread this through as the
         ``pack`` argument; it is a pure function of n so the five stacks
         cannot disagree."""
         return prf.pack_version(self.n)
@@ -143,22 +147,29 @@ class SimConfig:
                 "the §3.3/§9 schedules draw rounds mod crash_window")
         if not (0 < self.n <= prf.MAX_N):
             raise ValueError(f"n={self.n} out of range (1..{prf.MAX_N})")
+        if self.n > prf.V2_MAX_N and self.delivery != "committee":
+            # The full-mesh samplers are O(n·f) per replica; only the §10
+            # committee family is admitted past the v2 packing edge.
+            raise ValueError(
+                f"n={self.n} exceeds the full-mesh ceiling ({prf.V2_MAX_N}); "
+                f"only delivery='committee' (spec §10) runs under the §2 v3 "
+                f"packing law (got delivery={self.delivery!r})")
         if not (0 <= self.f < self.n):
             raise ValueError(f"f={self.f} out of range for n={self.n}")
-        # Field limits depend on the packing law (spec §2 / §2 v2): v2 buys
-        # recv/send width by narrowing the instance and round fields.
-        max_inst = prf.MAX_INSTANCES if self.pack_version == 1 \
-            else prf.V2_MAX_INSTANCES
-        max_rounds = prf.MAX_ROUNDS if self.pack_version == 1 \
-            else prf.V2_MAX_ROUNDS
+        # Field limits depend on the packing law (spec §2 / §2 v2 / §2 v3):
+        # v2/v3 buy replica-field width by narrowing instance and round.
+        max_inst = {1: prf.MAX_INSTANCES, 2: prf.V2_MAX_INSTANCES,
+                    3: prf.V3_MAX_INSTANCES}[self.pack_version]
+        max_rounds = {1: prf.MAX_ROUNDS, 2: prf.V2_MAX_ROUNDS,
+                      3: prf.V3_MAX_ROUNDS}[self.pack_version]
         if not (0 < self.instances <= max_inst):
             raise ValueError(
                 f"instances={self.instances} out of range (1..{max_inst}) "
                 f"under packing v{self.pack_version} (n={self.n}): the spec "
                 f"§2 v{self.pack_version} law packs instance ids in "
-                f"{17 if self.pack_version == 1 else 16} bits — chunk sizing "
-                "(backends/jax_backend.py::_chunk_size) is clamped to the "
-                "same ceiling")
+                f"{ {1: 17, 2: 16, 3: 12}[self.pack_version] } bits — chunk "
+                "sizing (backends/jax_backend.py::_chunk_size) is clamped to "
+                "the same ceiling")
         if not (0 < self.round_cap <= max_rounds):
             raise ValueError(
                 f"round_cap={self.round_cap} out of range (1..{max_rounds}) "
@@ -177,6 +188,32 @@ class SimConfig:
                 )
         elif 2 * self.f >= self.n:
             raise ValueError(f"benor requires n > 2f (got n={self.n}, f={self.f})")
+        if self.delivery == "committee":
+            # Committee resilience (spec §10.3): thresholds are evaluated
+            # over committee counts, so the bound that must hold is the
+            # protocol's — in (C, f_C), the static committee size and fault
+            # budget. The full-mesh n > kf bounds above are necessary but
+            # not sufficient (f_C carries a +sqrt(C) sampling margin).
+            from byzantinerandomizedconsensus_tpu.ops import committee as _cm
+
+            c = _cm.committee_size(self.n)
+            fc = _cm.committee_fault_budget(self.n, self.f)
+            if self.protocol == "bracha":
+                if 3 * fc >= c:
+                    raise ValueError(
+                        f"committee resilience: bracha requires 3·f_C < C, "
+                        f"got C={c}, f_C={fc} (n={self.n}, f={self.f}; spec "
+                        f"§10.3 — lower f to restore the sortition margin)")
+            elif self.lying_adversary:
+                if 5 * fc >= c:
+                    raise ValueError(
+                        f"committee resilience: benor+{self.adversary} "
+                        f"requires 5·f_C < C, got C={c}, f_C={fc} "
+                        f"(n={self.n}, f={self.f}; spec §10.3)")
+            elif 2 * fc >= c:
+                raise ValueError(
+                    f"committee resilience: benor requires 2·f_C < C, got "
+                    f"C={c}, f_C={fc} (n={self.n}, f={self.f}; spec §10.3)")
         return self
 
 
@@ -258,6 +295,26 @@ def sweep_point(n: int, seed: int = 0, instances: int = SWEEP_INSTANCES) -> SimC
         protocol="bracha", n=n, f=_f_opt(n), instances=instances,
         adversary="adaptive", coin="shared", seed=seed,
         delivery=PRODUCT_DELIVERY,
+    ).validate()
+
+
+# The committee benchmark fault fraction (spec §10.3): f = n/5 rather than
+# the full-mesh optimum (n-1)/3, because the committee fault budget carries
+# a +sqrt(C) sampling margin — at f = n/3 the margin consumes the whole
+# bracha 3·f_C < C headroom. n/5 is the largest simple fraction that keeps
+# every committee tier (C from 16 to 160) resilient for bracha.
+COMMITTEE_FAULT_DIV = 5
+
+
+def committee_point(n: int, seed: int = 0,
+                    instances: int = SWEEP_INSTANCES) -> SimConfig:
+    """The config-5-shaped committee benchmark point (spec §10): the same
+    bracha/adaptive/shared shape as :func:`sweep_point` so cost curves
+    compare like against like, with the §10.3 fault fraction."""
+    return SimConfig(
+        protocol="bracha", n=n, f=n // COMMITTEE_FAULT_DIV,
+        instances=instances, adversary="adaptive", coin="shared", seed=seed,
+        delivery="committee",
     ).validate()
 
 

@@ -70,8 +70,10 @@ class ByzantineAdversary(Adversary):
         n = cfg.n
         send = np.arange(n, dtype=np.uint32)
         if cfg.protocol == "bracha":
-            b = prf.prf_u32(self.seed, self.instance, rnd, t, 0, send,
-                            prf.BYZ_VALUE, xp=np, pack=self._pack) & 3
+            # Sender-addressed draw: prf_sender swaps the wide field under
+            # the §2 v3 packing law (bit-identical at pack ≤ 2).
+            b = prf.prf_sender(self.seed, self.instance, rnd, t, 0, send,
+                               prf.BYZ_VALUE, xp=np, pack=self._pack) & 3
             silent = self.faulty & (b == 0)
             v = np.where(b == 1, 0, np.where(b == 2, 1, honest_values)).astype(np.uint8)
             values = np.where(self.faulty, v, honest_values).astype(np.uint8)

@@ -8,6 +8,8 @@ the oracle cross-checks the vectorized selection semantics.
 
 from __future__ import annotations
 
+import math
+
 import numpy as np
 
 from byzantinerandomizedconsensus_tpu.ops import prf
@@ -187,6 +189,85 @@ class Network:
             own = int(vals[v])
             c0[v] = m[0] - d[0] + (1 if own == 0 else 0)
             c1[v] = m[1] - d[1] + (1 if own == 1 else 0)
+        return c0, c1
+
+    def committee_counts(self, rnd: int, t: int, vals_by_class,
+                         silent: np.ndarray, strata: str = "none",
+                         minority: int = 0, fside=None):
+        """Per-receiver delivered counts (c0, c1) via the §10.2 committee law.
+
+        ``silent`` arrives with the membership silence already folded in
+        (spec §10.4 composition order), so the class counts ``m`` range over
+        live committee senders only. Same class/stratum semantics as
+        :meth:`urn3_counts` and the same §4c cheap split — but the drop
+        quota is the committee k_C = C − f_C − 1 (spec §10.3), the nibble
+        word is the COMMITTEE send=1 sub-address, and a receiver's own
+        message is delivered iff the receiver is itself a committee member
+        this step (send=0 word — non-members do not broadcast). Scalar
+        python-int implementation, independent of ops/committee.py: the
+        integer committee laws use bit_length()/math.isqrt here vs the
+        static compare-sums of the vectorized path.
+        """
+        n, f = self.cfg.n, self.cfg.f
+        half = (n + 1) // 2
+        cn = min(n, max(16, 8 * (n - 1).bit_length()))     # C(n), spec §10.1
+        fc = f if cn == n else (cn * f + n - 1) // n + math.isqrt(cn)
+        k = cn - fc - 1                                     # k_C, spec §10.3
+        c0 = np.empty(n, dtype=np.int32)
+        c1 = np.empty(n, dtype=np.int32)
+        for v in range(n):
+            h = 0 if v < half else 1
+            vals = vals_by_class[h]
+            m = [0, 0, 0]
+            for u in range(n):
+                if u != v and not silent[u] \
+                        and (fside is None or fside[u] == fside[v]):
+                    m[int(vals[u])] += 1
+            L = sum(m)
+            D = max(0, L - k)
+            if strata == "class":
+                st = [h != 0, h != 1, True]
+            elif strata == "minority":
+                st = [minority != 0, minority != 1, True]
+            else:
+                st = [False, False, False]
+            word = int(prf.prf_u32(self.seed, self.instance, rnd, t,
+                                   np.uint32(v), 1, prf.COMMITTEE, xp=np,
+                                   pack=self._pack))
+            mw = int(prf.prf_u32(self.seed, self.instance, rnd, t,
+                                 np.uint32(v), 0, prf.COMMITTEE, xp=np,
+                                 pack=self._pack))
+            member = (mw % n) < cn                          # spec §10.1
+
+            def cheap(seg: int, mm: int, Lr: int, Dr: int) -> int:
+                nib = (word >> (8 * seg)) & 0xF
+                corr = bin(nib).count("1") - 2
+                den = max(Lr, 1)
+                base = (2 * Dr * mm + den) // (2 * den)
+                lo = max(0, Dr - (Lr - mm))
+                hi = min(mm, Dr)
+                return min(max(base + corr, lo), hi)
+
+            d = [0, 0]
+            mb = [m[w] if st[w] else 0 for w in range(3)]
+            Lb = sum(mb)
+            Db = min(D, Lb)
+            Lr, Dr = Lb, Db
+            for w in (0, 1):                 # segments 0-1: biased stratum
+                dw = cheap(w, mb[w], Lr, Dr)
+                d[w] += dw
+                Lr -= mb[w]
+                Dr -= dw
+            Lr, Dr = L - Lb, D - Db
+            for w in (0, 1):                 # segments 2-3: unbiased stratum
+                mu = m[w] - mb[w]
+                dw = cheap(2 + w, mu, Lr, Dr)
+                d[w] += dw
+                Lr -= mu
+                Dr -= dw
+            own = int(vals[v])
+            c0[v] = m[0] - d[0] + (1 if member and own == 0 else 0)
+            c1[v] = m[1] - d[1] + (1 if member and own == 1 else 0)
         return c0, c1
 
     def urn3_counts(self, rnd: int, t: int, vals_by_class, silent: np.ndarray,

@@ -13,7 +13,13 @@ import numpy as np
 
 class Replica:
     def __init__(self, cfg, index: int, est: int):
+        from byzantinerandomizedconsensus_tpu.models.committee import (
+            quorum_params)
+
         self.cfg = cfg
+        # The (n, f) pair thresholds evaluate over: (n, f) itself for the
+        # full-mesh deliveries, the committee (C, f_C) under spec §10.3.
+        self._nq, self._fq = quorum_params(cfg)
         self.index = index
         self.est = int(est)
         self.decided = False
@@ -45,8 +51,10 @@ class Replica:
         self.on_counts(t, c0, c1)
 
     def on_counts(self, t: int, c0: int, c1: int) -> None:
-        """Process one step from delivered-value counts (urn delivery, spec §4b)."""
-        n, f = self.cfg.n, self.cfg.f
+        """Process one step from delivered-value counts (urn delivery, spec
+        §4b). Committee configs evaluate the same thresholds over (C, f_C)
+        — spec §10.3."""
+        n, f = self._nq, self._fq
         if self.cfg.protocol == "benor":
             # Protocol A (benign) vs Protocol B (lying) thresholds — spec §5.1.
             lying = self.cfg.lying_adversary

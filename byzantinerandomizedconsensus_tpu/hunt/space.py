@@ -17,8 +17,8 @@ honest:
    receive admissible candidates, never exceptions.
 3. **Serving-shaped by construction.** n ≤ 40 folds every candidate into
    the FUSED_SMALL_TIER, and round_cap ≤ 128 fits the default feed
-   ceiling — the *entire* bucket universe of the space is the 8-element
-   product (2 protocols × 4 deliveries), enumerable by :meth:`buckets`
+   ceiling — the *entire* bucket universe of the space is the 10-element
+   product (2 protocols × 5 deliveries), enumerable by :meth:`buckets`
    for a complete warm-up. That is what makes the hunt's
    0-steady-state-recompile pin achievable.
 """
@@ -117,6 +117,13 @@ class SearchSpace:
                  for k in GENOME_FIELDS}
         return decode(self._repair(child))
 
+    def materialize(self, genome: dict) -> SimConfig:
+        """Repair + decode a strategy-built genome through the one
+        admissibility gate — the seam continuous strategies (hunt/
+        strategies.py ``cma``) use to land arbitrary latent points inside
+        the admissible region without re-implementing the repair laws."""
+        return decode(self._repair(dict(genome)))
+
     def regions(self) -> list:
         """The successive-halving bandit's arms: the adversary × delivery
         product — the axes the hunt question is *about* (which adversary
@@ -141,7 +148,7 @@ class SearchSpace:
 
     def buckets(self) -> list:
         """The complete compiled-program universe of this space: n ≤ 40
-        folds every candidate to the small fused tier, so 2 protocols × 4
+        folds every candidate to the small fused tier, so 2 protocols × 5
         deliveries is *all* the programs a hunt can touch. The hunter warms
         exactly these before measuring, which is why the
         0-steady-state-recompile pin is meaningful."""

@@ -29,7 +29,8 @@ from __future__ import annotations
 
 import random
 
-from byzantinerandomizedconsensus_tpu.hunt.space import SearchSpace
+from byzantinerandomizedconsensus_tpu.hunt.space import (
+    _MUTATION_DOMAINS, SearchSpace)
 
 
 class Strategy:
@@ -166,8 +167,163 @@ class BanditStrategy(Strategy):
         return d
 
 
+class CmaStrategy(Strategy):
+    """CMA-style continuous ask/tell optimizer (round 19): a diagonal
+    (μ/μ_w, λ) evolution strategy over the numeric axes plus PBIL-style
+    categorical tables over the discrete ones.
+
+    Numeric axes (n, f, round_cap rung, crash window rung) live in a
+    normalized [0, 1] latent cube: ``ask()`` draws ``x = m + σ ⊙ z`` with
+    ``z ~ N(0, I)`` around the adapted mean, decodes through the space's
+    repair gate (:meth:`SearchSpace.materialize`), and remembers ``(x, z)``
+    per candidate. Every λ tells close a generation: the top-μ candidates
+    (log-rank weighted) pull the mean, the per-axis step sizes σ_j adapt by
+    the elites' mean squared z (cumulative-step-size adaptation restricted
+    to the diagonal — the CMA mechanism that matters at 4 dimensions), and
+    the categorical tables relax toward the elite frequencies with a floor
+    so no value's probability ever hits zero.
+
+    Pipelining contract: ask never blocks, and a tell whose candidate was
+    asked under an already-closed generation still joins the current
+    buffer — the update is a pure function of the tell *sequence*, exactly
+    like the other strategies. Instances and seed ride along from the
+    chaos base draw each ask, so repeated latent points still explore
+    fitness noise instead of re-measuring one seed."""
+
+    name = "cma"
+
+    LAMBDA = 12        #: generation size (tells per update)
+    MU = 4             #: elites pulling the mean
+    SIGMA0 = 0.35      #: initial per-axis step size
+    SIGMA_LO, SIGMA_HI = 0.02, 0.6
+    C_SIGMA = 0.3      #: per-axis step-size learning rate
+    C_CAT = 0.25       #: categorical table learning rate
+    CAT_FLOOR = 0.02   #: exploration floor per categorical value
+
+    #: latent (continuous) axes, in cube-coordinate order
+    AXES = ("n", "f", "round_cap", "crash_window")
+    #: table (categorical) axes, in update order
+    CAT_AXES = ("protocol", "adversary", "coin", "init", "delivery",
+                "faults")
+
+    def __init__(self, space: SearchSpace, seed: int):
+        super().__init__(space, seed)
+        self._mean = [0.5] * len(self.AXES)
+        self._sigma = [self.SIGMA0] * len(self.AXES)
+        self._domains = {a: tuple(_MUTATION_DOMAINS[a])
+                         for a in self.CAT_AXES}
+        self._tables = {a: [1.0 / len(d)] * len(d)
+                        for a, d in self._domains.items()}
+        # genome-signature -> [(x, z), …] for in-flight candidates (a list:
+        # the same genome can be asked twice under ask-ahead pipelining)
+        self._pending: dict = {}
+        self._gen_buffer: list = []  # (fitness, -arrival, x, z, genome)
+        self.generation = 0
+
+    def _sig(self, genome: dict):
+        from byzantinerandomizedconsensus_tpu.hunt.space import GENOME_FIELDS
+
+        return tuple(genome[k] for k in GENOME_FIELDS)
+
+    def _pick(self, axis: str) -> str:
+        """One seeded categorical draw from the axis table."""
+        u = self.rng.random()
+        acc = 0.0
+        dom, probs = self._domains[axis], self._tables[axis]
+        for v, p in zip(dom, probs):
+            acc += p
+            if u < acc:
+                return v
+        return dom[-1]
+
+    def _decode(self, x: list) -> dict:
+        """Latent cube point → genome axis values (pre-repair)."""
+        def clamp01(v):
+            return min(1.0, max(0.0, v))
+
+        n = 4 + int(round(clamp01(x[0]) * (self.space.max_n - 4)))
+        out = {"n": n,
+               # fraction of n; the repair gate clamps to the resilience
+               # ceiling for whatever (protocol, adversary) lands beside it
+               "f": int(round(clamp01(x[1]) * n))}
+        for j, axis in ((2, "round_cap"), (3, "crash_window")):
+            dom = _MUTATION_DOMAINS[axis]
+            idx = min(len(dom) - 1, int(clamp01(x[j]) * len(dom)))
+            out[axis] = dom[idx]
+        return out
+
+    def ask(self):
+        from byzantinerandomizedconsensus_tpu.hunt import space as _space
+
+        base = _space.encode(self.space.sample(self.rng))
+        z = [self.rng.gauss(0.0, 1.0) for _ in self.AXES]
+        x = [m + s * zi for m, s, zi in zip(self._mean, self._sigma, z)]
+        genome = dict(base)
+        genome.update(self._decode(x))
+        for axis in self.CAT_AXES:
+            genome[axis] = self._pick(axis)
+        cfg = self.space.materialize(genome)
+        # remember the latent point under the *repaired* genome — that is
+        # the identity tell() will see back
+        self._pending.setdefault(self._sig(_space.encode(cfg)),
+                                 []).append((x, z))
+        return cfg
+
+    def tell(self, cfg, fitness: float) -> None:
+        from byzantinerandomizedconsensus_tpu.hunt import space as _space
+
+        super().tell(cfg, fitness)
+        genome = _space.encode(cfg)
+        entry = self._pending.get(self._sig(genome))
+        if not entry:
+            return  # replayed/foreign candidate: best-only, like bandit
+        x, z = entry.pop(0)
+        if not entry:
+            del self._pending[self._sig(genome)]
+        self._gen_buffer.append((float(fitness), -self.evaluations, x, z,
+                                 genome))
+        if len(self._gen_buffer) >= self.LAMBDA:
+            self._update()
+
+    def _update(self) -> None:
+        elites = sorted(self._gen_buffer, reverse=True)[:self.MU]
+        self._gen_buffer = []
+        self.generation += 1
+        import math as _math
+
+        raw = [_math.log(self.MU + 0.5) - _math.log(i + 1)
+               for i in range(len(elites))]
+        tot = sum(raw)
+        w = [r / tot for r in raw]
+        for j in range(len(self.AXES)):
+            self._mean[j] = min(1.0, max(0.0, sum(
+                wi * e[2][j] for wi, e in zip(w, elites))))
+            z2 = sum(wi * e[3][j] * e[3][j] for wi, e in zip(w, elites))
+            self._sigma[j] = min(self.SIGMA_HI, max(
+                self.SIGMA_LO,
+                self._sigma[j] * _math.exp(self.C_SIGMA
+                                           * (_math.sqrt(z2) - 1.0))))
+        for axis in self.CAT_AXES:
+            dom = self._domains[axis]
+            freq = [sum(wi for wi, e in zip(w, elites)
+                        if e[4][axis] == v) for v in dom]
+            probs = [(1.0 - self.C_CAT) * p + self.C_CAT * fr
+                     for p, fr in zip(self._tables[axis], freq)]
+            probs = [max(self.CAT_FLOOR, p) for p in probs]
+            s = sum(probs)
+            self._tables[axis] = [p / s for p in probs]
+
+    def doc(self) -> dict:
+        d = super().doc()
+        d["generation"] = self.generation
+        d["sigma"] = [round(s, 4) for s in self._sigma]
+        d["mean"] = [round(m, 4) for m in self._mean]
+        return d
+
+
 STRATEGIES = {cls.name: cls for cls in
-              (RandomStrategy, EvolutionStrategy, BanditStrategy)}
+              (RandomStrategy, EvolutionStrategy, BanditStrategy,
+               CmaStrategy)}
 
 
 def make_strategy(name: str, space: SearchSpace, seed: int) -> Strategy:

@@ -114,8 +114,10 @@ class AdversaryModel:
         if cfg.adversary == "byzantine":
             if cfg.protocol == "bracha":
                 # RBC count-level outcome, common to all receivers (spec §6.3).
-                b = prf.prf_u32(seed, inst, rnd, t, 0, send, prf.BYZ_VALUE,
-                                xp=xp, pack=cfg.pack_version) & xp.uint32(3)
+                # Sender-addressed draw: prf_sender swaps the wide field
+                # under the §2 v3 packing law (bit-identical at pack ≤ 2).
+                b = prf.prf_sender(seed, inst, rnd, t, 0, send, prf.BYZ_VALUE,
+                                   xp=xp, pack=cfg.pack_version) & xp.uint32(3)
                 silent = faulty & (b == 0)
                 v = xp.where(b == 1, xp.uint8(0),
                              xp.where(b == 2, xp.uint8(1), honest_values.astype(xp.uint8)))
@@ -155,9 +157,9 @@ class AdversaryModel:
                 # for bracha; for count-level Ben-Or the urns recompute the
                 # two-faced class values themselves (lane_setup selects).
                 if cfg.protocol == "bracha":
-                    b = prf.prf_u32(seed, inst, rnd, t, 0, send,
-                                    prf.BYZ_VALUE, xp=xp,
-                                    pack=cfg.pack_version) & xp.uint32(3)
+                    b = prf.prf_sender(seed, inst, rnd, t, 0, send,
+                                       prf.BYZ_VALUE, xp=xp,
+                                       pack=cfg.pack_version) & xp.uint32(3)
                     byz_sil = faulty & (b == 0)
                     v = xp.where(b == 1, xp.uint8(0),
                                  xp.where(b == 2, xp.uint8(1),

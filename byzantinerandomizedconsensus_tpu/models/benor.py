@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from byzantinerandomizedconsensus_tpu.models import coins, faults
+from byzantinerandomizedconsensus_tpu.models import coins, committee, faults
 from byzantinerandomizedconsensus_tpu.models.delivery import make_counts
 from byzantinerandomizedconsensus_tpu.utils import profiling
 
@@ -34,7 +34,9 @@ def round_body(cfg, seed, inst_ids, rnd, state, adv, setup, xp=np,
     """
     # n enters the round body only as a protocol *value* (quorum thresholds),
     # never as a shape — read n_eff so the batched lane runner can trace it.
-    n, f = cfg.n_eff, cfg.f
+    # Committee configs (spec §10.3) evaluate the same thresholds over
+    # (C, f_C); every other delivery gets (n_eff, f) back unchanged.
+    n, f = committee.quorum_params(cfg, xp)
     if gather is None:
         gather = lambda v: v
     est, decided = state["est"], state["decided"]
@@ -68,6 +70,9 @@ def round_body(cfg, seed, inst_ids, rnd, state, adv, setup, xp=np,
                                         xp=xp, recv_ids=recv_ids)
         if fsil is not None:
             silent0 = silent0 | fsil
+        msil0 = committee.step_silence(cfg, seed, inst_ids, rnd, 0, xp=xp)
+        if msil0 is not None:
+            silent0 = silent0 | msil0
         r0, r1 = counts(0, h0, v0, silent0, bias0)
         prop = xp.where(2 * r1 > quorum_rhs, xp.uint8(1),
                         xp.where(2 * r0 > quorum_rhs, xp.uint8(0), xp.uint8(2)))
@@ -79,6 +84,9 @@ def round_body(cfg, seed, inst_ids, rnd, state, adv, setup, xp=np,
                                         xp=xp, recv_ids=recv_ids)
         if fsil is not None:
             silent1 = silent1 | fsil
+        msil1 = committee.step_silence(cfg, seed, inst_ids, rnd, 1, xp=xp)
+        if msil1 is not None:
+            silent1 = silent1 | msil1
         p0, p1 = counts(1, h1, v1, silent1, bias1)
         w = (p1 >= p0).astype(xp.uint8)
         c = xp.where(w == 1, p1, p0)

@@ -14,7 +14,8 @@ from __future__ import annotations
 
 import numpy as np
 
-from byzantinerandomizedconsensus_tpu.models import coins, faults, validation
+from byzantinerandomizedconsensus_tpu.models import (coins, committee, faults,
+                                                     validation)
 from byzantinerandomizedconsensus_tpu.models.delivery import make_counts
 from byzantinerandomizedconsensus_tpu.utils import profiling
 
@@ -39,7 +40,9 @@ def round_body(cfg, seed, inst_ids, rnd, state, adv, setup, xp=np,
     """
     # n enters the round body only as a protocol *value* (quorum thresholds),
     # never as a shape — read n_eff so the batched lane runner can trace it.
-    n, f = cfg.n_eff, cfg.f
+    # Committee configs (spec §10.3) evaluate the same thresholds over
+    # (C, f_C); every other delivery gets (n_eff, f) back unchanged.
+    n, f = committee.quorum_params(cfg, xp)
     if gather is None:
         gather = lambda v: v
     est, decided = state["est"], state["decided"]
@@ -60,6 +63,9 @@ def round_body(cfg, seed, inst_ids, rnd, state, adv, setup, xp=np,
                                 recv_ids=recv_ids)
         if fsil is not None:
             s0 = s0 | fsil
+        msil0 = committee.step_silence(cfg, seed, inst_ids, rnd, 0, xp=xp)
+        if msil0 is not None:
+            s0 = s0 | msil0
         g0_0, g0_1 = validation.live_counts(v0, s0, xp=xp)
         c0_0, c0_1 = counts(0, h0, v0, s0, b0)
         m = (c0_1 >= c0_0).astype(xp.uint8)
@@ -72,7 +78,11 @@ def round_body(cfg, seed, inst_ids, rnd, state, adv, setup, xp=np,
                                 recv_ids=recv_ids)
         if fsil is not None:
             s1 = s1 | fsil
-        s1 = s1 | validation.validate_step1(cfg, v1, g0_0, g0_1, xp=xp)
+        msil1 = committee.step_silence(cfg, seed, inst_ids, rnd, 1, xp=xp)
+        if msil1 is not None:
+            s1 = s1 | msil1
+        s1 = s1 | validation.validate_step1(cfg, v1, g0_0, g0_1, xp=xp,
+                                            nf=(n, f))
         g1_0, g1_1 = validation.live_counts(v1, s1, xp=xp)
         c1_0, c1_1 = counts(1, h1, v1, s1, b1)
         d = xp.where(2 * c1_1 > n, xp.uint8(1),
@@ -85,7 +95,11 @@ def round_body(cfg, seed, inst_ids, rnd, state, adv, setup, xp=np,
                                 recv_ids=recv_ids)
         if fsil is not None:
             s2 = s2 | fsil
-        s2 = s2 | validation.validate_step2(cfg, v2, g1_0, g1_1, xp=xp)
+        msil2 = committee.step_silence(cfg, seed, inst_ids, rnd, 2, xp=xp)
+        if msil2 is not None:
+            s2 = s2 | msil2
+        s2 = s2 | validation.validate_step2(cfg, v2, g1_0, g1_1, xp=xp,
+                                            nf=(n, f))
         c2_0, c2_1 = counts(2, h2, v2, s2, b2)
         w = (c2_1 >= c2_0).astype(xp.uint8)
         c = xp.where(w == 1, c2_1, c2_0)

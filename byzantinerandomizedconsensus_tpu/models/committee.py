@@ -1,0 +1,59 @@
+"""Committee quorum seam for the round bodies (spec/PROTOCOL.md §10).
+
+The protocol layer reads every value-of-n law through ``cfg.n_eff`` and
+``cfg.f``. The committee family (ops/committee.py) changes *which* (n, f)
+the thresholds see — the static committee size C and fault budget f_C —
+without touching the threshold arithmetic itself. :func:`quorum_params` is
+that one seam: for every non-committee delivery it returns
+``(cfg.n_eff, cfg.f)`` unchanged (the identical objects, so no compiled
+program moves), and for the committee family it returns ``(C, f_C)``
+(python ints for plain configs, traced int32 scalars under the batched
+lane runner).
+
+:class:`CommitteeUnsupported` mirrors models/faults.FaultsUnsupported for
+the stacks without a committee channel (the native ABI, the Pallas kernels,
+the shard_map mesh): they degrade honestly instead of silently running the
+full-mesh law.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu.ops import committee as _committee
+from byzantinerandomizedconsensus_tpu.ops.committee import step_silence  # noqa: F401  (re-export for the round bodies)
+
+
+class CommitteeUnsupported(RuntimeError):
+    """Raised by stacks that have no committee channel (the native ABI, the
+    Pallas kernels, the shard_map mesh). Callers degrade honestly —
+    mirroring models/faults.FaultsUnsupported — instead of silently running
+    the full-mesh delivery law."""
+
+
+def check_committee_supported(cfg, stack: str) -> None:
+    """Shared gate: reject ``cfg.delivery == "committee"`` on a stack
+    without a committee channel with one uniform message."""
+    if cfg.delivery == "committee":
+        raise CommitteeUnsupported(
+            f"{stack} has no committee channel; "
+            "delivery='committee' runs on the cpu|numpy|jax stacks")
+
+
+def quorum_params(cfg, xp=np):
+    """The (n, f) pair the protocol thresholds evaluate over (spec §10.3).
+
+    Non-committee deliveries return ``(cfg.n_eff, cfg.f)`` — the identical
+    objects, so every existing config's round body is untouched. The
+    committee family returns the static ``(C, f_C)``; both laws are exact
+    compare-sum integer forms (ops/committee.py), so the python-int and
+    traced paths agree bit-for-bit.
+    """
+    n, f = cfg.n_eff, cfg.f
+    if cfg.delivery != "committee":
+        return n, f
+    if isinstance(n, (int, np.integer)) and isinstance(f, (int, np.integer)):
+        return (_committee.committee_size(int(n)),
+                _committee.committee_fault_budget(int(n), int(f)))
+    return (_committee.committee_size(n, xp=xp),
+            _committee.committee_fault_budget(n, f, xp=xp))

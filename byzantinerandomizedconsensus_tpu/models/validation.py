@@ -19,18 +19,24 @@ def live_counts(values, silent, xp=np):
     return g0, g1
 
 
-def validate_step1(cfg, values, g0_0, g0_1, xp=np):
-    """(B, n) bool — invalid step-1 (x) messages, from step-0 global counts."""
-    q = cfg.n_eff - cfg.f             # value-of-n law: traced under batching
+def validate_step1(cfg, values, g0_0, g0_1, xp=np, nf=None):
+    """(B, n) bool — invalid step-1 (x) messages, from step-0 global counts.
+
+    ``nf``, when given, overrides the (n, f) pair the quorum q = n − f is
+    derived from — the committee round body passes its (C, f_C) so the
+    validity interval matches the committee-scoped G counts (spec §10.3)."""
+    n, f = nf if nf is not None else (cfg.n_eff, cfg.f)  # value-of-n law
+    q = n - f                         # traced under batching
     ok1 = g0_1 >= (q + 1) // 2        # x=1: can be a ties->1 majority of a q-subset
     ok0 = g0_0 >= q // 2 + 1          # x=0: must be a strict majority
     return ~xp.where(values == 1, ok1[:, None],
                      xp.where(values == 0, ok0[:, None], True))
 
 
-def validate_step2(cfg, values, g1_0, g1_1, xp=np):
-    """(B, n) bool — invalid step-2 (z) messages, from valid step-1 global counts."""
-    n, f = cfg.n_eff, cfg.f           # value-of-n law: traced under batching
+def validate_step2(cfg, values, g1_0, g1_1, xp=np, nf=None):
+    """(B, n) bool — invalid step-2 (z) messages, from valid step-1 global
+    counts. ``nf`` overrides (n, f) as in :func:`validate_step1`."""
+    n, f = nf if nf is not None else (cfg.n_eff, cfg.f)  # value-of-n law
     q = n - f
     okv1 = g1_1 >= n // 2 + 1
     okv0 = g1_0 >= n // 2 + 1

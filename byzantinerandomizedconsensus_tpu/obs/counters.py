@@ -41,7 +41,11 @@ import numpy as np
 # ``dropped@ph`` law becomes partition-aware (a receiver's live total counts
 # only same-side senders). v1 configs (faults="none") keep the exact v1
 # column set and values.
-COUNTER_SCHEMA_VERSION = 2
+# v3 (spec §10): committee configs gain ``committee_size@ph`` (realized
+# committee size per phase) after the sampler cost counters, and their
+# ``dropped@ph`` quota is the committee k_C = C − f_C − 1. Non-committee
+# configs keep the exact v2 column set and values.
+COUNTER_SCHEMA_VERSION = 3
 
 # Step-index → phase-name mapping per protocol. Ben-Or's two broadcast steps
 # are the classic report/propose pair (models/benor.py); Bracha's three are
@@ -80,6 +84,12 @@ def counter_names(cfg) -> tuple[str, ...]:
         names += [f"delivered0@{ph}", f"delivered1@{ph}", f"dropped@{ph}"]
     names += ["coin_flips", "rounds_active"]
     names += _SAMPLER_COUNTERS.get(cfg.delivery, ())
+    if cfg.delivery == "committee":
+        # Schema v3 (spec §10): realized committee size per phase — the
+        # members among real replicas, summed over active rounds. Dividing
+        # by rounds_active recovers the mean committee the run actually drew.
+        for ph in phase_names(cfg):
+            names += [f"committee_size@{ph}"]
     if cfg.faults != "none":
         # Schema v2 fault attribution (spec §9): senders the fault schedule
         # silenced this step (whether or not the adversary also did), and
@@ -97,10 +107,13 @@ def counter_names(cfg) -> tuple[str, ...]:
 #   chain_trips      — §4b-v2 conditional-Bernoulli trips Σ_segments Σ_lanes K
 #   chain_trips_max  — max per-(lane, segment) K seen (the "K = D?" signal)
 #   urn3_words       — §4c Threefry words (one per receiver-step)
+#   committee_draws  — §10 Threefry words (2·n per receiver-step: one
+#                      membership word per replica + one drop word per recv)
 _SAMPLER_COUNTERS = {
     "urn": ("urn_draws",),
     "urn2": ("chain_trips", "chain_trips_max"),
     "urn3": ("urn3_words",),
+    "committee": ("committee_draws",),
 }
 
 _MAX_COUNTERS = frozenset({"chain_trips_max"})
@@ -128,8 +141,15 @@ def round_increments(cfg, obs: dict, xp=np):
         raise ValueError(f"obs is missing step entries: have {sorted(obs)}")
     batch = obs[0]["c0"].shape[0]
     # n-value law (traced under batched lanes): asarray, not the dtype
-    # constructor, so a traced n_eff/f pair is accepted.
-    k = xp.asarray(cfg.n_eff - cfg.f - 1, dtype=i32)
+    # constructor, so a traced n_eff/f pair is accepted. Committee configs
+    # wait for the committee quota k_C instead (spec §10.2).
+    if cfg.delivery == "committee":
+        from byzantinerandomizedconsensus_tpu.ops import committee as _cm
+
+        k = xp.asarray(_cm.committee_quota(cfg.n_eff, cfg.f, xp=xp),
+                       dtype=i32)
+    else:
+        k = xp.asarray(cfg.n_eff - cfg.f - 1, dtype=i32)
     # Pad-exact receiver axis (backends/batch.py): sums over receivers mask
     # padding lanes (index ≥ n_eff), so a padded lane's totals equal the
     # per-config run's. None (no masking compiled in) for plain configs.
@@ -183,6 +203,11 @@ def round_increments(cfg, obs: dict, xp=np):
             for t in range(1, steps):
                 acc = (acc + obs[t]["stats"][name].astype(u32)).astype(u32)
             cols.append(acc)
+    if cfg.delivery == "committee":
+        # committee_size@ph: the sampler's per-step realized-membership count
+        # (ops/committee.py ``committee_members`` stat), one column per phase.
+        for t in range(steps):
+            cols.append(obs[t]["stats"]["committee_members"].astype(u32))
     if cfg.faults != "none":
         for t in range(steps):
             e = obs[t]

@@ -132,8 +132,11 @@ RECORD_VERSION = 1
 # (closed-loop adversary search: strategy identity, budget accounting,
 # best-fitness / violation / steady-compile pins); v1.9 (round 18) the
 # hostile block (hostile-load suite: per-scenario rejection / fairness /
-# deadline-hit-rate rows + mismatch / steady-compile pins).
-RECORD_REVISION = 9
+# deadline-hit-rate rows + mismatch / steady-compile pins); v1.10 (round 19)
+# the committee block (spec §10 committee cost curve: log-spaced n legs,
+# realized committee sizes / fault budgets, per-replica cost flatness vs the
+# full-mesh baseline, the n=10⁵ checker verdict and the serve pins).
+RECORD_REVISION = 10
 
 
 def env_fingerprint() -> dict:
@@ -149,7 +152,9 @@ def env_fingerprint() -> dict:
         "package": __version__,
         "python": platform.python_version(),
         "numpy": np.__version__,
-        "pack_versions": sorted(prf.PACK_SHIFTS),
+        # Every §2 packing law this build speaks (KEY_LOW_BITS carries one
+        # entry per law; PACK_SHIFTS is Pallas-only and stops at v2).
+        "pack_versions": sorted(prf.KEY_LOW_BITS),
     }
     try:
         from byzantinerandomizedconsensus_tpu.backends.native_backend import (
@@ -504,6 +509,30 @@ def hostile_block(stats: dict | None) -> dict | None:
             if k in stats}
 
 
+#: The fields a schema-v1.10 ``committee`` block must carry (the spec §10
+#: committee cost-curve accounting of tools/cost_curve.py: the measured n
+#: grid, the realized committee laws along it, the per-replica flatness
+#: verdict vs the full-mesh baseline, and the checker / serve pins).
+COMMITTEE_BLOCK_KEYS = ("ns", "committee_sizes", "fault_budgets",
+                        "per_replica_cost", "flatness",
+                        "checker_n", "checker_ok")
+
+
+def committee_block(stats: dict | None) -> dict | None:
+    """The schema-v1.10 ``committee`` block from a committee cost-curve
+    stats dict (tools/cost_curve.py). None in, None out — a record without
+    the block stays a valid v1.x record. ``per_replica_cost`` maps n →
+    wall / (instances · n); ``flatness`` is the largest-to-smallest-n ratio
+    of that cost per delivery (the committee family's flat-ish claim is that
+    its ratio stays near 1 where the full-mesh families grow like n)."""
+    if stats is None:
+        return None
+    return {k: stats.get(k) for k in
+            (COMMITTEE_BLOCK_KEYS + ("fault_div", "instances", "baseline",
+                                     "serve", "counters"))
+            if k in stats}
+
+
 def validate_record(doc: dict) -> list:
     """Schema check: returns a list of problems (empty = valid v1 record)."""
     problems = []
@@ -652,6 +681,19 @@ def validate_record(doc: dict) -> list:
                                 problems.append(
                                     f"hostile scenario row {i} missing "
                                     f"{key!r}")
+    cb = doc.get("committee")
+    if cb is not None:
+        if not isinstance(cb, dict):
+            problems.append("committee block is not a dict")
+        else:
+            for key in COMMITTEE_BLOCK_KEYS:
+                if key not in cb:
+                    problems.append(f"committee block missing {key!r}")
+            if not isinstance(cb.get("ns"), list):
+                problems.append("committee block 'ns' is not a list")
+            ok = cb.get("checker_ok")
+            if ok is not None and not isinstance(ok, bool):
+                problems.append("committee block 'checker_ok' is not a bool")
     pg = doc.get("programs")
     if pg is not None:
         if not isinstance(pg, dict):

@@ -19,4 +19,8 @@ def delivery_counts_fn(delivery: str):
         from byzantinerandomizedconsensus_tpu.ops import urn3
 
         return urn3.counts_fn
+    if delivery == "committee":
+        from byzantinerandomizedconsensus_tpu.ops import committee
+
+        return committee.counts_fn
     raise KeyError(f"no count-level sampler for delivery {delivery!r}")

@@ -1,0 +1,234 @@
+"""Committee-sampled delivery (spec/PROTOCOL.md §10) — sortition at count level.
+
+The full-mesh samplers (§4b/§4b-v2/§4c) cost O(n·f) per replica and the §2
+v2 packing law caps them at n = 4096. The committee family replaces the
+broadcast set: per (instance, round, phase), a PRF-drawn committee of
+C(n) = min(n, max(16, 8·⌈log₂ n⌉)) replicas broadcasts, everyone listens,
+and the protocol thresholds (models/benor.py, models/bracha.py) are
+evaluated over *committee* counts with the sampled fault budget
+f_C = ⌈C·f/n⌉ + ⌊√C⌋ (spec §10.3) — per-replica work drops to
+O(C·polylog n) and n rides the §2 v3 packing law to 2^20.
+
+Sortition (spec §10.1) is a pure function of coordinates: replica u is in
+the committee of (instance, round, phase) iff
+
+    prf(seed, instance, round, phase, recv=u, send=0, COMMITTEE) % n < C(n)
+
+so every stack (oracle, numpy, jax) derives the same committees with no
+communication, exactly like every other draw in this codebase.
+Non-members enter the step's *silent* set (the round bodies OR the
+membership silence in right after the §9 fault silences — spec §10.4
+composition order), which makes the §5.1b validation counts and the
+``dropped@ph`` counter law committee-scoped automatically.
+
+The drop law (spec §10.2) mirrors §4c: per receiver, D = max(0, L − k_C)
+live committee messages are dropped with k_C = C − f_C − 1, split across
+value classes by the mode-anchored cheap law (one Threefry nibble word per
+receiver-step, the send=1 sub-address of the COMMITTEE purpose). A
+receiver's own message is delivered iff the receiver is itself a committee
+member this phase (non-members do not broadcast).
+
+Generic over the array namespace (numpy / jax.numpy); the CPU oracle
+implements the same spec independently in
+core/network.py::Network.committee_counts. The integer committee laws below
+are written as static compare-sums (no log2 / isqrt library calls) so they
+are exact for python ints AND safe for traced int32 lane scalars
+(backends/batch.py) — both paths compute the identical value.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from byzantinerandomizedconsensus_tpu.ops import prf, urn
+
+#: C(n) law constants (spec §10.1): floor committee of 16, slope 8 per
+#: doubling, degenerating to the full mesh (C = n) at small n.
+SIZE_FLOOR = 16
+SIZE_SLOPE = 8
+#: ⌈log₂ n⌉ as a sum of static compares — 20 terms covers the §2 v3
+#: ceiling n ≤ 2^20 exactly.
+_CL2_BITS = 20
+#: ⌊√C⌋ as a sum of static compares — C ≤ SIZE_FLOOR + SIZE_SLOPE·20 = 176
+#: < 14², so 13 terms are exact.
+_ISQRT_MAX = 13
+
+
+def committee_size(n, xp=None):
+    """C(n) = min(n, max(16, 8·⌈log₂ n⌉)) — spec §10.1.
+
+    With ``xp=None``, ``n`` is a python int and the result is a python int;
+    with an array namespace, ``n`` may be a (possibly traced) int32 scalar
+    and the result is an int32 scalar of the same kind.
+    """
+    if xp is None:
+        cl2 = sum(1 for k in range(_CL2_BITS) if (1 << k) < n)
+        return min(int(n), max(SIZE_FLOOR, SIZE_SLOPE * cl2))
+    i32 = xp.int32
+    n = xp.asarray(n, dtype=i32)
+    cl2 = xp.asarray(0, dtype=i32)
+    for k in range(_CL2_BITS):
+        cl2 = cl2 + (xp.asarray(1 << k, dtype=i32) < n).astype(i32)
+    c = xp.maximum(i32(SIZE_FLOOR), i32(SIZE_SLOPE) * cl2)
+    return xp.minimum(n, c).astype(i32)
+
+
+def committee_fault_budget(n, f, xp=None):
+    """f_C — the committee fault budget (spec §10.3).
+
+    When C(n) = n the committee *is* the full mesh and f_C = f exactly (the
+    family degenerates to plain thresholds). Otherwise
+    f_C = ⌈C·f/n⌉ + ⌊√C⌋: the expected committee-faulty count plus a
+    sampling margin (membership is Bernoulli(C/n) per replica, std < √C/2,
+    so the margin is > 2σ). All arithmetic fits int32: C·f ≤ 176·2^20.
+    """
+    if xp is None:
+        c = committee_size(n)
+        if c == n:
+            return int(f)
+        isq = sum(1 for s in range(1, _ISQRT_MAX + 1) if s * s <= c)
+        return (c * int(f) + int(n) - 1) // int(n) + isq
+    i32 = xp.int32
+    n = xp.asarray(n, dtype=i32)
+    f = xp.asarray(f, dtype=i32)
+    c = committee_size(n, xp=xp)
+    isq = xp.asarray(0, dtype=i32)
+    for s in range(1, _ISQRT_MAX + 1):
+        isq = isq + (xp.asarray(s * s, dtype=i32) <= c).astype(i32)
+    samp = (c * f + n - i32(1)) // n + isq
+    return xp.where(c == n, f, samp).astype(i32)
+
+
+def committee_quota(n, f, xp=None):
+    """k_C = C − f_C − 1 — the per-receiver guaranteed-delivery quota the
+    §10.2 drop law waits for (the committee analogue of §4b's n − f − 1)."""
+    if xp is None:
+        return committee_size(n) - committee_fault_budget(n, f) - 1
+    i32 = xp.int32
+    return (committee_size(n, xp=xp)
+            - committee_fault_budget(n, f, xp=xp) - i32(1)).astype(i32)
+
+
+def membership_plane(cfg, seed, inst_ids, rnd, t, xp=np):
+    """(B, n) bool — committee membership of every replica for step ``t``
+    (spec §10.1). Membership of padding replicas (index ≥ n_eff under the
+    batched lane runner) is garbage by construction; they are already
+    silenced by the pad mask, and the modulo is by ``n_eff`` so real
+    replicas' membership is independent of the padded width."""
+    u32 = xp.uint32
+    inst = xp.asarray(inst_ids, dtype=u32)[:, None]
+    rep = xp.arange(cfg.n, dtype=u32)[None, :]
+    word = prf.prf_u32(seed, inst, rnd, t, rep, 0, prf.COMMITTEE, xp=xp,
+                       pack=cfg.pack_version)
+    ne = xp.asarray(cfg.n_eff, dtype=u32)
+    c = xp.asarray(committee_size(cfg.n_eff, xp=xp), dtype=u32)
+    return (word % ne) < c
+
+
+def step_silence(cfg, seed, inst_ids, rnd, t, xp=np):
+    """The (B, n) membership-silence plane the round bodies OR into the
+    step's silent set (spec §10.4: adversary inject → §9 fault silences →
+    membership silence → §5.1b validation → delivery law), or None for
+    every non-committee delivery (the zero-cost fast path)."""
+    if cfg.delivery != "committee":
+        return None
+    return ~membership_plane(cfg, seed, inst_ids, rnd, t, xp=xp)
+
+
+def counts_fn(cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
+              recv_ids=None, xp=np, stats=None, fside=None):
+    """(c0, c1) delivered-value counts per receiver lane — spec §10.2.
+
+    Same hook signature and same class/stratum state (ops/urn.py::lane_setup)
+    as the §4b/§4c samplers. ``silent`` arrives with the membership silence
+    already folded in (spec §10.4), so the class counts ``m`` range over live
+    committee senders only; this function re-derives the drop total from the
+    committee quota k_C (lane_setup's full-mesh D is ignored) and applies the
+    §4c cheap split with the COMMITTEE send=1 word.
+
+    ``stats``, when a dict, receives the sampler's cost counters
+    (obs/counters.py): ``committee_draws`` (B,) — the COMMITTEE Threefry
+    words per step (2·n: one membership word per replica, one drop word per
+    receiver) — and ``committee_members`` (B,) — the realized committee size
+    this step (the per-phase ``committee_size@ph`` schema rows).
+    """
+    u32, i32 = xp.uint32, xp.int32
+    B = silent.shape[0]
+    recv, own_val, m, st, L, _D_full = urn.lane_setup(
+        cfg, seed, inst_ids, rnd, t, values, silent, faulty, honest,
+        recv_ids=recv_ids, xp=xp, fside=fside)
+    # Drop total per spec §10.2: k_C is a value-of-n law (n_eff — traced
+    # under batched lanes).
+    kq = xp.asarray(committee_quota(cfg.n_eff, cfg.f, xp=xp), dtype=i32)
+    D = xp.maximum(L - kq, i32(0)).astype(i32)
+
+    inst = xp.asarray(inst_ids, dtype=u32)[:, None]
+    # Per-receiver drop word (send=1) and the receiver's own membership word
+    # (send=0 — the same coordinates the round body's silence plane drew, so
+    # XLA CSE folds the recompute under jit).
+    u = prf.prf_u32(seed, inst, rnd, t, recv[None, :], 1, prf.COMMITTEE,
+                    xp=xp, pack=cfg.pack_version)
+    wv = prf.prf_u32(seed, inst, rnd, t, recv[None, :], 0, prf.COMMITTEE,
+                     xp=xp, pack=cfg.pack_version)
+    ne_u = xp.asarray(cfg.n_eff, dtype=u32)
+    c_u = xp.asarray(committee_size(cfg.n_eff, xp=xp), dtype=u32)
+    member_v = (wv % ne_u) < c_u                             # (B, R)
+
+    if stats is not None:
+        rm = urn.recv_value_mask(cfg, recv, xp)
+        words = (2 * recv.shape[0] if rm is None
+                 else u32(2) * xp.asarray(cfg.n_eff, dtype=u32))
+        stats["committee_draws"] = xp.full((B,), words, dtype=u32)
+        # Realized committee size: members among *real* replicas (pad-exact
+        # under the batched runner). Recomputed only on counter runs; the
+        # words are the same coordinates as the silence plane's.
+        plane = membership_plane(cfg, seed, inst_ids, rnd, t, xp=xp)
+        real = (xp.arange(cfg.n, dtype=i32)
+                < xp.asarray(cfg.n_eff, dtype=i32))[None, :]
+        stats["committee_members"] = (plane & real).sum(
+            axis=-1, dtype=i32).astype(u32)
+
+    # "superset" (fused lanes) takes the general adaptive structure: its
+    # selected st planes are identically False on non-adaptive lanes,
+    # under which the general draws collapse bit-exactly (see the
+    # st ≡ False notes on the samplers).
+    adaptive = cfg.adversary in ("adaptive", "adaptive_min", "superset")
+    from byzantinerandomizedconsensus_tpu.ops.urn3 import _cheap
+
+    d = [None, None]
+    if adaptive:
+        # Stratum split (deterministic, exactly §4b-v2/§4c): biased absorbs
+        # min(D, L_b) drops. Segments 0-1 = biased, 2-3 = unbiased.
+        z = xp.zeros((1, 1), dtype=i32)
+        mb = [xp.where(st[w], m[w], z).astype(i32) for w in (0, 1, 2)]
+        Lb = (mb[0] + mb[1] + mb[2]).astype(i32)
+        Db = xp.minimum(D, Lb).astype(i32)
+        Lr, Dr = Lb, Db
+        for w in (0, 1):
+            d[w] = _cheap(u, w, mb[w], Lr, Dr, xp)
+            Lr = (Lr - mb[w]).astype(i32)
+            Dr = (Dr - d[w]).astype(i32)
+        mu = [(m[w] - mb[w]).astype(i32) for w in (0, 1)]
+        Lr = (L - Lb).astype(i32)
+        Dr = (D - Db).astype(i32)
+        for w in (0, 1):
+            du = _cheap(u, 2 + w, mu[w], Lr, Dr, xp)
+            d[w] = (d[w] + du).astype(i32)
+            Lr = (Lr - mu[w]).astype(i32)
+            Dr = (Dr - du).astype(i32)
+    else:
+        # Biased stratum statically empty: segment indices 2-3, matching the
+        # §4b-v2/§4c seeding convention so the strata families stay aligned.
+        Lr, Dr = L, D
+        for w in (0, 1):
+            d[w] = _cheap(u, 2 + w, m[w], Lr, Dr, xp)
+            Lr = (Lr - m[w]).astype(i32)
+            Dr = (Dr - d[w]).astype(i32)
+
+    # Own delivery is membership-gated (spec §10.2): a receiver outside the
+    # committee did not broadcast, so it has no own message to deliver.
+    own0 = (member_v & (own_val == 0)).astype(i32)
+    own1 = (member_v & (own_val == 1)).astype(i32)
+    c0 = (m[0] - d[0] + own0).astype(i32)
+    c1 = (m[1] - d[1] + own1).astype(i32)
+    return c0, c1

@@ -32,13 +32,35 @@ V2_MAX_INSTANCES = 1 << 16
 V2_MAX_N = 1 << 12
 V2_MAX_ROUNDS = 1 << 12
 
-# The overall n ceiling any config may request (the v2 law's).
-MAX_N = V2_MAX_N
+# v3 field budget (spec §2 v3, configs with n > 4096): the replica id moves
+# to a 20-bit field so committee-sampled systems reach n = 2^20 (10⁵–10⁶).
+# x0 = send(12) | recv(20), x1 = round(12) | instance(12) | step(4) |
+# purpose(4). The wide field is *recv* — every per-replica draw (INIT_EST,
+# coins, FAULTY_RANK, CRASH_ROUND, fault schedules, URN-family receiver
+# draws, COMMITTEE membership) addresses the replica through recv. The one
+# draw family that addresses a replica through *send* (BYZ_VALUE's
+# per-sender equivocation words) goes through :func:`prf_sender`, which
+# swaps the (tag, sender) operands into (recv=sender, send=tag) under v3 —
+# a pure relabeling of coordinates, bit-identical at pack ≤ 2 where it
+# passes them through unchanged.
+V3_MAX_INSTANCES = 1 << 12
+V3_MAX_N = 1 << 20
+V3_MAX_ROUNDS = 1 << 12
+
+# The overall n ceiling any config may request (the v3 law's). Non-committee
+# delivery families still cap at V2_MAX_N (config.validate): the full-mesh
+# samplers are O(n·f) per replica and the v3 law exists for the committee
+# family (spec §10).
+MAX_N = V3_MAX_N
 
 
 # (send, rnd, recv) bit offsets per packing law — the in-kernel Threefry
 # implementations (ops/pallas_urn.py, ops/pallas_tally.py) build x0/x1 from
-# these so their packing cannot drift from prf_u32's.
+# these so their packing cannot drift from prf_u32's. v3 has NO entry on
+# purpose: its x0/x1 layout is structurally different (recv lives in x0),
+# so the (send, rnd, recv)-offset triple cannot describe it, and the Pallas
+# kernels never run v3 configs (they gate on CommitteeUnsupported /
+# n ≤ V2_MAX_N before compiling).
 PACK_SHIFTS = {1: (17, 16, 6), 2: (19, 20, 8)}
 
 # The two uint32 sub-laws that share the 10-bit-field assumption with the v1
@@ -53,8 +75,13 @@ PACK_SHIFTS = {1: (17, 16, 6), 2: (19, 20, 8)}
 #   faulty-rank key's replica field): v1 reserves the low 10 bits for the
 #   index; v2 reserves 12. KEY_LOW_BITS[pack] = index field width; the §4
 #   combined key's PRF field narrows to fit (20 → 18 bits).
-RED_SHIFTS = {1: (10, 22), 2: (12, 20)}
-KEY_LOW_BITS = {1: 10, 2: 12}
+#   v3 carries the v2 reduction ``d = ((u >> 12)·R) >> 20`` (consumers cache
+#   RED_SHIFTS[pack] unconditionally): the only v3 delivery family
+#   (committee, spec §10) draws nibble words like urn3 and performs no range
+#   reduction, and any future v3 reduction range is bounded by the committee
+#   ceiling (≪ 2^12), never the raw v3 n.
+RED_SHIFTS = {1: (10, 22), 2: (12, 20), 3: (12, 20)}
+KEY_LOW_BITS = {1: 10, 2: 12, 3: 20}
 # Rank mask for the §3.2 faulty-rank key ((rank & KEY_MASK[pack]) | replica):
 # the complement of the KEY_LOW_BITS index field, precomputed so the two
 # Python implementations (models/adversaries.py, core/adversary.py) share one
@@ -65,10 +92,13 @@ KEY_MASK = {p: (0xFFFFFFFF >> low) << low for p, low in KEY_LOW_BITS.items()}
 def pack_version(n) -> int:
     """The packing law a config of size ``n`` uses: the frozen v1 law for
     every n ≤ 1024 (existing draws and goldens must never move), the §2 v2
-    law above it. A pure function of n so that all five stacks (oracle,
-    numpy, jax, Pallas, C++) derive the same gate from the same field."""
+    law for 1024 < n ≤ 4096, the §2 v3 law above that (committee family,
+    spec §10). A pure function of n so that all five stacks (oracle, numpy,
+    jax, Pallas, C++) derive the same gate from the same field."""
+    if n > V3_MAX_N:
+        raise ValueError(f"n={n} exceeds the v3 packing ceiling ({V3_MAX_N})")
     if n > V2_MAX_N:
-        raise ValueError(f"n={n} exceeds the v2 packing ceiling ({V2_MAX_N})")
+        return 3
     return 1 if n <= V1_MAX_N else 2
 
 # Purposes (spec/PROTOCOL.md §2).
@@ -88,6 +118,12 @@ FAULT_HEAL = 11     # recover: outage length − 1, per (instance, replica)
 FAULT_SIDE = 12     # partition: isolated-side bit, per (instance, replica)
 FAULT_EPOCH = 13    # partition: epoch start (recv=0) / heal length (recv=1)
 FAULT_OMIT = 14     # omission: burst gate (send=1) / per-replica bit (send=0)
+# Committee sortition (spec §10): one purpose, sub-addressed through send —
+# send=0 is the per-(instance, round, phase, replica) membership word
+# (member iff word % n < C), send=1 the per-receiver committee drop word
+# feeding the §10 count law. The purpose field is 4 bits; 15 is its last
+# free value.
+COMMITTEE = 15
 
 # Urn-delivery LCG (spec §4b): full period mod 2^32 (A ≡ 1 mod 4, C odd).
 URN_LCG_A = 0x915F77F5
@@ -178,6 +214,9 @@ def prf_u32(seed, instance, rnd, step, recv, send, purpose, xp=np, pack=1):
     v2 (spec §2 v2, configs with n > 1024):
         x0 = (send << 19) | instance
         x1 = (rnd << 20) | (recv << 8) | (step << 4) | purpose
+    v3 (spec §2 v3, configs with n > 4096 — the committee family):
+        x0 = (send << 20) | recv
+        x1 = (rnd << 20) | (instance << 8) | (step << 4) | purpose
     """
     k0, k1 = seed_key(seed)
     u32 = xp.uint32
@@ -191,9 +230,30 @@ def prf_u32(seed, instance, rnd, step, recv, send, purpose, xp=np, pack=1):
     elif pack == 2:
         x0 = (send << u32(19)) | instance
         x1 = (rnd << u32(20)) | (recv << u32(8)) | (u32(int(step) << 4)) | u32(int(purpose))
+    elif pack == 3:
+        x0 = (send << u32(20)) | recv
+        x1 = (rnd << u32(20)) | (instance << u32(8)) | (u32(int(step) << 4)) | u32(int(purpose))
     else:
         raise ValueError(f"unknown packing version {pack!r}")
     return threefry2x32(k0, k1, x0, x1, xp=xp)
+
+
+def prf_sender(seed, instance, rnd, step, tag, sender, purpose, xp=np,
+               pack=1):
+    """A PRF draw addressed by *sender* (spec §2 v3 sender-draw rule).
+
+    The BYZ_VALUE family puts a full replica id in the ``send`` coordinate
+    (one equivocation word per sender) with only a small tag in ``recv``.
+    Under v1/v2 that is the plain draw; under v3 the wide field is recv, so
+    the coordinates swap: (recv=tag, send=sender) becomes
+    (recv=sender, send=tag). Every sender-addressed draw site goes through
+    this helper so the swap cannot drift per call site. Bit-identical to
+    ``prf_u32(..., recv=tag, send=sender, ...)`` at pack ≤ 2.
+    """
+    if pack >= 3:
+        tag, sender = sender, tag
+    return prf_u32(seed, instance, rnd, step, tag, sender, purpose, xp=xp,
+                   pack=pack)
 
 
 def prf_bit(seed, instance, rnd, step, recv, send, purpose, xp=np, pack=1):

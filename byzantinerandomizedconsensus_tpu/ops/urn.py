@@ -29,8 +29,8 @@ def byz_class_values(cfg, seed, inst_ids, rnd, t, honest, faulty, xp=np):
     send = xp.arange(cfg.n, dtype=xp.uint32)[None, :]
     out = []
     for h in (0, 1):
-        e = prf.prf_u32(seed, inst, rnd, t, h, send, prf.BYZ_VALUE, xp=xp,
-                        pack=cfg.pack_version)
+        e = prf.prf_sender(seed, inst, rnd, t, h, send, prf.BYZ_VALUE, xp=xp,
+                           pack=cfg.pack_version)
         vh = (e % xp.uint32(3)).astype(xp.uint8)
         out.append(xp.where(faulty, vh, honest).astype(xp.uint8))
     return out[0], out[1]

@@ -169,10 +169,13 @@ class JaxShardedBackend(JitChunkedBackend):
             raise ValueError(
                 f"n={cfg.n} not divisible by model-axis size {self.mesh.shape[MODEL_AXIS]}"
             )
+        from byzantinerandomizedconsensus_tpu.models.committee import (
+            check_committee_supported)
         from byzantinerandomizedconsensus_tpu.models.faults import (
             check_faults_supported)
 
         check_faults_supported(cfg, "the shard_map mesh")
+        check_committee_supported(cfg, "the shard_map mesh")
 
     def _clamp_chunk(self, cfg: SimConfig, chunk: int) -> int:
         n_data = self.mesh.shape[DATA_AXIS]

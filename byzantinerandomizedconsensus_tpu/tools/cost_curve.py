@@ -21,6 +21,15 @@ correctness evidence attached, not just its timings.
     python -m byzantinerandomizedconsensus_tpu.tools.cost_curve
 
 writes ``artifacts/n2048_r{N}.json``.
+
+Round 19 adds the committee curve (spec §10): ``--committee-r19`` produces
+``artifacts/committee_r19.json`` — the committee family timed on log-spaced
+n through 10⁵–10⁶ (where only committee delivery is admitted; spec §2 v3
+packing) against urn2/urn3 baselines capped at their n=4096 ceiling, with
+per-replica cost + flatness, the committee counter block, the §10 invariant
+checker at n=10⁵, a ConsensusServer end-to-end leg (0 steady-state
+compiles + offline bit-match), and the program-fingerprint census guarding
+the new committee programs.
 """
 
 from __future__ import annotations
@@ -32,7 +41,7 @@ import pathlib
 
 import numpy as np
 
-from byzantinerandomizedconsensus_tpu.config import sweep_point
+from byzantinerandomizedconsensus_tpu.config import committee_point, sweep_point
 from byzantinerandomizedconsensus_tpu.tools.product import run_config
 from byzantinerandomizedconsensus_tpu.utils.rounds import default_artifact
 
@@ -46,13 +55,42 @@ def shape_config(shape: str, n: int, delivery: str, instances: int):
     ``balanced`` is the wire-balance regime where the chains genuinely pay —
     the first real ``K = D`` test at n=2048 (ROADMAP open item #3). Pair it
     with ``--counters`` to read the measured ``chain_trips_max`` directly.
+
+    ``delivery="committee"`` swaps the base point for
+    :func:`~byzantinerandomizedconsensus_tpu.config.committee_point` — the
+    same bracha/adaptive/shared shape at the §10.3 fault fraction f = n/5
+    (the full-mesh optimum (n−1)/3 overruns the committee resilience gate).
     """
-    cfg = sweep_point(n, instances=instances)
+    if delivery == "committee":
+        cfg = committee_point(n, instances=instances)
+    else:
+        cfg = sweep_point(n, instances=instances)
     if shape == "balanced":
         cfg = dataclasses.replace(cfg, adversary="none")
     elif shape != "config5":
         raise ValueError(f"unknown shape {shape!r}")
     return dataclasses.replace(cfg, delivery=delivery)
+
+
+def log_spaced_ns(spec: str) -> list:
+    """``A:B`` → the doubling sequence A, 2A, 4A, … capped at B (B itself
+    is included even when it is not a power-of-two multiple of A), e.g.
+    ``2048:1048576`` → [2048, 4096, …, 1048576]."""
+    try:
+        lo_s, hi_s = spec.split(":")
+        lo, hi = int(lo_s), int(hi_s)
+    except ValueError:
+        raise SystemExit(f"--ns-log wants A:B (e.g. 2048:1048576), "
+                         f"got {spec!r}")
+    if lo < 4 or hi < lo:
+        raise SystemExit(f"--ns-log wants 4 <= A <= B, got {spec!r}")
+    ns = []
+    n = lo
+    while n < hi:
+        ns.append(n)
+        n *= 2
+    ns.append(hi)
+    return ns
 
 
 def _point(n: int, delivery: str, instances: int, backend: str,
@@ -118,17 +156,216 @@ def jax_sharded_leg(delivery: str, instances: int) -> dict:
                 "blocked": repr(e)}
 
 
+def committee_checker_leg(n: int, instances: int) -> dict:
+    """The §10 invariant checker at wide n (models/invariants.py on the
+    numpy stack — host-side, no device memory at n=10⁵)."""
+    from byzantinerandomizedconsensus_tpu.models import invariants
+
+    cfg = committee_point(n, instances=instances)
+    try:
+        out = invariants.check_config(cfg, backend="numpy")
+        return {"n": n, "instances": out["checked_instances"],
+                "ok": not out["violations"],
+                "violations": out["violations"][:4]}
+    except Exception as e:
+        return {"n": n, "instances": instances, "ok": False,
+                "error": repr(e)}
+
+
+def committee_serve_leg(n: int, instances: int, backend: str = "jax") -> dict:
+    """A committee config end-to-end through the serving stack: admit via
+    ConsensusServer, pin 0 steady-state compiles on the repeat submit, and
+    bit-compare the reply's per-instance rounds/decisions against a plain
+    offline ``backend.run`` of the same config (ISSUE 15 acceptance)."""
+    from byzantinerandomizedconsensus_tpu.backends.base import get_backend
+    from byzantinerandomizedconsensus_tpu.serve.server import (
+        DEFAULT_ROUND_CAP_CEILING, ConsensusServer)
+
+    cfg = committee_point(n, instances=instances)
+    # The benchmark point's round_cap is wider than the service ceiling
+    # (admission would 400 it); the serving pin is about shapes, not caps.
+    cfg = dataclasses.replace(
+        cfg, round_cap=min(cfg.round_cap, DEFAULT_ROUND_CAP_CEILING)
+    ).validate()
+    try:
+        with ConsensusServer(backend=backend) as srv:
+            # Same-bucket warm burst (tools/loadgen.py warm_up discipline):
+            # sequential submits exercise every program of the bucket —
+            # init + segment + refill, and the drain the first grid-close
+            # compiles — before the measured window opens.
+            for i in range(4):
+                srv.submit(dataclasses.replace(
+                    cfg, seed=1000 + i)).wait(timeout=600)
+            warm = srv.compile_count()
+            rec = srv.submit(cfg).wait(timeout=600)
+            steady = srv.compile_count() - warm
+        off = get_backend(backend).run(cfg)
+        match = (rec["rounds"] == [int(r) for r in off.rounds]
+                 and rec["decision"] == [int(d) for d in off.decision])
+        return {"n": n, "instances": instances,
+                "steady_state_compiles": int(steady),
+                "offline_bitmatch": bool(match)}
+    except Exception as e:
+        return {"n": n, "instances": instances, "blocked": repr(e)}
+
+
+def committee_r19(args) -> int:
+    """The round-19 headline artifact: committee per-replica cost flat-ish
+    on log-spaced n through 10⁵⁺ where the urn2/urn3 baselines (capped at
+    their v2 n=4096 ceiling) scale linearly."""
+    from byzantinerandomizedconsensus_tpu.utils.devices import ensure_live_backend
+
+    ensure_live_backend()
+    import jax
+
+    from byzantinerandomizedconsensus_tpu.config import COMMITTEE_FAULT_DIV
+    from byzantinerandomizedconsensus_tpu.obs import programs, record
+    from byzantinerandomizedconsensus_tpu.ops.committee import (
+        committee_fault_budget, committee_size)
+
+    # Fingerprint census over the committee programs this run compiles —
+    # the artifact's guard that the new-program set is what we shipped.
+    programs.configure()
+
+    ns = args.ns
+    base_ns = [n for n in ns if n <= 4096]
+
+    def inst_at(n: int) -> int:
+        # Constant total replica-instance budget: instances shrink as n
+        # grows so every point costs about the same wall (per-replica cost
+        # divides the budget back out).
+        return max(4, (args.committee_instances * ns[0]) // n)
+
+    legs = []
+    per_rep: dict = {}
+    counters_by_n: dict = {}
+    for d in ["committee"] + [x for x in args.deliveries if x != "committee"]:
+        curve_ns = ns if d == "committee" else base_ns
+        for n in curve_ns:
+            want_counters = (d == "committee"
+                             and n in (curve_ns[0], curve_ns[-1]))
+            e = _point(n, d, inst_at(n), args.backend, shape=args.shape,
+                       counters=want_counters)
+            e["instances"] = inst_at(n)
+            # per-replica cost: best wall divided over every simulated
+            # replica (instances × n) — the flat-vs-linear axis.
+            cost = e["_wall_raw"] / (inst_at(n) * n)
+            per_rep.setdefault(d, {})[str(n)] = cost
+            if want_counters and isinstance(e.get("counters"), dict):
+                counters_by_n[str(n)] = e.pop("counters")
+            print(json.dumps({"delivery": d, "n": n,
+                              "per_replica_cost_us":
+                              round(cost * 1e6, 4)}), flush=True)
+            legs.append(e)
+
+    def flat_ratio(m: dict):
+        ks = sorted(m, key=int)
+        if len(ks) < 2 or m[ks[0]] <= 0:
+            return None
+        return round(m[ks[-1]] / m[ks[0]], 3)
+
+    flatness = {d: flat_ratio(per_rep[d]) for d in per_rep}
+    # n grows by this factor across each measured range; a flat per-replica
+    # curve has ratio ≈ 1 over n_span_committee while a linear one tracks
+    # n_span_baseline.
+    flatness["n_span_committee"] = (ns[-1] // ns[0]) if ns else None
+    flatness["n_span_baseline"] = ((base_ns[-1] // base_ns[0])
+                                   if len(base_ns) >= 2 else None)
+
+    checker = committee_checker_leg(args.checker_n, args.checker_instances)
+    serve = committee_serve_leg(args.serve_n, args.serve_instances,
+                                backend=args.backend
+                                if args.backend.startswith("jax") else "jax")
+
+    for leg in legs:
+        leg.pop("_wall_raw", None)
+        if leg["n"] != max(ns):
+            leg.pop("round_histogram", None)
+
+    stats = {
+        "ns": list(ns),
+        "committee_sizes": {str(n): committee_size(n) for n in ns},
+        "fault_budgets": {str(n): committee_fault_budget(
+            n, n // COMMITTEE_FAULT_DIV) for n in ns},
+        "per_replica_cost": {d: {k: round(v, 9) for k, v in m.items()}
+                             for d, m in per_rep.items()},
+        "flatness": flatness,
+        "checker_n": checker["n"],
+        "checker_ok": bool(checker["ok"]),
+        "fault_div": COMMITTEE_FAULT_DIV,
+        "instances": {str(n): inst_at(n) for n in ns},
+        "baseline": {"ns": base_ns,
+                     "deliveries": [x for x in args.deliveries
+                                    if x != "committee"]},
+        "serve": serve,
+        "counters": counters_by_n,
+    }
+    doc = {
+        **record.new_record("committee_cost_curve"),
+        "description": "committee cost curve past the v2 packing edge "
+                       "(spec §2 v3 + §10): per-replica cost on log-spaced "
+                       "n through 10⁵⁺ vs urn2/urn3 baselines at their "
+                       "n=4096 ceiling, with the §10 checker at wide n, "
+                       "the serving end-to-end leg, and the program "
+                       "fingerprint census (tools/cost_curve.py "
+                       "--committee-r19)",
+        "platform": jax.default_backend(),
+        "backend": args.backend,
+        "shape": args.shape,
+        "legs": legs,
+        "committee": record.committee_block(stats),
+        "checker": checker,
+    }
+    pb = record.programs_block()
+    if pb is not None:
+        doc["programs"] = pb
+    cc = record.compile_cache_block(args.backend)
+    if cc is not None:
+        doc["compile_cache"] = cc
+    problems = record.validate_record(doc)
+    if problems:
+        raise SystemExit(f"committee_r19 record failed validation: "
+                         f"{problems}")
+    out = pathlib.Path(args.out or "artifacts/committee_r19.json")
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(doc, indent=1) + "\n")
+    print(json.dumps({"out": str(out), "flatness": flatness,
+                      "checker_ok": stats["checker_ok"],
+                      "serve": serve}))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--out", default=default_artifact("n2048"))
+    ap.add_argument("--out", default=None)
     ap.add_argument("--backend", default="jax")
     ap.add_argument("--ns", nargs="*", type=int, default=[512, 1024, 2048])
+    ap.add_argument("--ns-log", default=None, metavar="A:B",
+                    help="log-spaced shorthand for --ns: powers of two "
+                         "from A through B inclusive (e.g. 2048:1048576)")
     ap.add_argument("--deliveries", nargs="*", default=["urn2", "urn3"])
+    ap.add_argument("--committee-r19", action="store_true",
+                    help="produce the round-19 committee artifact "
+                         "(artifacts/committee_r19.json): committee legs "
+                         "on the --ns curve, urn2/urn3 baselines capped "
+                         "at n=4096, per-replica cost + flatness, the "
+                         "§10 checker at --checker-n, the serving "
+                         "end-to-end leg, and the program census")
+    ap.add_argument("--committee-instances", type=int, default=512,
+                    help="committee-curve instance budget at the smallest "
+                         "n; larger n get proportionally fewer instances "
+                         "(constant replica-instance budget per point)")
+    ap.add_argument("--checker-n", type=int, default=100_000,
+                    help="n for the §10 invariant-checker leg")
+    ap.add_argument("--checker-instances", type=int, default=2)
+    ap.add_argument("--serve-n", type=int, default=8192,
+                    help="n for the ConsensusServer end-to-end leg")
+    ap.add_argument("--serve-instances", type=int, default=32)
     ap.add_argument("--instances", type=int, default=2000,
                     help="instances per timed point (config-5's sweep count)")
     ap.add_argument("--bitmatch-instances", type=int, default=8)
     ap.add_argument("--shape", choices=["config5", "balanced"],
-                    default="config5",
+                    default=None,
                     help="config5 = the adaptive sweep shape (chains "
                          "deterministic, K≈0); balanced = the config-4 analog "
                          "(bracha, no adversary, shared coin) where the "
@@ -145,6 +382,27 @@ def main(argv=None) -> int:
                          "defaults. Points then carry the schema-v1.2 "
                          "compaction block")
     args = ap.parse_args(argv)
+
+    if args.ns_log is not None:
+        args.ns = log_spaced_ns(args.ns_log)
+    if args.committee_r19:
+        # The r19 contrast shape: balanced wires are where the §4b-v2
+        # chains genuinely pay K = D ∝ n (linear per-replica cost) while
+        # the committee drop law's D is bounded by C (flat); on config5
+        # the chains sit at K ≈ 0 and the baselines measure flat too.
+        if args.shape is None:
+            args.shape = "balanced"
+        if args.ns_log is None and args.ns == [512, 1024, 2048]:
+            # The r19 default curve: log-spaced from the committee gate's
+            # far side through 10⁵⁺ (spec §2 v3 admits n up to 2^20; the
+            # default stops at 2^17 so a CPU session finishes in minutes —
+            # --ns-log 2048:1048576 walks the full ceiling).
+            args.ns = log_spaced_ns("2048:131072")
+        return committee_r19(args)
+    if args.shape is None:
+        args.shape = "config5"
+    if args.out is None:
+        args.out = default_artifact("n2048")
 
     if args.compaction is not None:
         if args.backend != "jax":

@@ -362,6 +362,39 @@ def _hostile_rows_of(name: str, doc) -> list:
     return rows
 
 
+def _committee_rows_of(name: str, doc) -> list:
+    """Schema-v1.10 ``committee`` blocks of one artifact: (path, n span,
+    committee-size ceiling, per-replica flatness ratios vs the full-mesh
+    baselines, §10 invariant-checker verdict, serve-leg compiles) rows —
+    the ledger's committee cost-curve columns."""
+    from byzantinerandomizedconsensus_tpu.obs import record as _record
+
+    rows = []
+    for path, cb in _blocks_of(doc, "committee", _record.COMMITTEE_BLOCK_KEYS):
+        ns = cb.get("ns") if isinstance(cb.get("ns"), list) else []
+        sizes = cb.get("committee_sizes")
+        sizes = sizes if isinstance(sizes, dict) else {}
+        flat = cb.get("flatness")
+        flat = flat if isinstance(flat, dict) else {}
+        serve = cb.get("serve") if isinstance(cb.get("serve"), dict) else {}
+        rows.append({
+            "artifact": name,
+            "path": path,
+            "points": len(ns),
+            "n_max": max(ns) if ns else None,
+            "c_max": max(sizes.values()) if sizes else None,
+            "flat_committee": flat.get("committee"),
+            "flat_urn2": flat.get("urn2"),
+            "flat_urn3": flat.get("urn3"),
+            "n_span_committee": flat.get("n_span_committee"),
+            "checker_n": cb.get("checker_n"),
+            "checker_ok": cb.get("checker_ok"),
+            "serve_steady_state_compiles": serve.get("steady_state_compiles"),
+            "serve_offline_bitmatch": serve.get("offline_bitmatch"),
+        })
+    return rows
+
+
 def sentinel_verdict(bench: dict, wall_chain: list,
                      programs_rows: list) -> dict:
     """The ``--check`` verdict: wall-chain regressions past
@@ -603,6 +636,12 @@ def build_ledger(root=None) -> dict:
     for name, doc in sorted(docs.items()):
         hostile_rows.extend(_hostile_rows_of(name, doc))
 
+    # ---- committee cost-curve columns (schema v1.10, round 19): every
+    # committed artifact carrying a §10 committee block.
+    committee_rows = []
+    for name, doc in sorted(docs.items()):
+        committee_rows.extend(_committee_rows_of(name, doc))
+
     from byzantinerandomizedconsensus_tpu.obs import record
 
     return {
@@ -621,6 +660,7 @@ def build_ledger(root=None) -> dict:
         "metrics_rows": metrics_rows,
         "hunt_rows": hunt_rows,
         "hostile_rows": hostile_rows,
+        "committee_rows": committee_rows,
         "bench_rounds": {str(r): bench[r] for r in rounds_seen},
         "wall_chain": chain,
         "device_chain": device_chain,
@@ -782,6 +822,23 @@ def format_report(doc: dict) -> str:
                 f"fairness {fair_s}, "
                 f"{row['mismatches']} mismatches, "
                 f"{row['steady_state_compiles']} steady-state compiles")
+    # Present only once an artifact carries the v1.10 committee block.
+    if doc.get("committee_rows"):
+        lines.append("committee cost-curve columns (schema v1.10 — "
+                     "artifact[path]: points/n-max C-max "
+                     "flatness(committee|urn2|urn3) checker serve):")
+        for row in doc["committee_rows"]:
+            chk = row["checker_ok"]
+            chk_s = "n/a" if chk is None else ("OK" if chk else "FAIL")
+            lines.append(
+                f"  {row['artifact']}[{row['path']}]: "
+                f"{row['points']} points to n={row['n_max']}, "
+                f"C<= {row['c_max']}, flat x{row['flat_committee']} over "
+                f"{row['n_span_committee']}x n "
+                f"(urn2 x{row['flat_urn2']}, urn3 x{row['flat_urn3']}), "
+                f"checker n={row['checker_n']} {chk_s}, "
+                f"serve {row['serve_steady_state_compiles']} steady-state "
+                f"compiles, offline bitmatch {row['serve_offline_bitmatch']}")
     sent = doc.get("sentinel")
     if sent is not None:
         lines.append(

@@ -27,7 +27,9 @@ from byzantinerandomizedconsensus_tpu.config import (
 # population is reproducible only by (generator_version, seed) together —
 # plus the chaos flag: chaos appends fault-axis draws *after* the legacy
 # sequence, so non-chaos populations are unchanged since v1.
-GENERATOR_VERSION = 1
+# v2: DELIVERY_KINDS gained "committee" (spec §10) — the delivery choice
+# draws over a 5-element domain, which moves every population after it.
+GENERATOR_VERSION = 2
 
 MAX_SOAK_N = 40
 

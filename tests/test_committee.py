@@ -1,0 +1,308 @@
+"""Committee-sampled delivery (spec §10, delivery="committee"): the integer
+committee laws (C, f_C, k_C) pinned and cross-checked python-int vs traced,
+bit-match across the three stacks with a committee channel (cpu oracle,
+numpy, jax), the counters schema rows, batched/fused lanes, and the honest
+``CommitteeUnsupported`` gates on the stacks without a channel.
+
+Unlike the full-mesh families, the committee family *changes which (n, f)
+the thresholds see* — so the cross-stack bar is bit-identity within
+delivery="committee", plus law-level pins for the sortition margin the
+resilience gates enforce.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from byzantinerandomizedconsensus_tpu import SimConfig, Simulator
+from byzantinerandomizedconsensus_tpu.backends import get_backend
+from byzantinerandomizedconsensus_tpu.models.committee import (
+    CommitteeUnsupported, check_committee_supported, quorum_params)
+from byzantinerandomizedconsensus_tpu.ops import committee as cm
+
+
+def _eq(a, b):
+    return (np.array_equal(a.rounds, b.rounds)
+            and np.array_equal(a.decision, b.decision))
+
+
+# ---------------------------------------------------------------------------
+# the §10.1/§10.3 integer laws
+
+
+def test_committee_law_pins():
+    """C(n) = min(n, max(16, 8·⌈log₂ n⌉)) and the f_C/k_C laws at the
+    values the spec and the round-19 artifact quote."""
+    # Degenerate zone: C == n through n = 48 (the full-mesh fold).
+    assert cm.committee_size(4) == 4
+    assert cm.committee_size(16) == 16
+    assert cm.committee_size(40) == 40
+    assert cm.committee_size(48) == 48
+    # First genuine sortition at n = 49 (8·⌈log₂ 49⌉ = 48 < 49).
+    assert cm.committee_size(49) == 48
+    assert cm.committee_size(64) == 48
+    assert cm.committee_size(2048) == 88
+    assert cm.committee_size(100_000) == 136
+    assert cm.committee_size(1 << 20) == 160
+    # f_C: exactly f in the degenerate zone, ⌈C·f/n⌉ + ⌊√C⌋ past it.
+    assert cm.committee_fault_budget(40, 7) == 7
+    assert cm.committee_fault_budget(64, 4) == 3 + 6      # ⌈48·4/64⌉ + ⌊√48⌋
+    assert cm.committee_fault_budget(100_000, 20_000) == 28 + 11
+    assert cm.committee_quota(64, 4) == 48 - 9 - 1
+    assert cm.committee_quota(40, 7) == 40 - 7 - 1        # §4b's n − f − 1
+
+
+@pytest.mark.parametrize("n,f", [
+    (16, 5), (49, 8), (64, 4), (2048, 200), (100_000, 20_000),
+    (1 << 20, 100_000)])
+def test_committee_laws_python_numpy_jax_agree(n, f):
+    """The compare-sum forms are exact for python ints AND traced int32
+    scalars — the batched-lane contract (ops/committee.py docstring)."""
+    import jax
+    import jax.numpy as jnp
+
+    py = (cm.committee_size(n), cm.committee_fault_budget(n, f),
+          cm.committee_quota(n, f))
+    np_v = tuple(int(v) for v in (
+        cm.committee_size(n, xp=np), cm.committee_fault_budget(n, f, xp=np),
+        cm.committee_quota(n, f, xp=np)))
+
+    @jax.jit
+    def laws(a, b):
+        return (cm.committee_size(a, xp=jnp),
+                cm.committee_fault_budget(a, b, xp=jnp),
+                cm.committee_quota(a, b, xp=jnp))
+
+    traced = tuple(int(v) for v in laws(jnp.int32(n), jnp.int32(f)))
+    assert py == np_v == traced
+
+
+def test_membership_plane_matches_spec_law():
+    """Sortition is a pure function of coordinates: replica u is a member
+    iff prf(..., recv=u, send=0, COMMITTEE) % n < C (spec §10.1)."""
+    from byzantinerandomizedconsensus_tpu.ops import prf
+
+    cfg = SimConfig(protocol="bracha", n=64, f=10, instances=4,
+                    adversary="byzantine", coin="shared", seed=11,
+                    round_cap=48, delivery="committee").validate()
+    inst = np.arange(3, dtype=np.uint32)
+    plane = cm.membership_plane(cfg, cfg.seed, inst, 5, 1, xp=np)
+    rep = np.arange(64, dtype=np.uint32)
+    word = prf.prf_u32(cfg.seed, inst[:, None], 5, 1, rep[None, :], 0,
+                       prf.COMMITTEE, xp=np, pack=cfg.pack_version)
+    np.testing.assert_array_equal(plane, (word % np.uint32(64)) < 48)
+    # Realized sizes concentrate around C = 48 (Bernoulli(C/n), σ < √C/2).
+    sizes = plane.sum(axis=-1)
+    assert np.all(sizes > 48 - 16) and np.all(sizes < 48 + 16)
+
+
+def test_quorum_params_seam():
+    """Non-committee deliveries get (n_eff, f) back as the identical
+    objects (no compiled program moves); the committee family gets the
+    static (C, f_C)."""
+    full = SimConfig(protocol="bracha", n=64, f=10, instances=4,
+                     adversary="byzantine", delivery="urn2").validate()
+    n, f = quorum_params(full)
+    assert n is full.n_eff and f is full.f
+    comm = dataclasses.replace(full, delivery="committee").validate()
+    assert quorum_params(comm) == (48, 14)
+    # step_silence: the zero-cost fast path for every non-committee law.
+    assert cm.step_silence(full, full.seed, np.arange(2, dtype=np.uint32),
+                           0, 0, xp=np) is None
+
+
+# ---------------------------------------------------------------------------
+# resilience gates (spec §10.3) and the no-channel gates
+
+
+def test_committee_resilience_gates():
+    """The committee thresholds need the sortition margin: bracha 3·f_C < C,
+    benor+lying 5·f_C < C, benor benign 2·f_C < C — each rejected with a
+    message naming the violated bound (config.validate)."""
+    def c(protocol, f, adversary):
+        return SimConfig(protocol=protocol, n=64, f=f, instances=4,
+                         adversary=adversary, delivery="committee")
+
+    # f = 13 → f_C = 16, 3·16 = 48 ≮ 48 (the full-mesh bound 3·13 < 64 would
+    # have passed — the committee gate is the binding one).
+    with pytest.raises(ValueError, match="committee resilience: bracha requires"):
+        c("bracha", 13, "byzantine").validate()
+    c("bracha", 12, "byzantine").validate()     # f_C = 15, 45 < 48: boundary
+    with pytest.raises(ValueError,
+                       match=r"committee resilience: benor\+byzantine requires"):
+        c("benor", 5, "byzantine").validate()   # f_C = 10, 50 ≥ 48
+    c("benor", 4, "byzantine").validate()       # f_C = 9, 45 < 48
+    with pytest.raises(ValueError, match="committee resilience: benor requires"):
+        c("benor", 23, "crash").validate()      # f_C = 24, 48 ≮ 48
+    c("benor", 22, "crash").validate()
+
+
+def test_committee_gate_message_verbatim():
+    cfg = SimConfig(protocol="benor", n=49, f=2, instances=4,
+                    adversary="crash", delivery="committee").validate()
+    with pytest.raises(CommitteeUnsupported) as ei:
+        check_committee_supported(cfg, "the shard_map mesh")
+    assert str(ei.value) == (
+        "the shard_map mesh has no committee channel; "
+        "delivery='committee' runs on the cpu|numpy|jax stacks")
+    # Every other delivery passes through untouched.
+    assert check_committee_supported(
+        dataclasses.replace(cfg, delivery="urn3"), "anything") is None
+
+
+def test_committee_unsupported_backends_degrade_cleanly():
+    """The stacks without a committee channel refuse loudly before any
+    compile — mirroring the FaultsUnsupported gates."""
+    cfg = SimConfig(protocol="bracha", n=64, f=10, instances=4,
+                    adversary="byzantine", delivery="committee").validate()
+    with pytest.raises(CommitteeUnsupported, match="the native core"):
+        get_backend("native").run(cfg)
+    with pytest.raises(CommitteeUnsupported, match="kernel='pallas'"):
+        get_backend("jax_pallas").run(cfg)
+    with pytest.raises(CommitteeUnsupported, match="the shard_map mesh"):
+        get_backend("jax_sharded").run(cfg)
+
+
+# ---------------------------------------------------------------------------
+# bit-match: oracle / numpy / jax
+
+COMMITTEE_SMALL = [
+    SimConfig(protocol="benor", n=16, f=2, instances=12, adversary="none",
+              coin="local", round_cap=64, seed=0, delivery="committee"),
+    SimConfig(protocol="benor", n=49, f=6, instances=4, adversary="crash",
+              coin="local", round_cap=64, seed=1, delivery="committee"),
+    SimConfig(protocol="benor", n=64, f=4, instances=3, adversary="byzantine",
+              coin="local", round_cap=48, seed=2, delivery="committee"),
+    SimConfig(protocol="benor", n=50, f=2, instances=6, adversary="adaptive",
+              coin="shared", round_cap=48, seed=3, delivery="committee"),
+    SimConfig(protocol="bracha", n=64, f=10, instances=6,
+              adversary="byzantine", coin="shared", round_cap=48, seed=4,
+              delivery="committee"),
+    SimConfig(protocol="bracha", n=96, f=12, instances=4,
+              adversary="adaptive", coin="shared", round_cap=48, seed=5,
+              delivery="committee"),
+    SimConfig(protocol="bracha", n=48, f=5, instances=6,
+              adversary="adaptive_min", coin="shared", round_cap=48, seed=6,
+              delivery="committee"),
+]
+
+
+@pytest.mark.parametrize(
+    "cfg", COMMITTEE_SMALL,
+    ids=lambda c: f"{c.protocol}-n{c.n}f{c.f}-{c.adversary}-{c.coin}")
+def test_committee_bitmatch_small(cfg):
+    """Oracle / numpy / jax derive identical committees, drops, and
+    decisions — the acceptance bar every delivery family carries. The grid
+    spans the degenerate fold (C = n at 16/48), the first genuine sortition
+    shapes (49/50/64), and a v1-packed n = 96."""
+    ref = Simulator(cfg, "cpu").run()
+    for backend in ("numpy", "jax"):
+        got = Simulator(cfg, backend).run()
+        np.testing.assert_array_equal(ref.rounds, got.rounds,
+                                      err_msg=f"rounds {backend}")
+        np.testing.assert_array_equal(ref.decision, got.decision,
+                                      err_msg=f"decision {backend}")
+
+
+def test_committee_agreement_and_validity():
+    cfg = COMMITTEE_SMALL[4]
+    res = Simulator(cfg, "numpy").run()
+    assert set(np.unique(res.decision)) <= {0, 1, 2}
+    for init, expect in (("all0", 0), ("all1", 1)):
+        c = dataclasses.replace(cfg, init=init, instances=16)
+        r = Simulator(c, "numpy").run()
+        decided = r.decision != 2
+        assert np.all(r.decision[decided] == expect), f"validity broken for {init}"
+
+
+# ---------------------------------------------------------------------------
+# counters (schema v3 rows: committee_draws, committee_size@ph)
+
+
+def test_committee_counters_cross_stack():
+    """numpy and jax totals identical; the oracle's independent counts agree
+    on the common subset; the sampler rows obey their closed-form laws."""
+    from byzantinerandomizedconsensus_tpu.obs import counters as obs_counters
+
+    cfg = SimConfig(protocol="bracha", n=64, f=10, instances=4,
+                    adversary="byzantine", coin="shared", round_cap=48,
+                    seed=4, delivery="committee").validate()
+    nb, jb, cb = get_backend("numpy"), get_backend("jax"), get_backend("cpu")
+    base = nb.run(cfg)
+    res_n, doc_n = nb.run_with_counters(cfg)
+    assert _eq(base, res_n), "counters moved the committee results"
+    res_j, doc_j = jb.run_with_counters(cfg)
+    assert doc_n["totals"] == doc_j["totals"]
+    assert doc_n["schema"] == obs_counters.COUNTER_SCHEMA_VERSION
+
+    t = doc_n["totals"]
+    # §10 word law: 2·n COMMITTEE words per receiver-step (one membership
+    # word per replica, one drop word per receiver), 3 steps per bracha round.
+    assert t["committee_draws"] == 2 * cfg.n * 3 * t["rounds_active"]
+    # Realized committee size per phase: mean over steps concentrates at C.
+    size_keys = [k for k in t if k.startswith("committee_size@")]
+    assert len(size_keys) == 3
+    mean_c = sum(t[k] for k in size_keys) / (3 * t["rounds_active"])
+    assert abs(mean_c - 48) < 6
+
+    res_c, doc_c = cb.run_with_counters(cfg)
+    assert _eq(res_n, res_c)
+    common = {k: v for k, v in t.items() if k in doc_c["totals"]}
+    assert common == doc_c["totals"]
+
+
+# ---------------------------------------------------------------------------
+# batched and fused lanes
+
+
+def test_committee_batch_lanes_bitmatch():
+    """Mixed-n committee lanes in one padded bucket vs the per-config jax
+    path: the traced-n_eff committee laws must not shift a single draw."""
+    jb = get_backend("jax")
+    cfgs = [
+        SimConfig(protocol="benor", n=64, f=4, instances=5,
+                  adversary="byzantine", coin="local", round_cap=48, seed=1,
+                  delivery="committee").validate(),
+        SimConfig(protocol="benor", n=50, f=3, instances=4,
+                  adversary="byzantine", coin="local", round_cap=48, seed=2,
+                  delivery="committee").validate(),
+        SimConfig(protocol="benor", n=49, f=2, instances=4,
+                  adversary="byzantine", coin="local", round_cap=48, seed=3,
+                  delivery="committee").validate(),
+    ]
+    for cfg, res in zip(cfgs, jb.run_batch(cfgs)):
+        ref = jb.run(cfg)
+        np.testing.assert_array_equal(ref.rounds, res.rounds)
+        np.testing.assert_array_equal(ref.decision, res.decision)
+
+
+def test_committee_fused_lanes_and_bucket_label():
+    """The hunt-facing fused tier hosts committee lanes (the bucket
+    universe's 10th cell) and the bucket key carries C(n_pad)."""
+    from byzantinerandomizedconsensus_tpu.backends.batch import FusedBucket
+
+    jb, nb = get_backend("jax"), get_backend("numpy")
+    cfgs = [
+        SimConfig(protocol="benor", n=7, f=1, instances=6, adversary="crash",
+                  coin="local", round_cap=32, seed=1,
+                  delivery="committee").validate(),
+        SimConfig(protocol="benor", n=12, f=2, instances=5,
+                  adversary="byzantine", coin="shared", round_cap=48, seed=2,
+                  delivery="committee").validate(),
+        SimConfig(protocol="benor", n=9, f=2, instances=6, adversary="none",
+                  coin="local", round_cap=32, seed=3, init="split",
+                  delivery="committee").validate(),
+    ]
+    results, report = jb.run_fused(cfgs)
+    assert report["mode"] == "fused"
+    for cfg, res in zip(cfgs, results):
+        ref = nb.run(cfg)
+        np.testing.assert_array_equal(ref.rounds, res.rounds)
+        np.testing.assert_array_equal(ref.decision, res.decision)
+
+    b = FusedBucket.of(cfgs[0])
+    assert b.committee_c == cm.committee_size(b.n_pad)
+    assert b.label().endswith(f"/C{b.committee_c}")
+    plain = FusedBucket.of(dataclasses.replace(cfgs[0], delivery="urn3"))
+    assert plain.committee_c == 0 and "/C" not in plain.label()

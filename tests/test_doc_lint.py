@@ -59,7 +59,7 @@ def test_span_kind_census_is_nontrivial_and_complete():
                      "hunt.done", "serve.backpressure", "serve.cancel",
                      "serve.rotate", "compaction.cancel"):
         assert expected in kinds, (expected, sorted(kinds))
-    assert len(kinds) >= 42
+    assert len(kinds) >= 48
 
 
 def test_every_emitted_span_kind_is_documented():
@@ -131,7 +131,7 @@ def test_metric_name_census_is_nontrivial_and_complete():
                      "brc_serve_deadline_met_total",
                      "brc_serve_deadline_missed_total"):
         assert expected in names, (expected, sorted(names))
-    assert len(names) >= 42
+    assert len(names) >= 44
 
 
 def test_every_registered_metric_is_documented():
@@ -164,6 +164,7 @@ def test_every_record_block_key_is_documented():
         "metrics": record.METRICS_BLOCK_KEYS,
         "hunt": record.HUNT_BLOCK_KEYS,
         "hostile": record.HOSTILE_BLOCK_KEYS,
+        "committee": record.COMMITTEE_BLOCK_KEYS,
         "counters": ("supported", "totals"),
     }
     missing = []

@@ -66,12 +66,13 @@ def test_space_candidates_are_admissible_everywhere():
 
 def test_space_bucket_universe_is_complete_and_tiny():
     """n ≤ 40 folds everything to one tier: the whole compiled-program
-    universe is 2 protocols × 4 deliveries — what makes a complete warm-up
-    (and hence the 0-steady-state-recompile pin) possible."""
+    universe is 2 protocols × 5 deliveries (committee joined in round 19) —
+    what makes a complete warm-up (and hence the 0-steady-state-recompile
+    pin) possible."""
     sp = SearchSpace()
     buckets = sp.buckets()
-    assert len(buckets) == 8
-    assert len(set(buckets)) == 8
+    assert len(buckets) == 10
+    assert len(set(buckets)) == 10
     rng = random.Random(7)
     for _ in range(60):
         assert FusedBucket.of(sp.sample(rng)) in buckets
@@ -138,6 +139,34 @@ def test_bandit_halves_regions():
         st.tell(cfg, _fake_fitness(cfg))
     assert len(st._active) == max(1, n0 // 2)
     assert st._rung == 1
+
+
+def test_cma_adapts_and_stays_deterministic():
+    """The round-19 continuous strategy: generations close every λ tells,
+    the latent mean/step-sizes move off their initial point, categorical
+    tables stay normalized with the exploration floor, and the whole
+    trajectory (including the internal state) is a pure function of
+    (strategy, seed) + tell sequence."""
+    def run(seed):
+        st = make_strategy("cma", SearchSpace(), seed)
+        for _ in range(3 * st.LAMBDA):
+            cfg = st.ask()
+            st.tell(cfg, _fake_fitness(cfg))
+        return st
+
+    a, b = run(5), run(5)
+    assert a.generation == 3
+    assert a.doc() == b.doc()
+    assert a._mean == b._mean and a._sigma == b._sigma
+    assert a._tables == b._tables
+    # adaptation actually happened: some axis moved off the init point
+    assert a._mean != [0.5] * len(a.AXES) or \
+        a._sigma != [a.SIGMA0] * len(a.AXES)
+    for axis, probs in a._tables.items():
+        assert abs(sum(probs) - 1.0) < 1e-9
+        assert min(probs) >= a.CAT_FLOOR - 1e-9
+    # in-flight pipelined asks don't leak: pending drains as tells arrive
+    assert not a._pending
 
 
 def test_unknown_strategy_rejected():
@@ -260,6 +289,7 @@ def server():
     srv.shutdown(drain=True)
 
 
+@pytest.mark.slow
 def test_mini_hunt_smoke_pipelined(server):
     """A seeded in-process mini-hunt over the real serving stack: budget
     harvested exactly, all archive entries admissible, elite fitness

@@ -593,3 +593,44 @@ def test_census_includes_hostile_artifact():
     report = ledger.format_report(doc)
     assert "hostile-traffic columns" in report
     assert "overflow rejections" in report
+
+
+def test_census_includes_committee_artifact():
+    """The round-19 committee cost-curve artifact: parsed with zero errors,
+    the flat-vs-linear headline on the record (committee per-replica ratio
+    near 1 over a 64x n span while urn2 grows), the n=10^5 invariant-checker
+    verdict green, the serve leg at 0 steady-state compiles with the offline
+    differential bit-identical, and the schema-v1.10 committee columns
+    reconstructed by the ledger."""
+    import pathlib
+
+    from byzantinerandomizedconsensus_tpu.utils.rounds import repo_root
+
+    doc = ledger.build_ledger()
+    assert doc["parse_errors"] == []
+    rows = {r["artifact"]: r for r in doc["committee_rows"]}
+    assert "artifacts/committee_r19.json" in rows, \
+        "committee_r19.json must yield committee cost-curve columns"
+    row = rows["artifacts/committee_r19.json"]
+    assert row["n_max"] >= 100_000           # past the 4096 full-mesh ceiling
+    assert row["n_span_committee"] >= 32     # a wide span, not two points
+    assert row["flat_committee"] < 1.3       # per-replica cost flat-ish in n
+    assert row["flat_urn2"] > 1.5            # the full-mesh family is linear
+    assert row["checker_n"] >= 100_000 and row["checker_ok"] is True
+    assert row["serve_steady_state_compiles"] == 0
+    assert row["serve_offline_bitmatch"] is True
+
+    cv = json.loads((pathlib.Path(repo_root())
+                     / "artifacts/committee_r19.json").read_text())
+    assert cv["kind"] == "committee_cost_curve"
+    assert record.validate_record(cv) == []
+    assert cv["record_revision"] >= 10  # schema v1.10
+    cb = cv["committee"]
+    # C(n) on the record matches the spec-§10.1 law at every measured n.
+    from byzantinerandomizedconsensus_tpu.ops.committee import committee_size
+    assert {int(k): v for k, v in cb["committee_sizes"].items()} == {
+        n: committee_size(n) for n in cb["ns"]}
+
+    report = ledger.format_report(doc)
+    assert "committee cost-curve columns" in report
+    assert "offline bitmatch True" in report

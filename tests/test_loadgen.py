@@ -8,6 +8,8 @@ results bit-identical to the offline batched path over the same configs.
 
 import dataclasses
 
+import pytest
+
 from byzantinerandomizedconsensus_tpu.backends.base import get_backend
 from byzantinerandomizedconsensus_tpu.backends.compaction import (
     CompactionPolicy)
@@ -65,6 +67,7 @@ def test_stream_population_is_admissible():
     assert keys > 0, "keys-model validation traffic absent"
 
 
+@pytest.mark.slow
 def test_served_results_bit_identical_to_offline_batched_path():
     """The same configs, served (streamed, continuously batched) vs the
     offline batched path (grid barrier, run_many over the shared compile

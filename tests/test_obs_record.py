@@ -14,7 +14,7 @@ def test_env_fingerprint_fields():
     for key in ("package", "python", "numpy", "jax", "native_abi",
                 "pack_versions"):
         assert key in env, key
-    assert env["pack_versions"] == [1, 2]
+    assert env["pack_versions"] == [1, 2, 3]
     assert env["native_abi"] == 5  # native/simcore.cpp sim_abi_version
 
 

@@ -1,6 +1,7 @@
-"""Spec §2 v2 coordinate packing: the n > 1024 gate (ISSUE 2 tentpole).
+"""Spec §2 v2/v3 coordinate packing: the n > 1024 gate (ISSUE 2 tentpole)
+and the n > 4096 gate (ISSUE 15, round 19).
 
-Three invariants:
+Four invariants:
 
 1. **Frozen v1 law** — every draw of every n ≤ 1024 config is bit-identical to
    the pre-v2 code: pinned raw PRF words, plus a golden re-pin asserting the
@@ -10,6 +11,9 @@ Three invariants:
    accepts n=2048/4096 and enforces the narrower v2 instance/round fields.
 3. **Cross-stack agreement past the old cap** — numpy vs native (and a scalar
    oracle subsample on the slow leg) bit-match at n=2048 under the v2 law.
+4. **The v3 gate** (round 19) — v1/v2 words never move under the widened law;
+   ``validate()`` admits n = 10⁵/10⁶ for the committee family only, and
+   rejects v3 field overflows and full-mesh deliveries past 4096 by name.
 """
 
 import shutil
@@ -73,18 +77,21 @@ def test_pack_version_is_pure_function_of_n():
     assert prf.pack_version(1025) == 2
     assert prf.pack_version(2048) == 2
     assert prf.pack_version(4096) == 2
+    assert prf.pack_version(4097) == 3
+    assert prf.pack_version(100_000) == 3
+    assert prf.pack_version(prf.V3_MAX_N) == 3
     with pytest.raises(ValueError):
-        prf.pack_version(4097)
+        prf.pack_version(prf.V3_MAX_N + 1)
 
 
 def test_v2_law_differs_from_v1():
-    """The gate is non-vacuous: the two laws give different words on shared
-    coordinates (same seed, same logical draw)."""
+    """The gates are non-vacuous: the three laws give pairwise different
+    words on shared coordinates (same seed, same logical draw)."""
     coords = (42, 3, 1, 0, 1, 1, prf.SCHED)
-    assert int(prf.prf_u32(*coords, xp=np, pack=1)) != \
-        int(prf.prf_u32(*coords, xp=np, pack=2))
-    with pytest.raises(ValueError):
-        prf.prf_u32(*coords, xp=np, pack=3)
+    w1 = int(prf.prf_u32(*coords, xp=np, pack=1))
+    w2 = int(prf.prf_u32(*coords, xp=np, pack=2))
+    w3 = int(prf.prf_u32(*coords, xp=np, pack=3))
+    assert len({w1, w2, w3}) == 3
 
 
 def test_v2_numpy_matches_jax():
@@ -140,6 +147,51 @@ def test_validate_rejects_v2_field_overflow():
     SimConfig(protocol="bracha", n=2048, f=682,
               instances=prf.V2_MAX_INSTANCES,
               round_cap=prf.V2_MAX_ROUNDS).validate()
+
+
+# ----------------------------------------------------- the v3 gate (round 19)
+
+def test_validate_accepts_v3_committee_sizes():
+    """The §2 v3 law admits the committee family at n = 10⁵ and 10⁶ — the
+    scales the §10 cost curve is measured at (artifacts/committee_r19.json)."""
+    from byzantinerandomizedconsensus_tpu.config import committee_point
+
+    c1e5 = committee_point(100_000, instances=4)
+    assert c1e5.pack_version == 3
+    c1e6 = committee_point(1_000_000, instances=2)
+    assert c1e6.pack_version == 3
+    assert prf.V3_MAX_N == 1 << 20
+
+
+def test_validate_rejects_full_mesh_past_v2_ceiling():
+    """Only the committee family crosses the 4096 edge: the full-mesh
+    samplers stay behind the v2 ceiling, rejected by name."""
+    for delivery in ("keys", "urn", "urn2", "urn3"):
+        with pytest.raises(ValueError,
+                           match="only delivery='committee'"):
+            SimConfig(protocol="bracha", n=8192, f=1638, instances=1,
+                      delivery=delivery).validate()
+
+
+def test_validate_rejects_v3_field_overflow():
+    """v3 narrows the instance field to 12 bits (the round field stays at
+    v2's 12): an instance count legal under v2 must be rejected once n
+    crosses the 4096 gate, and a round_cap past the 12-bit field too."""
+    big_inst = prf.V3_MAX_INSTANCES + 1        # fine under v2 (2^16 cap)
+    SimConfig(protocol="bracha", n=2048, f=409,
+              instances=big_inst).validate()
+    with pytest.raises(ValueError, match="under packing v3"):
+        SimConfig(protocol="bracha", n=8192, f=1638, instances=big_inst,
+                  delivery="committee").validate()
+    with pytest.raises(ValueError, match="under packing v3"):
+        SimConfig(protocol="bracha", n=8192, f=1638, instances=1,
+                  round_cap=prf.V3_MAX_ROUNDS + 1,
+                  delivery="committee").validate()
+    # At the exact v3 limits validate() still accepts.
+    SimConfig(protocol="bracha", n=8192, f=1638,
+              instances=prf.V3_MAX_INSTANCES,
+              round_cap=prf.V3_MAX_ROUNDS,
+              delivery="committee").validate()
 
 
 # ------------------------------------------- cross-stack agreement at n = 2048

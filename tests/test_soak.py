@@ -92,6 +92,7 @@ def test_soak_reports_mismatch_instead_of_raising(monkeypatch):
             assert len(m[leg]["decision"]) == m["config"]["instances"]
 
 
+@pytest.mark.slow
 def test_chaos_smoke_subprocess_leg(tmp_path):
     """The deterministic tier-1 chaos smoke: 8 seeded configs, each run in a
     real subprocess (numpy-vs-jax + oracle subsample + safety invariants) —
@@ -178,6 +179,7 @@ def test_chaos_smoke_subprocess_leg(tmp_path):
     assert doc2["oracle_subsampled_configs"] == 2
 
 
+@pytest.mark.slow
 def test_chaos_survives_crash_and_hang_and_resumes(tmp_path):
     """The acceptance drill: an injected subprocess crash AND an injected
     hang each go timeout → backoff → retry → skip-with-record (the run
