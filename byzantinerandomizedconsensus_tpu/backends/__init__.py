@@ -24,7 +24,8 @@ def _numpy():
 
 
 def _jax(kernel: str = "xla"):
-    """``jax`` or ``jax:<kernel>`` with kernel in xla | xla_nosort | pallas."""
+    """``jax`` or ``jax:<kernel>`` with kernel in xla | xla_nosort | pallas
+    | fused."""
     from byzantinerandomizedconsensus_tpu.backends.jax_backend import JaxBackend
 
     return JaxBackend(kernel=kernel or "xla")
@@ -46,6 +47,17 @@ def _jax_pallas():
     from byzantinerandomizedconsensus_tpu.backends.jax_backend import JaxBackend
 
     return JaxBackend(kernel="pallas")
+
+
+def _jax_fused():
+    """``jax_fused`` — the whole round loop resident in one Pallas kernel
+    (ops/pallas_round.py, ABI v6): delivery draw → tally → coin → decide
+    with the spec §9 fault parameters and the §10 committee draw in-kernel,
+    for the count-level deliveries. Interpret mode off-TPU; bit-identical to
+    ``jax`` (tests/test_pallas_round.py)."""
+    from byzantinerandomizedconsensus_tpu.backends.jax_backend import JaxBackend
+
+    return JaxBackend(kernel="fused")
 
 
 def _jax_compact(policy: str = ""):
@@ -95,6 +107,7 @@ register_backend("jax", _jax)
 register_backend("jax_cpu", _jax_cpu)
 register_backend("jax_sharded", _jax_sharded)
 register_backend("jax_pallas", _jax_pallas)
+register_backend("jax_fused", _jax_fused)
 register_backend("jax_compact", _jax_compact)
 register_backend("native", _native)
 register_backend("virtual", _virtual)

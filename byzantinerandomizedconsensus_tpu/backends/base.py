@@ -163,8 +163,10 @@ class JitChunkedBackend(SimulatorBackend):
     and SimResult assembly. Subclasses provide ``_make_fn`` / ``_chunk_size`` and
     may override ``_check_config`` / ``_clamp_chunk`` / ``_device_ctx``."""
 
-    #: "pallas" kernels need concrete PRF key words in-kernel; everything else
-    #: takes the key dynamically so one program serves every seed.
+    #: The per-step Pallas kernel ("pallas") bakes concrete PRF key words
+    #: in-kernel; everything else — including the fused round kernel, whose
+    #: ABI v6 key plane is an operand — takes the key dynamically so one
+    #: program serves every seed.
     kernel: str = "xla"
 
     needs_warmup = True  # first run at a shape compiles an XLA program
@@ -177,6 +179,13 @@ class JitChunkedBackend(SimulatorBackend):
     def _cache_key(self, cfg: SimConfig) -> SimConfig:
         if self.kernel == "pallas":
             return cfg
+        if self.kernel == "fused":
+            # The fused program is additionally request-size-independent:
+            # cfg.instances only bounds id resolution (nothing under
+            # models/ or ops/ reads it) and the dispatch shape is the
+            # power-of-two chunk clamp, so one program serves every
+            # request size in a bin — the serve path's steady state.
+            return dataclasses.replace(cfg, seed=0, instances=1)
         return dataclasses.replace(cfg, seed=0)
 
     def _extra_args(self, cfg: SimConfig) -> tuple:
@@ -205,6 +214,18 @@ class JitChunkedBackend(SimulatorBackend):
 
         return contextlib.nullcontext()
 
+    def _census_label(self, cfg: SimConfig) -> str:
+        """The per-config census key. Non-default kernels append ``/k<name>``
+        so an A/B census (xla vs fused over the same config) keeps distinct
+        entries — additive: every existing kernel="xla" label is unchanged,
+        so the committed r13 census keys still match."""
+        from byzantinerandomizedconsensus_tpu.obs import programs as _programs
+
+        label = _programs.config_label(self._cache_key(cfg))
+        if self.kernel != "xla":
+            label += f"/k{self.kernel}"
+        return label
+
     def _fn(self, cfg: SimConfig):
         key = self._cache_key(cfg)
         if key not in self._compiled:
@@ -219,9 +240,22 @@ class JitChunkedBackend(SimulatorBackend):
                 programs as _programs)
 
             if _programs.enabled():
-                fn = _programs.instrument(_programs.config_label(key), fn)
+                fn = _programs.instrument(self._census_label(cfg), fn)
             self._compiled[key] = fn
         return self._compiled[key]
+
+    def compile_probe(self) -> int:
+        """Programs compiled through the per-config dispatch path: jit-cache
+        entries summed over the compiled-fn cache, so a shape recompile
+        counts too. The serve loadgen's zero-steady-state-recompile pin
+        reads this probe's delta for non-xla kernels, whose requests go
+        through direct dispatch and never touch the bucket CompileCache."""
+        total = 0
+        for fn in self._compiled.values():
+            size = getattr(fn, "_cache_size", None)
+            if callable(size):
+                total += int(size())
+        return total
 
     def run(self, cfg: SimConfig, inst_ids: Optional[np.ndarray] = None) -> SimResult:
         from byzantinerandomizedconsensus_tpu.obs import trace as _trace
@@ -244,10 +278,7 @@ class JitChunkedBackend(SimulatorBackend):
                 # post-hoc so the untraced fast path never computes it —
                 # the roofline join (tools/programs.py) matches it against
                 # the census like the bucket paths' dispatch spans.
-                from byzantinerandomizedconsensus_tpu.obs import (
-                    programs as _programs)
-
-                sp["program"] = _programs.config_label(self._cache_key(cfg))
+                sp["program"] = self._census_label(cfg)
             rounds_out, decision_out = self._run_chunked(
                 fn, ids, chunk, self._extra_args(cfg))
         return SimResult(config=cfg, inst_ids=ids, rounds=rounds_out, decision=decision_out)

@@ -96,8 +96,10 @@ def _run_chunk(cfg, inst_ids: jnp.ndarray, key=None, counts_fn=None,
 
 class JaxBackend(JitChunkedBackend):
     """``device='tpu'|'cpu'|None`` pins the computation; None = JAX default device.
-    ``kernel='xla'`` (masks+tally) or ``'pallas'`` (fused kernel; interpret mode
-    is selected automatically on non-TPU platforms so CI can bit-match it)."""
+    ``kernel='xla'`` (masks+tally), ``'pallas'`` (fused step kernel) or
+    ``'fused'`` (the whole round loop in one pallas_call, ops/pallas_round.py
+    — faults + committees in-kernel, ABI v6); the Pallas kernels select
+    interpret mode automatically on non-TPU platforms so CI can bit-match."""
 
     name = "jax"
 
@@ -105,9 +107,10 @@ class JaxBackend(JitChunkedBackend):
                  device=None, kernel: str = "xla"):
         super().__init__(chunk_bytes, max_chunk)
         self.device = device
-        if kernel not in ("xla", "xla_nosort", "pallas"):
+        if kernel not in ("xla", "xla_nosort", "pallas", "fused"):
             raise ValueError(
-                f"unknown kernel {kernel!r}; use 'xla', 'xla_nosort' or 'pallas'")
+                f"unknown kernel {kernel!r}; use 'xla', 'xla_nosort', "
+                "'pallas' or 'fused'")
         self.kernel = kernel
 
     def _chunk_size(self, cfg: SimConfig) -> int:
@@ -122,6 +125,14 @@ class JaxBackend(JitChunkedBackend):
         pack_cap = {1: prf.MAX_INSTANCES, 2: prf.V2_MAX_INSTANCES,
                     3: prf.V3_MAX_INSTANCES}[cfg.pack_version]
         max_chunk = min(self.max_chunk, pack_cap)
+        if self.kernel == "fused":
+            # The whole round loop runs per 8-instance block inside one
+            # pallas_call (ops/pallas_round.py); state is O(B·n) and a block
+            # exits as soon as its instances decide, so stragglers cost at
+            # block granularity, not chunk granularity. Same O(B·n) budget
+            # as the count-level path, capped at the Pallas dispatch sweet
+            # spot.
+            return max(1, min(max_chunk, 4096, (1 << 20) // max(1, cfg.n)))
         if cfg.count_level:
             # No O(B·n²) transient at all — state is O(B·n). Measured optimum
             # at n=512 on v5e is ~2k instances/chunk: beyond that the
@@ -137,11 +148,32 @@ class JaxBackend(JitChunkedBackend):
         per_inst = cfg.n * cfg.n * 4 * 4  # ~4 live (B,n,n) u32-sized transients
         return max(1, min(max_chunk, self.chunk_bytes // per_inst))
 
+    def _clamp_chunk(self, cfg: SimConfig, chunk: int) -> int:
+        if self.kernel != "fused":
+            return chunk
+        # Shape-stabilize the fused dispatch: round the chunk up to a power
+        # of two (tail rows pad with a repeated last id, the established
+        # tail law), so the per-config jit cache holds a log-bounded program
+        # set instead of one program per distinct request size — the serve
+        # path's zero-steady-state-recompile pin needs shape reuse, not
+        # just config reuse.
+        return 1 << max(3, (chunk - 1).bit_length())
+
     def _make_fn(self, cfg: SimConfig):
-        if self.kernel != "xla":
-            # The custom-kernel paths compute delivery in-kernel and have no
-            # fault-schedule or committee channel — fail loudly, never fall
-            # back silently.
+        if self.kernel == "fused":
+            # ABI v6 (ops/pallas_round.py): faults and committees run
+            # in-kernel, so the per-step kernels' gates don't apply; the
+            # fused kernel has its own named surface check instead.
+            from byzantinerandomizedconsensus_tpu.ops import pallas_round
+
+            pallas_round.check_fused_supported(cfg)
+            interpret = jax.default_backend() != "tpu"
+            return jax.jit(partial(pallas_round.run_chunk, cfg,
+                                   interpret=interpret))
+        if self.kernel in ("xla_nosort", "pallas"):
+            # The per-step custom-kernel paths compute delivery in-kernel and
+            # have no fault-schedule or committee channel — fail loudly,
+            # never fall back silently.
             from byzantinerandomizedconsensus_tpu.models.committee import (
                 check_committee_supported)
             from byzantinerandomizedconsensus_tpu.models.faults import (

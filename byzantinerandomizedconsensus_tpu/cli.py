@@ -325,9 +325,11 @@ def main(argv=None) -> int:
                         "(tools/programs.py): `programs dump ART` (XLA "
                         "cost/memory + HLO fingerprints), `programs diff "
                         "A B` (fingerprint drift), `programs roofline "
-                        "--census ART` (per-dispatch wall vs per-program "
-                        "flops/bytes), `programs census` (the "
-                        "census-on-vs-off A/B artifact)")
+                        "--census ART [--vs BASE]` (per-dispatch wall vs "
+                        "per-program flops/bytes, bytes/dispatch delta vs "
+                        "a baseline census), `programs census` (the "
+                        "census-on-vs-off A/B artifact), `programs fused` "
+                        "(the ABI v6 xla-vs-fused A/B artifact)")
     sub.add_parser("serve",
                    help="always-on consensus service (serve/server.py): "
                         "stdlib-HTTP front end over continuous-batching "

@@ -35,7 +35,11 @@ DELIVERY_KINDS = ("keys",) + COUNT_LEVEL_DELIVERIES
 # "partition" = a PRF-drawn epoch isolating a fault-prone sub-block (messages
 # across the cut suppressed both ways); "omission" = transient per-round
 # send-omission bursts. Implemented in models/faults.py (vectorized) and
-# core/faults.py (scalar oracle); native/Pallas/sharded raise FaultsUnsupported.
+# core/faults.py (scalar oracle); the native core and the per-step Pallas
+# kernels raise FaultsUnsupported. The fused round kernel (ABI v6,
+# ops/pallas_round.py) closed that gate for the count-level deliveries: its
+# operand block carries the §9 schedules in-kernel, on the single-host and
+# sharded paths alike.
 FAULT_KINDS = ("none", "recover", "partition", "omission")
 
 # Single source for the default round cap. checkpoint.shard_name encodes only

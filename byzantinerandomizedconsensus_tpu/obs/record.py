@@ -135,8 +135,12 @@ RECORD_VERSION = 1
 # deadline-hit-rate rows + mismatch / steady-compile pins); v1.10 (round 19)
 # the committee block (spec §10 committee cost curve: log-spaced n legs,
 # realized committee sizes / fault budgets, per-replica cost flatness vs the
-# full-mesh baseline, the n=10⁵ checker verdict and the serve pins).
-RECORD_REVISION = 10
+# full-mesh baseline, the n=10⁵ checker verdict and the serve pins); v1.11
+# (round 20) the fused block (ABI v6 fused round kernel: per-config
+# bytes/dispatch vs the xla baseline, the bit-match / steady-compile pins,
+# the device-of-record debt field the ledger tracks) + the env fingerprint's
+# pallas_pack_versions / fused_state_pack packing-law fields.
+RECORD_REVISION = 11
 
 
 def env_fingerprint() -> dict:
@@ -153,8 +157,20 @@ def env_fingerprint() -> dict:
         "python": platform.python_version(),
         "numpy": np.__version__,
         # Every §2 packing law this build speaks (KEY_LOW_BITS carries one
-        # entry per law; PACK_SHIFTS is Pallas-only and stops at v2).
+        # entry per law). PACK_SHIFTS covers only the per-step Pallas
+        # kernels, which stop at v2; the fused round kernel (ABI v6) runs
+        # the xp-generic prf_u32 in-kernel and speaks every law, so its
+        # packing identity is the resident-state word below, not a
+        # coordinate triple.
         "pack_versions": sorted(prf.KEY_LOW_BITS),
+        "pallas_pack_versions": sorted(prf.PACK_SHIFTS),
+        # ABI v6 resident-state law (round 20): the fused kernel's packed
+        # uint32 state word, field -> [bit offset, width] (spec §A6).
+        "fused_state_pack": {
+            "version": prf.FUSED_STATE_PACK_VERSION,
+            "bits": {k: list(v)
+                     for k, v in sorted(prf.FUSED_STATE_BITS.items())},
+        },
     }
     try:
         from byzantinerandomizedconsensus_tpu.backends.native_backend import (
@@ -533,6 +549,29 @@ def committee_block(stats: dict | None) -> dict | None:
             if k in stats}
 
 
+#: The fields a schema-v1.11 ``fused`` block must carry (the ABI v6 fused
+#: round kernel A/B of tools/programs.py ``programs fused``: per-config
+#: bytes/dispatch rows vs the xla baseline, the bit-match pin whose
+#: committed value 0 is the round's claim, and the device-of-record field
+#: the ledger's debt row reads).
+FUSED_BLOCK_KEYS = ("configs", "mismatches", "rows", "device_of_record")
+
+
+def fused_block(stats: dict | None) -> dict | None:
+    """The schema-v1.11 ``fused`` block from a fused-A/B stats dict
+    (tools/programs.py ``programs fused``). None in, None out — a record
+    without the block stays a valid v1.x record. ``rows`` is one entry per
+    A/B config: census label, xla and fused bytes/dispatch, their ratio.
+    ``device_of_record`` names where the bit-match ran ("interpret/cpu"
+    until the Mosaic lowering lands — the ledger tracks that debt)."""
+    if stats is None:
+        return None
+    return {k: stats.get(k) for k in
+            (FUSED_BLOCK_KEYS + ("state_pack", "steady_state_compiles",
+                                 "bytes_total", "duration_s"))
+            if k in stats}
+
+
 def validate_record(doc: dict) -> list:
     """Schema check: returns a list of problems (empty = valid v1 record)."""
     problems = []
@@ -694,6 +733,25 @@ def validate_record(doc: dict) -> list:
             ok = cb.get("checker_ok")
             if ok is not None and not isinstance(ok, bool):
                 problems.append("committee block 'checker_ok' is not a bool")
+    fu = doc.get("fused")
+    if fu is not None:
+        if not isinstance(fu, dict):
+            problems.append("fused block is not a dict")
+        else:
+            for key in FUSED_BLOCK_KEYS:
+                if key not in fu:
+                    problems.append(f"fused block missing {key!r}")
+            rows = fu.get("rows")
+            if rows is not None:
+                if not isinstance(rows, list):
+                    problems.append("fused block 'rows' is not a list")
+                else:
+                    for i, row in enumerate(rows):
+                        if not isinstance(row, dict) or "key" not in row \
+                                or "fused_bytes_per_dispatch" not in row:
+                            problems.append(
+                                f"fused row {i} missing "
+                                "'key'/'fused_bytes_per_dispatch'")
     pg = doc.get("programs")
     if pg is not None:
         if not isinstance(pg, dict):

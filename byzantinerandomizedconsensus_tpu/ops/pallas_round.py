@@ -1,0 +1,281 @@
+"""Pallas TPU kernel: the fused in-kernel round loop (ABI v6 / Pallas v2).
+
+The per-config jit path (backends/jax_backend.py::_run_chunk) runs the round
+recurrence as an XLA ``while_loop`` whose body is a dozen separate fusions:
+each broadcast step's delivery draw, tally, coin and decide round-trips the
+packed per-replica state through HBM between dispatches. The r13 program
+census pegs those count-level programs at 3.4–5.9 flops/byte — memory-bound —
+so the next multiplier is bytes moved, not flops.
+
+This kernel keeps the whole round loop resident in one ``pallas_call``:
+
+1. per (instance-block) grid cell the packed state word — ``est`` (2 bits) |
+   ``decided`` (1 bit) | ``decided_val`` (2 bits) | ``phase`` (24 bits) — is
+   the ``while_loop`` carry; nothing leaves the kernel until the block's
+   instances have all decided (or hit the round cap). Only the (B,) round
+   counts and decisions ever reach HBM;
+2. the loop body IS the protocol: it calls the xp-generic round bodies
+   (models/benor.py / models/bracha.py) with ``xp = jax.numpy`` on the
+   block's slice, so the delivery draw (§4b/§4b-v2/§4c/§10), tallies, coin
+   and decide rules are the *same code* every other vectorized backend runs —
+   bit-exactness against the core/network.py oracle holds by construction,
+   not by transcription;
+3. the spec §9 fault parameters and the §10 committee draw ride in-kernel —
+   the reserved ABI v6 operand block: the sort-backed static selections
+   (§3.2 fault-prone set, crash rounds, partition sides/epochs) are computed
+   host-side once and streamed in as narrow operand planes; the per-round
+   fault masks (recovery windows, omission bursts) and the committee
+   membership/step-silence PRF draws are evaluated in-register. This closes
+   the ``FaultsUnsupported`` / ``CommitteeUnsupported`` gates of the Pallas
+   path (models/faults.py, models/committee.py).
+
+Supported surface: the count-level deliveries (``urn`` | ``urn2`` | ``urn3``
+| ``committee``) for both protocols, every static adversary, every static
+fault schedule. ``delivery="keys"`` needs the spec-§4 per-(recv, send) key
+sort — a different kernel (ops/pallas_tally.py) — and the ``superset`` fused
+lane laws need traced lane codes; both raise :class:`FusedUnsupported` by
+name (never a silent fallback).
+
+Device of record: interpret mode (CPU). The loop body reuses the xp-generic
+model code, whose gathers (extract_decision) and nested while_loops (the
+§4b-v2 chain) do not all lower through Mosaic today — the real-TPU lowering
+is tracked as ledger debt (``brc-tpu ledger``; docs/PERF.md round 20). The
+bytes-moved claim is measured on the interpret program's cost analysis
+(tools/programs.py roofline --vs), at bit-identical results.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from byzantinerandomizedconsensus_tpu.models import benor, bracha
+from byzantinerandomizedconsensus_tpu.models import state as state_mod
+from byzantinerandomizedconsensus_tpu.models.adversaries import AdversaryModel
+from byzantinerandomizedconsensus_tpu.ops.pallas_tally import (align_vma,
+                                                               out_struct,
+                                                               _pad_axis)
+
+#: Lane width of the broadcast operand/result planes (Mosaic's native lane
+#: count; scalars ride as (B, 128) planes like ops/pallas_urn.py's ids).
+_LANE = 128
+
+#: Instance rows per grid cell (the Mosaic minimum sublane block).
+_BLOCK_B = 8
+
+#: The ABI v6 surface. Count-level deliveries only: the keys delivery needs
+#: the §4 per-(recv, send) combined-key sort, which is ops/pallas_tally.py's
+#: job; the "superset" adversary/fault/init laws are fused-lane constructs
+#: (backends/batch.py) that carry traced lane codes this per-config kernel
+#: never sees.
+SUPPORTED_DELIVERIES = ("urn", "urn2", "urn3", "committee")
+SUPPORTED_ADVERSARIES = ("none", "crash", "byzantine", "adaptive",
+                         "adaptive_min")
+SUPPORTED_FAULTS = ("none", "recover", "partition", "omission")
+SUPPORTED_INITS = ("random", "all0", "all1", "split")
+
+
+class FusedUnsupported(RuntimeError):
+    """Raised for configs outside the fused kernel's ABI v6 surface —
+    mirroring models/faults.FaultsUnsupported — instead of silently running
+    a different delivery law."""
+
+
+def check_fused_supported(cfg) -> None:
+    """Reject configs outside the ABI v6 surface with one uniform message
+    that names the whole supported surface (the gate tests pin this)."""
+    problems = []
+    if cfg.delivery not in SUPPORTED_DELIVERIES:
+        problems.append(f"delivery={cfg.delivery!r}")
+    if cfg.adversary not in SUPPORTED_ADVERSARIES:
+        problems.append(f"adversary={cfg.adversary!r}")
+    if cfg.faults not in SUPPORTED_FAULTS:
+        problems.append(f"faults={cfg.faults!r}")
+    if cfg.init not in SUPPORTED_INITS:
+        problems.append(f"init={cfg.init!r}")
+    if problems:
+        raise FusedUnsupported(
+            f"kernel='fused' does not support {', '.join(problems)}; the "
+            f"ABI v6 surface is delivery in {SUPPORTED_DELIVERIES}, "
+            f"adversary in {SUPPORTED_ADVERSARIES}, "
+            f"faults in {SUPPORTED_FAULTS}, init in {SUPPORTED_INITS} "
+            "(delivery='keys' runs on kernel='xla'|'xla_nosort'|'pallas'; "
+            "superset lanes run on the batched xla runner)")
+
+
+# --- packed resident state ------------------------------------------------
+# One uint32 word per (instance, replica) carries the whole protocol state
+# between rounds: est {0,1} in bits 0-1, decided in bit 2, decided_val {0,1}
+# in bits 3-4, phase (monotone, <= round_cap <= 2^20 by the §2 law caps) in
+# bits 8-31. Packing/unpacking costs a few VPU ops per round; what it buys is
+# a single-plane while_loop carry — the narrowest resident footprint the §2
+# laws allow, and the shape the spec §A6 appendix documents.
+
+def _pack_state(st):
+    return (st["est"].astype(jnp.uint32)
+            | (st["decided"].astype(jnp.uint32) << jnp.uint32(2))
+            | (st["decided_val"].astype(jnp.uint32) << jnp.uint32(3))
+            | (st["phase"].astype(jnp.uint32) << jnp.uint32(8)))
+
+
+def _unpack_state(packed):
+    return {
+        "est": (packed & jnp.uint32(3)).astype(jnp.uint8),
+        "decided": ((packed >> jnp.uint32(2)) & jnp.uint32(1)) != 0,
+        "decided_val": ((packed >> jnp.uint32(3))
+                        & jnp.uint32(3)).astype(jnp.uint8),
+        "phase": (packed >> jnp.uint32(8)).astype(jnp.int32),
+    }
+
+
+def _make_kernel(cfg, n: int):
+    """Build the per-config kernel body. The operand list is config-shaped
+    (the ABI v6 parameter block, spec/PROTOCOL.md §A6): the inst plane, the
+    PRF key plane and the adversary's static setup always; the
+    fault-schedule planes only when ``cfg.faults != "none"`` — absent axes
+    cost zero bytes. The key rides as an *operand* (not a constant) so one
+    compiled program serves every seed — the serve path's
+    zero-steady-state-recompile pin depends on it."""
+    adv = AdversaryModel(cfg)
+    round_body = (benor.round_body if cfg.protocol == "benor"
+                  else bracha.round_body)
+
+    def kernel(*refs):
+        inst_ref, key_ref, faulty_ref, crash_ref = refs[:4]
+        rest = list(refs[4:-2])
+        rounds_ref, decision_ref = refs[-2:]
+
+        inst = inst_ref[...][:, 0].astype(jnp.uint32)           # (block_b,)
+        # int32 planes are bit-transparent for the uint32 threefry words
+        key = key_ref[...][0, :2].astype(jnp.uint32)            # (2,)
+        faulty = faulty_ref[...][:, :n] != 0                    # (block_b, n)
+        crash = crash_ref[...][:, :n].astype(jnp.int32)
+        if cfg.faults == "none":
+            fsetup = None
+        else:
+            fsetup = {"fprone": rest.pop(0)[...][:, :n] != 0}
+            if cfg.faults == "recover":
+                fsetup["down_at"] = rest.pop(0)[...][:, :n].astype(jnp.int32)
+                fsetup["up_at"] = rest.pop(0)[...][:, :n].astype(jnp.int32)
+            elif cfg.faults == "partition":
+                fsetup["side"] = rest.pop(0)[...][:, :n].astype(jnp.uint8)
+                fsetup["part_start"] = rest.pop(0)[...][:, 0].astype(jnp.int32)
+                fsetup["part_heal"] = rest.pop(0)[...][:, 0].astype(jnp.int32)
+            # omission: the burst gate + per-replica bits are pure PRF draws,
+            # evaluated in-register by models/faults.round_masks each round.
+        setup = {"faulty": faulty, "crash_round": crash, "faults": fsetup}
+
+        st = state_mod.init_state(cfg, key, inst, xp=jnp)
+        done_at = jnp.full((inst.shape[0],), -1, dtype=jnp.int32)
+
+        def cond(carry):
+            r, _, done_at = carry
+            return (r < cfg.round_cap) & ~jnp.all(done_at >= 0)
+
+        def body(carry):
+            r, packed, done_at = carry
+            st = _unpack_state(packed)
+            # counts_fn=None routes make_counts to the registered count-level
+            # sampler (ops/urn*.py, ops/committee.py) WITH the §9 fsil/fside
+            # masks threaded — the whole point of running the model code
+            # in-kernel rather than a transcription of it.
+            st = round_body(cfg, key, inst, r, st, adv, setup, xp=jnp)
+            done_now = state_mod.all_correct_decided(st, faulty, xp=jnp)
+            done_at = jnp.where((done_at < 0) & done_now, r + 1, done_at)
+            return r + 1, _pack_state(st), done_at
+
+        _, packed, done_at = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), _pack_state(st), done_at))
+        st = _unpack_state(packed)
+        done = done_at >= 0
+        rounds = jnp.where(done, done_at, cfg.round_cap).astype(jnp.int32)
+        decision = state_mod.extract_decision(st, faulty, done, xp=jnp)
+        shape = (inst.shape[0], _LANE)
+        rounds_ref[...] = jnp.broadcast_to(rounds[:, None], shape)
+        decision_ref[...] = jnp.broadcast_to(
+            decision.astype(jnp.int32)[:, None], shape)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "interpret"))
+def run_chunk(cfg, inst_ids, key=None, interpret: bool = False):
+    """Simulate one chunk entirely in-kernel; returns ``(rounds (B,) i32,
+    decision (B,) u8)`` — the backends/base.py dispatch contract, matching
+    jax_backend._run_chunk bit for bit.
+
+    ``key``: the (2,) uint32 threefry key as a *dynamic* argument (None
+    derives it from ``cfg.seed`` inside the trace). The dispatch path
+    (JitChunkedBackend._extra_args) passes it dynamically, so the compiled
+    program — and the serve compile cache — is seed-independent.
+
+    Host side builds the ABI v6 operand block: the static per-instance
+    selections that need a sort (§3.2 fault-prone/faulty sets, crash rounds,
+    partition sides and epochs) run once with ``xp = jax.numpy`` outside the
+    kernel and stream in as int32 planes; everything per-round stays
+    in-register. Each _BLOCK_B-row grid cell runs its own round loop and
+    exits as soon as its instances decide — per-instance results are
+    invariant to loop length (updates are decided-masked, ``done_at``
+    latches), so the early exit is bit-free.
+    """
+    from byzantinerandomizedconsensus_tpu.ops import prf
+
+    check_fused_supported(cfg)
+    n = cfg.n
+    B = inst_ids.shape[0]
+    b_blocks = -(-B // _BLOCK_B)
+    B_pad = b_blocks * _BLOCK_B
+    n_pad = -(-n // _LANE) * _LANE
+
+    if key is None:
+        key = jnp.asarray(prf.seed_key(cfg.seed), dtype=jnp.uint32)
+    key = jnp.asarray(key, dtype=jnp.uint32)
+
+    ids = jnp.asarray(inst_ids, dtype=jnp.uint32)
+    if B_pad != B:
+        # Pad rows duplicate the last real instance (backends/base.py's tail
+        # law): they decide exactly when it does, so they never extend a
+        # block's loop beyond real work.
+        ids = jnp.concatenate(
+            [ids, jnp.broadcast_to(ids[-1:], (B_pad - B,))])
+
+    setup = AdversaryModel(cfg).setup(key, ids, xp=jnp)
+
+    def plane(x):                       # (B_pad, n) -> (B_pad, n_pad) i32
+        return _pad_axis(x.astype(jnp.int32), -1, n_pad, 0)
+
+    def lanes(x):                       # (B_pad,) -> (B_pad, _LANE) i32
+        return jnp.broadcast_to(x.astype(jnp.int32)[:, None],
+                                (B_pad, _LANE))
+
+    kplane = _pad_axis(jnp.broadcast_to(
+        key.astype(jnp.int32)[None, :], (B_pad, 2)), -1, _LANE, 0)
+    operands = [lanes(ids), kplane, plane(setup["faulty"]),
+                plane(setup["crash_round"])]
+    fs = setup["faults"]
+    if cfg.faults != "none":
+        operands.append(plane(fs["fprone"]))
+        if cfg.faults == "recover":
+            operands += [plane(fs["down_at"]), plane(fs["up_at"])]
+        elif cfg.faults == "partition":
+            operands += [plane(fs["side"]), lanes(fs["part_start"]),
+                         lanes(fs["part_heal"])]
+
+    operands, vma = align_vma(operands)
+    rounds, decision = pl.pallas_call(
+        _make_kernel(cfg, n),
+        grid=(b_blocks,),
+        in_specs=[pl.BlockSpec((_BLOCK_B, x.shape[1]), lambda b: (b, 0))
+                  for x in operands],
+        out_specs=[pl.BlockSpec((_BLOCK_B, _LANE), lambda b: (b, 0)),
+                   pl.BlockSpec((_BLOCK_B, _LANE), lambda b: (b, 0))],
+        out_shape=[
+            out_struct((B_pad, _LANE), jnp.int32, vma),
+            out_struct((B_pad, _LANE), jnp.int32, vma),
+        ],
+        interpret=interpret,
+    )(*operands)
+    return rounds[:B, 0], decision[:B, 0].astype(jnp.uint8)

@@ -214,17 +214,34 @@ def align_vma(args):
     Under shard_map's vma checking the outputs vary over every mesh axis any
     input varies over, and every input must carry the same vma for the
     interpreter's internal slices. Returns (aligned_args, vma_set).
+
+    jax builds without vma typing (``jax.typeof`` landed with it) return the
+    args untouched with an empty set: the shard_map paths that need the
+    alignment are unavailable there, and the plain pallas_call paths must
+    keep working.
     """
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return list(args), frozenset()
     vma = frozenset()
     for x in args:
-        vma |= getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
+        vma |= getattr(typeof(x), "vma", frozenset()) or frozenset()
 
     def _align(x):
         need = tuple(a for a in vma
-                     if a not in (getattr(jax.typeof(x), "vma", frozenset()) or ()))
+                     if a not in (getattr(typeof(x), "vma", frozenset()) or ()))
         return jax.lax.pcast(x, need, to="varying") if need else x
 
     return [_align(x) for x in args], vma
+
+
+def out_struct(shape, dtype, vma):
+    """``jax.ShapeDtypeStruct`` with the ``vma`` kwarg only when it carries
+    information — older jax builds reject the kwarg outright, and an empty
+    vma set is the constructor's default anyway."""
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def _pad_axis(x, axis: int, size: int, fill):
@@ -322,8 +339,8 @@ def step_counts(cfg, inst_ids, rnd, step, values, silent, faulty,
             pl.BlockSpec((block_b, tile_r), lambda b, r: (b, r)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B_pad, r_pad), jnp.int32, vma=_vma),
-            jax.ShapeDtypeStruct((B_pad, r_pad), jnp.int32, vma=_vma),
+            out_struct((B_pad, r_pad), jnp.int32, _vma),
+            out_struct((B_pad, r_pad), jnp.int32, _vma),
         ],
         interpret=interpret,
     )(params, inst_ids.astype(jnp.int32), values, silent, faulty)

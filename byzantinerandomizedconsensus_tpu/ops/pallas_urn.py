@@ -263,7 +263,8 @@ def step_counts(cfg, inst_ids, rnd, step, v0c, v1c, silent, faulty=None,
     params = jnp.stack([jnp.asarray(rnd, dtype=jnp.int32).reshape(()),
                         jnp.asarray(recv_offset, dtype=jnp.int32).reshape(())])
 
-    from byzantinerandomizedconsensus_tpu.ops.pallas_tally import align_vma
+    from byzantinerandomizedconsensus_tpu.ops.pallas_tally import (align_vma,
+                                                                   out_struct)
 
     # The faulty plane is an input only under minority strata (spec §6.4b) —
     # the benchmark kernels never pay its DMA or VMEM footprint.
@@ -305,8 +306,8 @@ def step_counts(cfg, inst_ids, rnd, step, v0c, v1c, silent, faulty=None,
             pl.BlockSpec((block_b, tile_r), lambda b, r: (b, r)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B_pad, r_pad), jnp.int32, vma=_vma),
-            jax.ShapeDtypeStruct((B_pad, r_pad), jnp.int32, vma=_vma),
+            out_struct((B_pad, r_pad), jnp.int32, _vma),
+            out_struct((B_pad, r_pad), jnp.int32, _vma),
         ],
         interpret=interpret,
     )(*args)

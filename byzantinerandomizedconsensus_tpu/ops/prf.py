@@ -55,13 +55,30 @@ MAX_N = V3_MAX_N
 
 
 # (send, rnd, recv) bit offsets per packing law — the in-kernel Threefry
-# implementations (ops/pallas_urn.py, ops/pallas_tally.py) build x0/x1 from
-# these so their packing cannot drift from prf_u32's. v3 has NO entry on
-# purpose: its x0/x1 layout is structurally different (recv lives in x0),
-# so the (send, rnd, recv)-offset triple cannot describe it, and the Pallas
-# kernels never run v3 configs (they gate on CommitteeUnsupported /
-# n ≤ V2_MAX_N before compiling).
+# implementations of the PER-STEP kernels (ops/pallas_urn.py,
+# ops/pallas_tally.py) build x0/x1 from these so their packing cannot drift
+# from prf_u32's. v3 has NO entry on purpose: its x0/x1 layout is
+# structurally different (recv lives in x0), so the (send, rnd, recv)-offset
+# triple cannot describe it, and the per-step kernels never run v3 configs
+# (they gate on CommitteeUnsupported / n ≤ V2_MAX_N before compiling). The
+# fused round kernel (ops/pallas_round.py, ABI v6) does not consume
+# PACK_SHIFTS at all: it runs the xp-generic prf_u32 in-kernel, so it speaks
+# every law here — including v3 — by construction.
 PACK_SHIFTS = {1: (17, 16, 6), 2: (19, 20, 8)}
+
+# ABI v6 (spec §A6): the fused round kernel's resident-state word — not a
+# coordinate law but the narrow-dtype packing the §2 field caps license.
+# One uint32 per (instance, replica) carries the whole protocol state across
+# the in-kernel round loop: field -> (bit offset, width). phase is monotone
+# and bounded by the round cap (< 2^12 under every law above), so the 24-bit
+# field holds it with headroom; est/decided_val carry the {0,1,2} protocol
+# values in 2 bits each. ops/pallas_round.py's _pack_state/_unpack_state
+# implement exactly this table (pinned in tests/test_pallas_round.py), and
+# obs/record.env_fingerprint records it so artifact readers know which
+# resident layout produced a run.
+FUSED_STATE_PACK_VERSION = 1
+FUSED_STATE_BITS = {"est": (0, 2), "decided": (2, 1),
+                    "decided_val": (3, 2), "phase": (8, 24)}
 
 # The two uint32 sub-laws that share the 10-bit-field assumption with the v1
 # coordinate packing, widened alongside it (spec §2 v2). Selected by the same

@@ -136,8 +136,9 @@ class JaxShardedBackend(JitChunkedBackend):
         super().__init__(chunk_bytes, max_chunk)
         self._mesh = mesh
         self._n_model = n_model
-        if kernel not in ("xla", "pallas"):
-            raise ValueError(f"unknown kernel {kernel!r}; use 'xla' or 'pallas'")
+        if kernel not in ("xla", "pallas", "fused"):
+            raise ValueError(
+                f"unknown kernel {kernel!r}; use 'xla', 'pallas' or 'fused'")
         self.kernel = kernel
 
     @property
@@ -169,6 +170,14 @@ class JaxShardedBackend(JitChunkedBackend):
             raise ValueError(
                 f"n={cfg.n} not divisible by model-axis size {self.mesh.shape[MODEL_AXIS]}"
             )
+        if self.kernel == "fused":
+            # ABI v6: faults and committees run inside the fused kernel —
+            # the mesh-level gates don't apply; the kernel's own surface
+            # check rejects what it cannot run, by name.
+            from byzantinerandomizedconsensus_tpu.ops import pallas_round
+
+            pallas_round.check_fused_supported(cfg)
+            return
         from byzantinerandomizedconsensus_tpu.models.committee import (
             check_committee_supported)
         from byzantinerandomizedconsensus_tpu.models.faults import (
@@ -182,6 +191,21 @@ class JaxShardedBackend(JitChunkedBackend):
         return max(n_data, chunk - chunk % n_data)
 
     def _make_fn(self, cfg: SimConfig):
+        if self.kernel == "fused":
+            # The fused round kernel (ops/pallas_round.py) holds the full
+            # replica width in-kernel, so only the instance axis shards:
+            # each data shard runs its own whole-round pallas_call. The
+            # model axis (if any) replicates the compute; outputs are
+            # model-invariant by determinism. vma checking cannot see
+            # through pallas_call's interpreter — disabled, like the
+            # per-step Pallas path below.
+            from byzantinerandomizedconsensus_tpu.ops import pallas_round
+
+            interpret = jax.default_backend() != "tpu"
+            fn = partial(pallas_round.run_chunk, cfg, interpret=interpret)
+            return jax.jit(jax.shard_map(
+                fn, mesh=self.mesh, in_specs=(P(DATA_AXIS), P()),
+                out_specs=(P(DATA_AXIS), P(DATA_AXIS)), check_vma=False))
         counts_fn = None
         if self.kernel == "pallas":
             from byzantinerandomizedconsensus_tpu.backends.base import (

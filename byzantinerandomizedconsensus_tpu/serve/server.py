@@ -463,10 +463,21 @@ class ConsensusServer:
             try:
                 with _trace.span("serve.dispatch", bucket=bucket.label(),
                                  seeded=len(reqs)):
-                    _compaction.run_bucket(
-                        self._backend, bucket, [], [], policy=self._policy,
-                        feed=feed, on_retire=self._retire,
-                        progress=self._segment_hook)
+                    if getattr(self._backend, "kernel", "xla") != "xla":
+                        # Non-xla kernels (the round-20 fused Pallas path)
+                        # run whole requests per backend call: lane
+                        # compaction's mid-flight surgery requires the xla
+                        # kernel (backends/batch.py), so the feed drains
+                        # directly. Replies are bit-identical either way
+                        # (backend determinism); JitChunkedBackend's
+                        # per-config compile cache keeps the steady state
+                        # at zero recompiles.
+                        self._dispatch_direct(feed)
+                    else:
+                        _compaction.run_bucket(
+                            self._backend, bucket, [], [], policy=self._policy,
+                            feed=feed, on_retire=self._retire,
+                            progress=self._segment_hook)
             except Exception as e:  # noqa: BLE001 — a grid failure must
                 # fail its requests, never kill the dispatcher
                 feed.close()
@@ -477,6 +488,31 @@ class ConsensusServer:
             with self._cv:
                 self._active = None
                 self._cv.notify_all()
+
+    def _dispatch_direct(self, feed) -> None:
+        """Drain ``feed`` one config at a time through ``backend.run`` —
+        the dispatch leg for kernels lane compaction cannot host. A
+        per-item failure (e.g. a config outside the fused kernel's named
+        surface) fails only its own request; the grid keeps draining.
+        Cancels that land while an item is queued were already stripped by
+        ``WorkFeed.cancel``; a cancel that races the run itself is dropped
+        at :meth:`_retire` (the reply is discarded, as on the lane path)."""
+        while True:
+            items = feed.pull(block=True)
+            if items is None:
+                return
+            feed.pop_cancelled()  # queued cancels already left the feed
+            for cfg, ids, token in items:
+                try:
+                    result = self._backend.run(cfg, inst_ids=ids)
+                except Exception as e:  # noqa: BLE001 — isolate the item
+                    if token is not None:
+                        with self._cv:
+                            if not token.done.is_set():
+                                self._fail(token, f"dispatch error: {e!r}")
+                    continue
+                if token is not None:
+                    self._retire(token, result)
 
     def _retire(self, req: ServeRequest, result) -> None:
         with self._cv:
@@ -660,6 +696,12 @@ class ConsensusServer:
 
     def compile_count(self) -> int:
         """Compiles so far — the loadgen's zero-steady-state probe."""
+        if getattr(self._backend, "kernel", "xla") != "xla":
+            # Direct-dispatch kernels never enter the bucket CompileCache;
+            # their compile surface is the per-config jit caches.
+            probe = getattr(self._backend, "compile_probe", None)
+            if probe is not None:
+                return int(probe())
         return int(_batch.compile_cache(self._backend).stats()["compiles"])
 
 

@@ -395,6 +395,37 @@ def _committee_rows_of(name: str, doc) -> list:
     return rows
 
 
+def _fused_rows_of(name: str, doc) -> list:
+    """Schema-v1.11 ``fused`` blocks of one artifact: (path, configs,
+    mismatches, A/B rows, steady-state compiles, device of record) rows —
+    the ledger's ABI v6 fused-kernel columns. ``device_of_record`` is the
+    round-20 debt field: "interpret/cpu" until the bit-match re-runs on a
+    real TPU, and the ledger keeps naming that debt until it does."""
+    from byzantinerandomizedconsensus_tpu.obs import record as _record
+
+    rows = []
+    for path, fb in _blocks_of(doc, "fused", _record.FUSED_BLOCK_KEYS):
+        ab = fb.get("rows") if isinstance(fb.get("rows"), list) else []
+        ratios = [r.get("bytes_ratio") for r in ab
+                  if isinstance(r, dict)
+                  and isinstance(r.get("bytes_ratio"), (int, float))]
+        rows.append({
+            "artifact": name,
+            "path": path,
+            "configs": fb.get("configs"),
+            "mismatches": fb.get("mismatches"),
+            "ab_rows": len(ab),
+            "mean_bytes_ratio": (round(sum(ratios) / len(ratios), 4)
+                                 if ratios else None),
+            "steady_state_compiles": fb.get("steady_state_compiles"),
+            "device_of_record": fb.get("device_of_record"),
+            # the debt bit the report renders: a fused claim whose bit-match
+            # has not yet run on the device of record
+            "device_debt": fb.get("device_of_record") not in (None, "tpu"),
+        })
+    return rows
+
+
 def sentinel_verdict(bench: dict, wall_chain: list,
                      programs_rows: list) -> dict:
     """The ``--check`` verdict: wall-chain regressions past
@@ -642,6 +673,13 @@ def build_ledger(root=None) -> dict:
     for name, doc in sorted(docs.items()):
         committee_rows.extend(_committee_rows_of(name, doc))
 
+    # ---- fused-kernel columns (schema v1.11, round 20): every committed
+    # artifact carrying an ABI v6 fused A/B block, with its
+    # device-of-record debt bit.
+    fused_rows = []
+    for name, doc in sorted(docs.items()):
+        fused_rows.extend(_fused_rows_of(name, doc))
+
     from byzantinerandomizedconsensus_tpu.obs import record
 
     return {
@@ -661,6 +699,7 @@ def build_ledger(root=None) -> dict:
         "hunt_rows": hunt_rows,
         "hostile_rows": hostile_rows,
         "committee_rows": committee_rows,
+        "fused_rows": fused_rows,
         "bench_rounds": {str(r): bench[r] for r in rounds_seen},
         "wall_chain": chain,
         "device_chain": device_chain,
@@ -839,6 +878,22 @@ def format_report(doc: dict) -> str:
                 f"checker n={row['checker_n']} {chk_s}, "
                 f"serve {row['serve_steady_state_compiles']} steady-state "
                 f"compiles, offline bitmatch {row['serve_offline_bitmatch']}")
+    # Present only once an artifact carries the v1.11 fused block.
+    if doc.get("fused_rows"):
+        lines.append("fused-kernel columns (schema v1.11 — artifact[path]: "
+                     "configs mismatches A/B-rows mean-bytes-ratio "
+                     "steady-state compiles device-of-record):")
+        for row in doc["fused_rows"]:
+            lines.append(
+                f"  {row['artifact']}[{row['path']}]: "
+                f"{row['configs']} configs, "
+                f"{row['mismatches']} mismatches, "
+                f"{row['ab_rows']} A/B rows, "
+                f"mean bytes ratio {row['mean_bytes_ratio']}, "
+                f"{row['steady_state_compiles']} steady-state compiles, "
+                f"device of record {row['device_of_record']}"
+                + (" — DEBT: bit-match not yet re-run on TPU"
+                   if row["device_debt"] else ""))
     sent = doc.get("sentinel")
     if sent is not None:
         lines.append(

@@ -1,7 +1,7 @@
 """``brc-tpu programs`` — consumers of the compiled-program census
 (obs/programs.py; round 13).
 
-Four verbs:
+Five verbs:
 
 - ``dump SRC`` — render the schema-v1.4 ``programs`` block(s) of an artifact
   (or of a census JSON written by ``census``) as a table: program key, HLO
@@ -17,6 +17,16 @@ Four verbs:
   dispatches, wall, arithmetic intensity (flops/byte) and achieved
   GFLOP/s / GB/s per program. The default trace file is the one the
   artifact's own ``trace`` block names, resolved next to the artifact.
+  ``--vs BASELINE`` joins each row against another artifact's census by
+  program key (label-format revisions normalized) and reports the
+  bytes/dispatch delta — the round-20 bytes-moved metric.
+- ``fused [--out ART]`` — the round-20 ABI v6 A/B + artifact producer:
+  xla vs fused over the closed fault × committee gates, results
+  bit-compared, a fresh-seed pass pinning zero steady-state recompiles
+  (the seed rides the ABI v6 key plane), bytes/dispatch per config from
+  the census cost analysis; emits a schema-v1.11 run record
+  (kind="fused_roofline", fused + programs + trace blocks) — committed
+  as ``artifacts/fused_r20.json`` (+ ``fused_r20.jsonl``).
 - ``census`` — the round-13 A/B + artifact producer: the seeded chaos grid
   (tools/bench_batch.chaos_grid) through the fused lanes census-on vs
   census-off, best-of-N walls each, results bit-compared, plus an untimed
@@ -190,6 +200,50 @@ def roofline_rows(entries: dict, events) -> list:
     return rows
 
 
+def _canon_label(key: str) -> str:
+    """Normalize a census key across label-format revisions for the ``--vs``
+    baseline join: the trailing kernel segment (``/k<kernel>``, round 20)
+    and the per-run ``f``/``w``/``i``/``s`` segments (fault budget, crash
+    window, instances, seed — added to ``config_label`` after r13) are
+    dropped, so a current label finds its r13-era baseline entry. ``n``/
+    ``c``/``p`` segments (size, cap, pack law) always survive — they change
+    the compiled program."""
+    import re
+
+    parts = key.split("/")
+    if parts and re.fullmatch(r"k(xla|xla_nosort|pallas|fused)", parts[-1]):
+        parts = parts[:-1]
+    return "/".join(p for p in parts if not re.fullmatch(r"[fwis]\d+", p))
+
+
+def baseline_delta_rows(rows: list, base_entries: dict) -> list:
+    """Join roofline rows against a baseline census by program key — exact
+    key first, then the :func:`_canon_label` normalization — and annotate
+    each matched row with the baseline bytes/dispatch and the fractional
+    delta (negative = fewer bytes moved than the baseline program)."""
+    base_canon: dict = {}
+    for k in sorted(base_entries):
+        base_canon.setdefault(_canon_label(k), k)
+    out = []
+    for row in rows:
+        bk = row["key"] if row["key"] in base_entries else \
+            base_canon.get(_canon_label(row["key"]))
+        row = dict(row)
+        if bk is None:
+            row["baseline_key"] = None
+            out.append(row)
+            continue
+        base_bytes = ((base_entries[bk].get("cost") or {})
+                      .get("bytes_accessed"))
+        row["baseline_key"] = bk
+        row["baseline_bytes_per_dispatch"] = base_bytes
+        if base_bytes and row.get("bytes_per_dispatch") is not None:
+            row["bytes_delta_fraction"] = round(
+                row["bytes_per_dispatch"] / base_bytes - 1.0, 4)
+        out.append(row)
+    return out
+
+
 def cmd_roofline(args) -> int:
     try:
         entries = _programs_of(args.census)
@@ -216,8 +270,27 @@ def cmd_roofline(args) -> int:
         print(f"cannot read trace {trace_path!r}: {e}", file=sys.stderr)
         return 2
     rows = roofline_rows(entries, events)
+    vs = None
+    if args.vs:
+        try:
+            base_entries = _programs_of(args.vs)
+        except (OSError, ValueError) as e:
+            print(f"cannot read baseline census {args.vs!r}: {e}",
+                  file=sys.stderr)
+            return 2
+        rows = baseline_delta_rows(rows, base_entries)
+        matched = [r for r in rows if r.get("baseline_key")]
+        deltas = [r["bytes_delta_fraction"] for r in matched
+                  if "bytes_delta_fraction" in r]
+        vs = {"baseline": str(args.vs), "rows": len(rows),
+              "matched": len(matched),
+              "mean_bytes_delta_fraction":
+                  (round(sum(deltas) / len(deltas), 4) if deltas else None)}
     if args.json:
-        print(json.dumps({"rows": rows}, indent=1))
+        out = {"rows": rows}
+        if vs is not None:
+            out["vs"] = vs
+        print(json.dumps(out, indent=1))
         return 0
     print(f"roofline join — {len(rows)} dispatched program(s), "
           f"{len(entries)} in census ({args.census} x {trace_path})")
@@ -231,6 +304,18 @@ def cmd_roofline(args) -> int:
               + (f", {row['gbytes_per_s']} GB/s"
                  if "gbytes_per_s" in row else "")
               + ("" if row["in_census"] else "  [NOT IN CENSUS]"))
+        if row.get("baseline_key"):
+            print(f"    vs {row['baseline_key']}: "
+                  f"{_fmt_bytes(row.get('baseline_bytes_per_dispatch'))} "
+                  "baseline bytes/dispatch"
+                  + (f", delta {row['bytes_delta_fraction']:+.1%}"
+                     if "bytes_delta_fraction" in row else ""))
+    if vs is not None:
+        mean = vs["mean_bytes_delta_fraction"]
+        print(f"vs {vs['baseline']}: {vs['matched']}/{vs['rows']} row(s) "
+              "matched, mean bytes/dispatch delta "
+              + (f"{mean:+.1%}" if mean is not None else "n/a"))
+        print(json.dumps(vs, indent=1))
     return 0
 
 
@@ -369,6 +454,156 @@ def cmd_census(args) -> int:
     return 0 if ok else 1
 
 
+# ---------------------------------------------------------------------------
+# fused — the round-20 ABI v6 A/B + artifact producer
+
+
+def _fused_grid():
+    """The fused-A/B config list. The first entry reproduces (modulo the
+    label segments added after r13) the one count-level program of the
+    committed r13 census that the fused kernel can run — the ``--vs``
+    baseline join lands on it — and the rest spread the closed gates:
+    every §9 fault kind and the §10 committee family."""
+    from byzantinerandomizedconsensus_tpu.config import SimConfig
+
+    return [
+        SimConfig(protocol="bracha", n=6, f=1, instances=8,
+                  adversary="adaptive", coin="shared", init="split", seed=7,
+                  round_cap=64, delivery="urn2", faults="recover",
+                  crash_window=4).validate(),
+        SimConfig(protocol="benor", n=8, f=1, instances=12,
+                  adversary="crash", coin="shared", init="random", seed=11,
+                  round_cap=32, delivery="urn").validate(),
+        SimConfig(protocol="bracha", n=8, f=1, instances=10,
+                  adversary="none", coin="local", init="all1", seed=5,
+                  round_cap=32, delivery="urn3",
+                  faults="omission").validate(),
+        SimConfig(protocol="benor", n=12, f=2, instances=8,
+                  adversary="adaptive_min", coin="shared", init="random",
+                  seed=9, round_cap=48, delivery="urn",
+                  faults="partition").validate(),
+        SimConfig(protocol="benor", n=64, f=2, instances=6,
+                  adversary="byzantine", coin="shared", init="random",
+                  seed=3, round_cap=48, delivery="committee").validate(),
+    ]
+
+
+def cmd_fused(args) -> int:
+    """xla-vs-fused A/B over the ABI v6 surface: bit-match pin, per-config
+    bytes/dispatch from the census cost analysis, the zero-steady-state-
+    recompile pin, all recorded as the schema-v1.11 ``fused`` block —
+    committed as ``artifacts/fused_r20.json`` (+ ``.jsonl``, the trace the
+    roofline verb joins against)."""
+    import dataclasses
+
+    import numpy as np
+
+    from byzantinerandomizedconsensus_tpu.backends.jax_backend import (
+        JaxBackend)
+    from byzantinerandomizedconsensus_tpu.obs import record
+    from byzantinerandomizedconsensus_tpu.ops import prf
+    from byzantinerandomizedconsensus_tpu.utils.devices import (
+        ensure_live_backend)
+
+    ensure_live_backend()
+    import jax
+
+    cfgs = _fused_grid()
+    out = pathlib.Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    trace_path = out.with_suffix(".jsonl")
+    trace_path.unlink(missing_ok=True)
+
+    be_xla = JaxBackend()
+    be_fused = JaxBackend(kernel="fused")
+
+    _programs.configure()
+    _trace.configure(path=trace_path)
+    t0 = time.perf_counter()
+    mismatches = 0
+    pairs = []
+    for cfg in cfgs:
+        a = be_xla.run(cfg)
+        b = be_fused.run(cfg)
+        same = (np.array_equal(a.rounds, b.rounds)
+                and np.array_equal(a.decision, b.decision))
+        mismatches += 0 if same else 1
+        pairs.append((cfg, same))
+        print(f"  {be_fused._census_label(cfg)}: "
+              f"bit_identical={same}", flush=True)
+    # The steady-state pin: every config again at a fresh seed — the
+    # seed rides the ABI v6 key plane as an operand, so the per-config
+    # jit caches must not grow.
+    probe0 = be_fused.compile_probe()
+    for cfg, _ in pairs:
+        re_cfg = dataclasses.replace(cfg, seed=cfg.seed + 1000).validate()
+        a = be_xla.run(re_cfg)
+        b = be_fused.run(re_cfg)
+        mismatches += 0 if (np.array_equal(a.rounds, b.rounds) and
+                            np.array_equal(a.decision, b.decision)) else 1
+    steady = be_fused.compile_probe() - probe0
+    wall = time.perf_counter() - t0
+    _trace.disable()
+
+    census = {**be_xla.program_census(), **be_fused.program_census()}
+    programs_block = record.programs_block()
+    rows = []
+    for cfg, same in pairs:
+        kx = be_xla._census_label(cfg)
+        kf = be_fused._census_label(cfg)
+        bx = ((census.get(kx) or {}).get("cost") or {}).get("bytes_accessed")
+        bf = ((census.get(kf) or {}).get("cost") or {}).get("bytes_accessed")
+        # The two legs dispatch different chunk widths (xla: the request
+        # size; fused: the power-of-two clamp), so the apples-to-apples
+        # number is bytes per *instance*, alongside the raw per-dispatch
+        # figure the --vs baseline join reads.
+        wx = min(be_xla._chunk_size(cfg), cfg.instances)
+        wf = be_fused._clamp_chunk(
+            cfg, min(be_fused._chunk_size(cfg), cfg.instances))
+        row = {"key": kf, "baseline_key": kx, "bit_identical": same,
+               "xla_bytes_per_dispatch": bx,
+               "fused_bytes_per_dispatch": bf,
+               "xla_dispatch_instances": wx,
+               "fused_dispatch_instances": wf}
+        if bx and bf is not None:
+            row["bytes_ratio"] = round(bf / bx, 4)
+            row["bytes_per_instance_ratio"] = round(
+                (bf / wf) / (bx / wx), 4)
+        rows.append(row)
+    stats = {
+        "configs": len(cfgs),
+        "mismatches": mismatches,
+        "rows": rows,
+        "steady_state_compiles": steady,
+        "device_of_record": ("tpu" if jax.default_backend() == "tpu"
+                             else "interpret/cpu"),
+        "state_pack": {"version": prf.FUSED_STATE_PACK_VERSION,
+                       "bits": {k: list(v) for k, v in
+                                sorted(prf.FUSED_STATE_BITS.items())}},
+        "duration_s": round(wall, 2),
+    }
+    doc = {
+        **record.new_record("fused_roofline"),
+        "description": "ABI v6 fused round kernel A/B (ops/pallas_round.py; "
+                       "round 20): xla vs fused over the closed fault x "
+                       "committee gates, bit-match and steady-compile pins, "
+                       "bytes/dispatch from the census cost analysis",
+        "fused": record.fused_block(stats),
+        "programs": programs_block,
+        "trace": record.trace_block(trace_path),
+    }
+    _programs.disable()
+    out.write_text(json.dumps(doc, indent=1) + "\n")
+    ratios = [r["bytes_ratio"] for r in rows if "bytes_ratio" in r]
+    summary = {"out": str(out), "configs": len(cfgs),
+               "mismatches": mismatches, "steady_state_compiles": steady,
+               "mean_bytes_ratio": (round(sum(ratios) / len(ratios), 4)
+                                    if ratios else None)}
+    print(json.dumps(summary))
+    return 0 if (mismatches == 0 and steady == 0
+                 and programs_block is not None) else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -397,6 +632,11 @@ def main(argv=None) -> int:
                            "spans (default: the file the artifact's trace "
                            "block names, next to the artifact)")
     p_ro.add_argument("--json", action="store_true")
+    p_ro.add_argument("--vs", default=None, metavar="ART",
+                      help="baseline artifact with a programs block: "
+                           "annotate each row with the baseline program's "
+                           "bytes/dispatch and the fractional delta "
+                           "(label-format revisions are normalized)")
     p_ro.set_defaults(fn=cmd_roofline)
 
     p_ce = sub.add_parser("census",
@@ -413,6 +653,13 @@ def main(argv=None) -> int:
 
     p_ce.add_argument("--out", default=default_artifact("programs"))
     p_ce.set_defaults(fn=cmd_census)
+
+    p_fu = sub.add_parser("fused",
+                          help="ABI v6 fused-kernel A/B (xla vs fused over "
+                               "the closed fault x committee gates; the "
+                               "round-20 artifact)")
+    p_fu.add_argument("--out", default="artifacts/fused_r20.json")
+    p_fu.set_defaults(fn=cmd_fused)
 
     args = ap.parse_args(argv)
     return args.fn(args)

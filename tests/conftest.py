@@ -58,6 +58,24 @@ def pytest_configure(config):
 
 
 # ---------------------------------------------------------------------------
+# Shared interpret-mode switch for every Pallas test (round 20). The suites
+# previously each hard-coded `interpret=True`; the one shared fixture keeps
+# them honest about WHY (no TPU in the test process — see the CPU pinning at
+# the top of this file) and flips to compiled Mosaic automatically if a test
+# box ever does run with a real TPU backend.
+
+import pytest
+
+
+@pytest.fixture(scope="session")
+def pallas_interpret() -> bool:
+    """True when Pallas kernels must run in interpret mode (non-TPU backend)."""
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
 # Tier-1 wall-clock budget guard (round 19). The CI driver runs the tier-1
 # selection (-m 'not slow') under `timeout -k 10 870`; the suite must keep
 # >= 15% headroom under that ceiling so one slow box or one new test does

@@ -634,3 +634,53 @@ def test_census_includes_committee_artifact():
     report = ledger.format_report(doc)
     assert "committee cost-curve columns" in report
     assert "offline bitmatch True" in report
+
+
+def test_census_includes_fused_artifact():
+    """The round-20 fused-kernel artifact: parsed with zero errors, every
+    ABI v6 A/B config bit-identical at zero steady-state compiles, the
+    resident-state pack law on the record, and the schema-v1.11 fused
+    columns reconstructed by the ledger — including the device-of-record
+    debt row ("interpret/cpu" until the bit-match re-runs on a TPU)."""
+    import json
+    import pathlib
+
+    from byzantinerandomizedconsensus_tpu.ops import prf
+    from byzantinerandomizedconsensus_tpu.utils.rounds import repo_root
+
+    doc = ledger.build_ledger()
+    assert doc["parse_errors"] == []
+    rows = {r["artifact"]: r for r in doc["fused_rows"]}
+    assert "artifacts/fused_r20.json" in rows, \
+        "fused_r20.json must yield fused-kernel columns"
+    row = rows["artifacts/fused_r20.json"]
+    assert row["configs"] == 5               # every closed gate + control
+    assert row["mismatches"] == 0            # the round's bit-match claim
+    assert row["ab_rows"] == 5
+    assert row["steady_state_compiles"] == 0
+    assert row["device_of_record"] == "interpret/cpu"
+    assert row["device_debt"] is True        # the ledger names the debt
+
+    fv = json.loads((pathlib.Path(repo_root())
+                     / "artifacts/fused_r20.json").read_text())
+    assert fv["kind"] == "fused_roofline"
+    assert record.validate_record(fv) == []
+    assert fv["record_revision"] >= 11  # schema v1.11
+    fb = fv["fused"]
+    # The committed pack law matches this build's (any relayout must bump
+    # FUSED_STATE_PACK_VERSION and re-capture the artifact).
+    assert fb["state_pack"] == {
+        "version": prf.FUSED_STATE_PACK_VERSION,
+        "bits": {k: list(v) for k, v in prf.FUSED_STATE_BITS.items()}}
+    assert all(r["bit_identical"] for r in fb["rows"])
+    # Every A/B row joins the r13-style census: a kfused key vs an xla
+    # baseline key, both with bytes/dispatch from the cost analysis.
+    for r in fb["rows"]:
+        assert r["key"].endswith("/kfused")
+        assert r["baseline_key"] and "kfused" not in r["baseline_key"]
+        assert r["fused_bytes_per_dispatch"] > 0
+        assert r["xla_bytes_per_dispatch"] > 0
+
+    report = ledger.format_report(doc)
+    assert "fused-kernel columns" in report
+    assert "DEBT: bit-match not yet re-run on TPU" in report

@@ -18,6 +18,19 @@ def test_env_fingerprint_fields():
     assert env["native_abi"] == 5  # native/simcore.cpp sim_abi_version
 
 
+def test_env_fingerprint_packing_law_fields():
+    """Schema v1.11: the fingerprint records every packing identity this
+    build speaks — the per-step Pallas laws (stop at v2) AND the fused
+    round kernel's resident-state word (ABI v6, spec §A6). Any relayout
+    must bump FUSED_STATE_PACK_VERSION, so artifacts stay joinable by law."""
+    env = record.env_fingerprint()
+    assert env["pallas_pack_versions"] == [1, 2]
+    fsp = env["fused_state_pack"]
+    assert fsp["version"] == 1
+    assert fsp["bits"] == {"est": [0, 2], "decided": [2, 1],
+                           "decided_val": [3, 2], "phase": [8, 24]}
+
+
 def test_new_record_validates():
     doc = record.new_record("bench", description="x", config=preset("config1"))
     assert record.validate_record(doc) == []
@@ -52,7 +65,8 @@ def test_validate_record_rejects_unknown_revision():
                                            "record_revision": bad})), bad
     # Every revision this build knows — including the legacy implied-v1
     # absence — stays valid.
-    for ok in (None, 0, 1, 2, 3, 4, 5, 6, 7, record.RECORD_REVISION):
+    for ok in (None, 0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10,
+               record.RECORD_REVISION):
         doc = record.new_record("x")
         if ok is None:
             doc.pop("record_revision")
@@ -184,6 +198,38 @@ def test_validate_record_checks_hunt_block():
     assert record.hunt_block(None) is None
 
 
+def test_validate_record_checks_fused_block():
+    """Schema v1.11: a fused block missing its required keys (or with rows
+    that lack the census-label / bytes-per-dispatch join fields) fails by
+    name; the ``programs fused`` verb's own block validates."""
+    bad = {**record.new_record("fused_roofline"), "fused": {"configs": 5}}
+    problems = record.validate_record(bad)
+    assert any("fused block missing 'mismatches'" in p for p in problems)
+    assert any("'device_of_record'" in p for p in problems)
+    assert any(p.startswith("fused block is not a dict") for p in
+               record.validate_record(
+                   {**record.new_record("fused_roofline"), "fused": []}))
+
+    stats = {
+        "configs": 5, "mismatches": 0, "device_of_record": "interpret/cpu",
+        "steady_state_compiles": 0,
+        "state_pack": {"version": 1},
+        "rows": [{"key": "benor/n8/...", "xla_bytes_per_dispatch": 100.0,
+                  "fused_bytes_per_dispatch": 40.0, "bytes_ratio": 0.4}],
+        "bytes_total": 140.0, "duration_s": 1.0}
+    good = {**record.new_record("fused_roofline"),
+            "fused": record.fused_block(stats)}
+    assert record.validate_record(good) == []
+    assert good["fused"]["state_pack"] == {"version": 1}  # optionals ride
+
+    torn = {**good, "fused": {**record.fused_block(stats),
+                              "rows": [{"key": "x"}]}}
+    assert any("fused row 0" in p for p in record.validate_record(torn)), \
+        record.validate_record(torn)
+
+    assert record.fused_block(None) is None
+
+
 def test_timing_block_maps_suspect_to_error():
     """Absence-of-signal device 0.0s must land as errors (VERDICT r5 weak #1),
     real measurements as device_busy_s — the one mapping every tool shares."""
@@ -290,3 +336,4 @@ def test_schema_census_every_committed_artifact_validates():
     assert "metrics_r16.json" in checked, checked
     assert "hunt_r17.json" in checked, checked
     assert "hunt_regressions.json" in checked, checked
+    assert "fused_r20.json" in checked, checked  # the v1.11 fused block

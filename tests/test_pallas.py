@@ -86,7 +86,7 @@ def test_kth_smallest_matches_sort():
         np.testing.assert_array_equal(got, want)
 
 
-def test_smallest_k_mask_vs_sort_with_tie_classes():
+def test_smallest_k_mask_vs_sort_with_tie_classes(pallas_interpret):
     """_smallest_k_mask == argsort top-k on crafted keys with dense top-22
     collisions (the tie-resolution path that full-key thresholding never
     stresses at random: P[top22 collision] = 2^-20 per pair)."""
@@ -105,7 +105,7 @@ def test_smallest_k_mask_vs_sort_with_tie_classes():
 
         out = pl.pallas_call(
             kern, out_shape=jax.ShapeDtypeStruct(keys.shape, jnp.int32),
-            interpret=True)(jnp.asarray(keys))
+            interpret=pallas_interpret)(jnp.asarray(keys))
         return np.asarray(out).astype(bool)
 
     rng = np.random.default_rng(99)

@@ -1,0 +1,151 @@
+"""Gate-closure pins for the fused round kernel (ops/pallas_round.py, ABI v6).
+
+Round 20: every fault × committee config below used to raise
+``FaultsUnsupported`` / ``CommitteeUnsupported`` on the Pallas path (the
+per-step kernels have no fault-schedule or committee channel). The fused
+kernel carries both in-kernel, so the same configs now run on
+``kernel='fused'`` and must bit-match the XLA oracle — on CPU the kernel
+runs in Pallas interpret mode (see the ``pallas_interpret`` fixture), which
+is exactly how the bit-match is provable in CI. The per-step kernels keep
+their gates: closing one door must not silently unlock the others.
+"""
+
+import numpy as np
+import pytest
+
+from byzantinerandomizedconsensus_tpu.backends.base import get_backend
+from byzantinerandomizedconsensus_tpu.config import SimConfig
+from byzantinerandomizedconsensus_tpu.models.committee import CommitteeUnsupported
+from byzantinerandomizedconsensus_tpu.models.faults import FaultsUnsupported
+from byzantinerandomizedconsensus_tpu.ops.pallas_round import FusedUnsupported
+
+
+# Previously-gated surface, one config per closed gate: every §9 fault kind
+# (recover / omission / partition) and the §10 committee family, plus a
+# fault-free control. Kept small — whole-round interpret mode pays per-op
+# eager dispatch, so instance counts stay in one 8-block where possible.
+GATED_GRID = [
+    SimConfig(protocol="bracha", n=6, f=1, instances=8,
+              adversary="adaptive", coin="shared", init="split", seed=7,
+              round_cap=64, delivery="urn2", faults="recover",
+              crash_window=4),
+    SimConfig(protocol="benor", n=8, f=1, instances=12,
+              adversary="crash", coin="shared", init="random", seed=11,
+              round_cap=32, delivery="urn"),
+    SimConfig(protocol="bracha", n=8, f=1, instances=10,
+              adversary="none", coin="local", init="all1", seed=5,
+              round_cap=32, delivery="urn3", faults="omission"),
+    SimConfig(protocol="benor", n=12, f=2, instances=8,
+              adversary="adaptive_min", coin="shared", init="random",
+              seed=9, round_cap=48, delivery="urn", faults="partition"),
+    SimConfig(protocol="benor", n=64, f=2, instances=6,
+              adversary="byzantine", coin="shared", init="random",
+              seed=3, round_cap=48, delivery="committee"),
+]
+
+
+@pytest.mark.parametrize(
+    "cfg", GATED_GRID,
+    ids=[f"{c.protocol}-n{c.n}-{c.delivery}-{c.adversary}-f{c.faults}"
+         for c in GATED_GRID])
+def test_fused_closes_fault_and_committee_gates(cfg, pallas_interpret):
+    """Configs the per-step Pallas path rejects run on kernel='fused' and
+    bit-match the XLA oracle (rounds AND decision, every instance)."""
+    assert pallas_interpret, "suite is pinned to CPU interpret mode"
+    cfg = cfg.validate()
+    a = get_backend("jax").run(cfg)
+    b = get_backend("jax_fused").run(cfg)
+    np.testing.assert_array_equal(a.rounds, b.rounds)
+    np.testing.assert_array_equal(a.decision, b.decision)
+
+
+@pytest.mark.parametrize("backend_cfg,exc", [
+    (SimConfig(protocol="benor", n=6, f=1, instances=4, adversary="none",
+               coin="local", round_cap=8, seed=0, delivery="urn",
+               faults="recover", crash_window=4), FaultsUnsupported),
+    (SimConfig(protocol="benor", n=16, f=1, instances=4, adversary="none",
+               coin="shared", round_cap=8, seed=0,
+               delivery="committee"), CommitteeUnsupported),
+], ids=["faults", "committee"])
+def test_per_step_pallas_gates_still_raise(backend_cfg, exc):
+    """The per-step kernel path keeps its named gates — the fused kernel
+    closing them must not silently change kernel='pallas' behavior."""
+    with pytest.raises(exc, match="kernel='pallas'"):
+        get_backend("jax_pallas").run(backend_cfg.validate())
+
+
+def test_fused_unsupported_names_the_surface():
+    """Outside the ABI v6 surface the fused kernel raises one named error
+    that lists the whole supported surface (never a silent fallback)."""
+    cfg = SimConfig(protocol="benor", n=7, f=3, instances=4,
+                    adversary="none", coin="shared", round_cap=8,
+                    seed=0).validate()  # delivery='keys' (superset lanes)
+    with pytest.raises(FusedUnsupported) as ei:
+        get_backend("jax_fused").run(cfg)
+    msg = str(ei.value)
+    assert "delivery='keys'" in msg
+    for named in ("urn", "urn2", "urn3", "committee",   # deliveries
+                  "adaptive_min", "recover", "partition", "omission"):
+        assert named in msg, f"surface must name {named!r}"
+
+
+def test_packed_state_word_roundtrip_and_layout():
+    """The resident u32 state word round-trips and its bit layout matches
+    the published prf.FUSED_STATE_BITS record (spec §A6; any relayout must
+    bump FUSED_STATE_PACK_VERSION)."""
+    import jax.numpy as jnp
+
+    from byzantinerandomizedconsensus_tpu.ops import prf
+    from byzantinerandomizedconsensus_tpu.ops.pallas_round import (
+        _pack_state, _unpack_state)
+
+    assert prf.FUSED_STATE_PACK_VERSION == 1
+    assert prf.FUSED_STATE_BITS == {"est": (0, 2), "decided": (2, 1),
+                                    "decided_val": (3, 2), "phase": (8, 24)}
+
+    rng = np.random.default_rng(20)
+    st = {
+        "est": jnp.asarray(rng.integers(0, 2, 64, dtype=np.uint8)),
+        "decided": jnp.asarray(rng.integers(0, 2, 64).astype(bool)),
+        "decided_val": jnp.asarray(rng.integers(0, 2, 64, dtype=np.uint8)),
+        "phase": jnp.asarray(rng.integers(0, 1 << 20, 64, dtype=np.int32)),
+    }
+    word = _pack_state(st)
+    assert word.dtype == jnp.uint32
+    back = _unpack_state(word)
+    for k in st:
+        np.testing.assert_array_equal(np.asarray(back[k]), np.asarray(st[k]),
+                                      err_msg=k)
+    # Layout pin: each field lands at its published (shift, width) slot.
+    w = np.asarray(word).astype(np.uint64)
+    for field, (shift, width) in prf.FUSED_STATE_BITS.items():
+        got = (w >> np.uint64(shift)) & np.uint64((1 << width) - 1)
+        want = np.asarray(st[field]).astype(np.uint64)
+        np.testing.assert_array_equal(got, want, err_msg=field)
+
+
+def test_fused_compile_cache_is_seed_and_request_size_independent():
+    """The serve pin: the key rides as an operand plane and chunks clamp to
+    power-of-two bins, so new seeds / new instance counts inside a warmed
+    bin compile nothing (zero steady-state recompiles)."""
+    from byzantinerandomizedconsensus_tpu.backends.jax_backend import JaxBackend
+
+    be = JaxBackend(kernel="fused")
+    base = SimConfig(protocol="benor", n=6, f=1, instances=5,
+                     adversary="crash", coin="shared", round_cap=16,
+                     seed=1, delivery="urn").validate()
+    warm = be.run(base)
+    warmed = be.compile_probe()
+    assert warmed >= 1  # the warm-up did compile something
+
+    import dataclasses
+    for seed, instances in ((2, 5), (3, 7), (40, 3), (2, 8)):
+        cfg = dataclasses.replace(base, seed=seed,
+                                  instances=instances).validate()
+        out = be.run(cfg)
+        assert len(out.decision) == instances
+    assert be.compile_probe() == warmed, "steady-state recompile on the fused path"
+    # and the warm-up result itself stays the oracle's
+    oracle = get_backend("jax").run(base)
+    np.testing.assert_array_equal(warm.rounds, oracle.rounds)
+    np.testing.assert_array_equal(warm.decision, oracle.decision)

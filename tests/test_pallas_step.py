@@ -84,12 +84,13 @@ URN_STEP = [
     "cfg,n_rounds", [pytest.param(c, r, marks=[pytest.mark.slow] if s else [],
                                   id=f"{c.protocol}-n{c.n}f{c.f}-{c.adversary}")
                      for c, r, s in URN_STEP])
-def test_urn_kernel_steps(cfg, n_rounds):
+def test_urn_kernel_steps(cfg, n_rounds, pallas_interpret):
     """Pallas urn kernel == XLA urn path through the real round body."""
     from byzantinerandomizedconsensus_tpu.ops import pallas_urn
 
     _assert_rounds_equal(
-        cfg, None, functools.partial(pallas_urn.counts_fn, interpret=True),
+        cfg, None,
+        functools.partial(pallas_urn.counts_fn, interpret=pallas_interpret),
         n_rounds=n_rounds)
 
 
@@ -118,18 +119,19 @@ KEYS_STEP = [
     "cfg,n_rounds", [pytest.param(c, r, marks=[pytest.mark.slow] if s else [],
                                   id=f"{c.protocol}-n{c.n}f{c.f}-{c.adversary}")
                      for c, r, s in KEYS_STEP])
-def test_keys_kernel_steps(cfg, n_rounds):
+def test_keys_kernel_steps(cfg, n_rounds, pallas_interpret):
     """Fused Pallas selection+tally kernel == XLA masks+tally path through the
     real round body (incl. the tile-boundary shapes)."""
     from byzantinerandomizedconsensus_tpu.ops import pallas_tally
 
     _assert_rounds_equal(
-        cfg, None, functools.partial(pallas_tally.counts_fn, interpret=True),
+        cfg, None,
+        functools.partial(pallas_tally.counts_fn, interpret=pallas_interpret),
         n_rounds=n_rounds)
 
 
 @pytest.mark.parametrize("lo,hi", [(0, 5), (5, 11), (11, 16)])
-def test_urn_kernel_receiver_shard_offsets(lo, hi):
+def test_urn_kernel_receiver_shard_offsets(lo, hi, pallas_interpret):
     """Direct counts_fn comparison on receiver sub-ranges: the Pallas urn
     kernel's recv_offset path (incl. the two-faced class boundary at
     (n+1)//2 = 8) must match ops/urn.py for every shard."""
@@ -151,6 +153,6 @@ def test_urn_kernel_receiver_shard_offsets(lo, hi):
     b0, b1 = pallas_urn.counts_fn(
         cfg, cfg.seed, jnp.asarray(inst), 1, 0, jnp.asarray(honest),
         jnp.asarray(silent), jnp.asarray(faulty), jnp.asarray(honest),
-        recv_ids=jnp.asarray(recv), interpret=True)
+        recv_ids=jnp.asarray(recv), interpret=pallas_interpret)
     np.testing.assert_array_equal(a0, np.asarray(b0))
     np.testing.assert_array_equal(a1, np.asarray(b1))
