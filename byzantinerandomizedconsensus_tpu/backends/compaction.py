@@ -169,20 +169,40 @@ class WorkFeed:
         self._cancelled: list = []
         self._cv = threading.Condition()
         self._closed = False
+        # Tokens of live sessions (spec §11) that own this feed: a session's
+        # future slots materialize at the grid's retire seam, not here, so
+        # "queue empty + closed" is NOT "drained" while an owner lives —
+        # pull() keeps the stream open until every owner finishes
+        # (session_done) or is cancelled.
+        self._owner_tokens: list = []
 
-    def push(self, cfg, ids=None, token=None, force: bool = False) -> None:
+    def _release_owner(self, token) -> None:
+        """Drop ``token`` from the live-session owners (identity match,
+        idempotent — a cancel and a reap may both report the same death)."""
+        self._owner_tokens = [t for t in self._owner_tokens
+                              if t is not token]
+
+    def push(self, cfg, ids=None, token=None, force: bool = False,
+             session=None) -> None:
         """Enqueue one config (its instances become queued lane work).
         ``ids`` defaults to the config's full instance range; ``token`` is
         returned verbatim to ``on_retire`` when the config completes.
         ``force=True`` bypasses the ``max_depth`` bound — the server's
         rotation seed uses it, because seeded requests were admitted
-        before this feed existed (round 18)."""
+        before this feed existed (round 18). ``session=L`` marks the config
+        as slot 0 of an L-slot spec-§11 session: the grid re-seeds slots
+        1..L-1 in place at its retire seam, ``on_retire`` fires once per
+        slot with the same token, and the token owns the feed (it cannot
+        report drained) until the last slot retires or the session is
+        cancelled."""
         if cfg.round_cap > self.round_cap_ceiling:
             raise ValueError(
                 f"round_cap={cfg.round_cap} exceeds the feed ceiling "
                 f"{self.round_cap_ceiling}: the drain program is compiled "
                 "once per bucket at the ceiling, so admission must reject "
                 "or re-route larger caps")
+        session = None if session is None or int(session) <= 1 \
+            else int(session)
         with self._cv:
             if self._closed:
                 raise RuntimeError("push on a closed WorkFeed")
@@ -192,7 +212,9 @@ class WorkFeed:
                     f"WorkFeed depth {len(self._items)} at max_depth="
                     f"{self.max_depth}: producer must back off until the "
                     "grid drains")
-            self._items.append((cfg, ids, token))
+            self._items.append((cfg, ids, token, session))
+            if session is not None:
+                self._owner_tokens.append(token)
             self._cv.notify_all()
 
     def close(self) -> None:
@@ -223,10 +245,27 @@ class WorkFeed:
         here (the cheap case); False means the grid owns it now — or never
         saw it — and the boundary reap is the reclaim path. Survivors are
         bit-identical either way: lane placement never enters a draw.
+
+        Session ownership is released **only** for a session still queued
+        here (it died before its first slot reached a lane); a session
+        already flying keeps owning the feed until :func:`run_bucket`'s
+        boundary reap reports its death via :meth:`session_done`. The
+        round-21 edge case this ordering fixes: cancelling the last queued
+        config of a session-owned feed empties the queue, but must NOT make
+        a closed feed report drained (``pull() -> None``) while a different
+        session's future slots are still due from the grid — that would
+        close the feed out from under the dispatcher mid-session.
         """
         with self._cv:
             n = len(self._items)
-            self._items = [it for it in self._items if it[2] is not token]
+            kept = []
+            for it in self._items:
+                if it[2] is token:
+                    if it[3] is not None:
+                        self._release_owner(token)
+                else:
+                    kept.append(it)
+            self._items = kept
             self._cancelled.append(token)
             self._cv.notify_all()
             return len(self._items) < n
@@ -239,16 +278,36 @@ class WorkFeed:
             self._cancelled = []
             return out
 
+    def sessions(self) -> int:
+        """Live sessions owning this feed (queued or flying) — the serving
+        stats probe."""
+        with self._cv:
+            return len(self._owner_tokens)
+
+    def session_done(self, token) -> None:
+        """Release ``token``'s session ownership — :func:`run_bucket` calls
+        this when the session's last slot retires (or its lanes are reaped
+        after a cancel), letting a closed feed finally report drained."""
+        with self._cv:
+            self._release_owner(token)
+            self._cv.notify_all()
+
     def pull(self, block: bool = False):
         """Everything pushed since the last pull: a list of
-        ``(cfg, ids, token)`` items, ``[]`` when nothing is pending, or
-        ``None`` once the feed is closed *and* drained. ``block=True`` waits
-        for items or close — the idle server parks here."""
+        ``(cfg, ids, token, session)`` items, ``[]`` when nothing is
+        pending, or ``None`` once the feed is closed *and* drained.
+        ``block=True`` waits for items or close — the idle server parks
+        here. A feed owned by a live session is never drained: its future
+        slots materialize at the grid's retire seam, so pull keeps the
+        stream open (returns ``[]`` / keeps waiting) until every owner
+        retires its last slot or is cancelled."""
         with self._cv:
-            while block and not self._items and not self._closed:
+            while block and not self._items and not (
+                    self._closed and not self._owner_tokens):
                 self._cv.wait()
             if not self._items:
-                return None if self._closed else []
+                return (None if self._closed and not self._owner_tokens
+                        else [])
             out = self._items
             self._items = []
             return out
@@ -503,11 +562,21 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
     is called as ``on_retire(token, SimResult)`` the moment a config's last
     instance retires — replies stream out per request, not at grid end
     (tokens for the initial ``cfgs`` are their list indices).
+
+    Feed items pushed with ``session=L`` (spec §11) stay resident across
+    slots: when slot ``k``'s last instance retires, its ``on_retire`` fires
+    with that slot's SimResult and the retire seam immediately splices slot
+    ``k+1`` — the same config under the chained seed
+    (models/session.py::next_slot_config) — into the work stream, so the
+    next refill re-seeds the freed lanes in place. No admission round-trip,
+    no new program key (the seed is a dynamic operand), and each slot is
+    bit-identical to the offline ``run_session`` replay.
     """
     import jax
     import jax.numpy as jnp
 
     from byzantinerandomizedconsensus_tpu.backends.base import SimResult
+    from byzantinerandomizedconsensus_tpu.models import session as _session_mod
     from byzantinerandomizedconsensus_tpu.obs import counters as _c
 
     policy = (policy or CompactionPolicy()).validate()
@@ -528,6 +597,12 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
     rounds_out = [np.zeros(len(ids), dtype=np.int32) for ids in ids_list]
     dec_out = [np.zeros(len(ids), dtype=np.uint8) for ids in ids_list]
     total = sum(remaining)
+    # Spec-§11 session bookkeeping, parallel to cfgs: slots still owed
+    # (including the current one), the current slot index, and whether the
+    # entry owns its feed (must session_done on final retire or reap).
+    sess_left = [1] * len(cfgs)
+    sess_slot = [0] * len(cfgs)
+    sess_owner = [False] * len(cfgs)
 
     # The shared work stream: configs in input order, flattened to parallel
     # (config index, row position, instance id) arrays with a head pointer.
@@ -555,7 +630,7 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
         items = feed.pull(block=block)
         if items is None:
             return False
-        for cfg, ids, token in items:
+        for cfg, ids, token, session in items:
             cfg = cfg.validate()
             ids = (np.asarray(backend._resolve_inst_ids(cfg, None))
                    if ids is None else np.asarray(ids))
@@ -566,6 +641,9 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
             remaining.append(len(ids))
             rounds_out.append(np.zeros(len(ids), dtype=np.int32))
             dec_out.append(np.zeros(len(ids), dtype=np.uint8))
+            sess_left.append(int(session) if session else 1)
+            sess_slot.append(0)
+            sess_owner.append(session is not None)
             row = _host_op_row(bucket, cfg)
             for k in row:
                 v = np.asarray(row[k])[None]
@@ -578,10 +656,15 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
             work_iid = np.concatenate(
                 [work_iid, np.asarray(ids, dtype=np.uint32)])
             total += len(ids)
-            if on_retire is not None and len(ids) == 0:
-                on_retire(tokens[ci], SimResult(
-                    config=cfg, inst_ids=ids, rounds=rounds_out[ci],
-                    decision=dec_out[ci]))
+            if len(ids) == 0:
+                # Degenerate: nothing to run, so nothing to chain either —
+                # reply once and release any session ownership.
+                if on_retire is not None:
+                    on_retire(tokens[ci], SimResult(
+                        config=cfg, inst_ids=ids, rounds=rounds_out[ci],
+                        decision=dec_out[ci]))
+                if sess_owner[ci]:
+                    feed.session_done(tokens[ci])
         return True
 
     if feed is not None:
@@ -675,6 +758,7 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
     # never enters a draw.
     dead: set = set()
     cancelled_lanes = 0
+    session_reseeds = 0
 
     def _reap() -> bool:
         """Process feed.cancel() marks at the segment boundary. Returns
@@ -700,9 +784,53 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
                 cancelled_lanes += lanes
                 owner_cfg[owner_cfg == ci] = -1
                 changed = True
+                if sess_owner[ci]:
+                    # A cancelled session chains no further slots; release
+                    # its feed ownership so a closed feed can drain.
+                    feed.session_done(tokens[ci])
                 _trace.event("compaction.cancel", cfg_index=ci,
                              lanes=lanes, queued_dropped=dropped)
         return changed
+
+    def _chain_slot(ci: int) -> None:
+        """The spec-§11 retire/refill seam: slot ``ci`` just retired with
+        slots still owed, so splice the next slot — same config, chained
+        seed — into the work stream in place. The freed lanes re-seed from
+        it at the next refill without touching admission, and the seed is a
+        dynamic operand so no program key changes."""
+        nonlocal work_cfg, work_pos, work_iid, total, session_reseeds
+        nxt = _session_mod.next_slot_config(cfgs[ci], sess_slot[ci],
+                                            dec_out[ci])
+        ids = ids_list[ci]
+        cj = len(cfgs)
+        cfgs.append(nxt)
+        ids_list.append(ids)
+        tokens.append(tokens[ci])
+        remaining.append(len(ids))
+        rounds_out.append(np.zeros(len(ids), dtype=np.int32))
+        dec_out.append(np.zeros(len(ids), dtype=np.uint8))
+        sess_left.append(sess_left[ci] - 1)
+        sess_slot.append(sess_slot[ci] + 1)
+        sess_owner.append(sess_owner[ci])
+        row = _host_op_row(bucket, nxt)
+        for k in row:
+            op_mat[k] = np.concatenate([op_mat[k], np.asarray(row[k])[None]])
+        work_cfg = np.concatenate(
+            [work_cfg, np.full(len(ids), cj, dtype=np.int32)])
+        work_pos = np.concatenate(
+            [work_pos, np.arange(len(ids), dtype=np.int64)])
+        work_iid = np.concatenate(
+            [work_iid, np.asarray(ids, dtype=np.uint32)])
+        total += len(ids)
+        session_reseeds += 1
+        _trace.event("compaction.reseed", cfg_index=cj,
+                     slot=sess_slot[cj], slots_left=sess_left[cj],
+                     lanes=len(ids))
+        if _metrics.enabled():
+            _metrics.counter(
+                "brc_session_reseeds_total",
+                "In-grid session slot re-seeds at the retire seam "
+                "(spec §11)").inc()
 
     # Fill the whole grid, then alternate segment dispatches with
     # compaction+refill dispatches whenever the retired fraction crosses the
@@ -751,12 +879,20 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
                 if counters:
                     acc_out[ci][rows] = fetch[4][sel]
                 remaining[ci] -= int(sel.sum())
-                if on_retire is not None and remaining[ci] == 0:
-                    # Stream the finished request out NOW — the serving
-                    # loop's reply path; the grid keeps flying.
-                    on_retire(tokens[ci], SimResult(
-                        config=cfgs[ci], inst_ids=ids_list[ci],
-                        rounds=rounds_out[ci], decision=dec_out[ci]))
+                if remaining[ci] == 0:
+                    if on_retire is not None:
+                        # Stream the finished slot out NOW — the serving
+                        # loop's reply path; the grid keeps flying. Sessions
+                        # reply once per slot (same token every time).
+                        on_retire(tokens[ci], SimResult(
+                            config=cfgs[ci], inst_ids=ids_list[ci],
+                            rounds=rounds_out[ci], decision=dec_out[ci]))
+                    if sess_left[ci] > 1:
+                        # Spec §11: the retiring slot's decision seeds the
+                        # next slot in place — no admission round-trip.
+                        _chain_slot(ci)
+                    elif sess_owner[ci] and feed is not None:
+                        feed.session_done(tokens[ci])
             owner_cfg[retire] = -1
             live = owner_cfg >= 0
             free = W - int(live.sum())
@@ -873,6 +1009,7 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
         "refills": refills,
         "cancelled_cfgs": len(dead),
         "cancelled_lanes": cancelled_lanes,
+        "session_reseeds": session_reseeds,
         "device_lane_rounds": device_rounds,
         "useful_lane_rounds": useful_rounds,
         "occupancy": (round(useful_rounds / device_rounds, 4)

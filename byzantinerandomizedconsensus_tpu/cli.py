@@ -302,8 +302,10 @@ def main(argv=None) -> int:
                         "BENCH/MULTICHIP/artifact JSON (tools/ledger.py; "
                         "`ledger --check` is the regression sentinel — "
                         "nonzero on wall regression or program-fingerprint "
-                        "drift; `--json` for the machine-readable verdict; "
-                        "all further options pass through)")
+                        "drift; `ledger --debts` prints only the standing "
+                        "device-of-record DEBT rows as a table; `--json` "
+                        "for the machine-readable verdict; all further "
+                        "options pass through)")
     sub.add_parser("chaos",
                    help="chaos soak: randomized spec-§9 fault schedules, "
                         "subprocess-isolated with timeout/retry/checkpoint "
@@ -350,8 +352,11 @@ def main(argv=None) -> int:
                         "--slo-error-rate gate the run against a live "
                         "/metrics scrape (exit 5 on breach); --scenario "
                         "flash_crowd|heavy_tail|bucket_churn|tenant_hog|"
-                        "cancel_storm|all runs the hostile-load suite "
-                        "(tools/hostile.py, schema-v1.9 hostile block)")
+                        "cancel_storm|session_hog|all runs the hostile-"
+                        "load suite (tools/hostile.py, schema-v1.9 "
+                        "hostile block); --session-bench measures the "
+                        "spec-§11 session amortization ratio (schema-"
+                        "v1.12 session block)")
     sub.add_parser("dash",
                    help="live terminal dashboard over a serving endpoint's "
                         "GET /metrics (tools/dash.py): request p50/p99 + "

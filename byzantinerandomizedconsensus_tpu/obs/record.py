@@ -107,6 +107,18 @@ differential and ``steady_state_compiles``. Carried by
 ``record_version`` stays 1, the revision is declarative, and the block
 shape is checked only when present.
 
+Schema v1.12 (round 21) adds the **session** block (:func:`session_block` —
+the replicated-log session bench, tools/loadgen.py ``--session-bench``):
+the measured session population (sessions × slots per session), decisions/s
+for the L-slot session path vs L dependency-honoring independent requests on
+the same seeded population, their ratio (the **amortization_ratio**, the
+round's headline), the in-grid re-seed count, and the standing pins —
+``steady_state_compiles`` (0), per-slot numpy differential ``mismatches``
+(0), and ``replay_ok`` (every measured session bit-replays offline from its
+base seed alone, spec §11). Carried by ``artifacts/session_r21.json``. Same
+compatibility rule as v1.1–v1.11: ``record_version`` stays 1, the revision
+is declarative, and the block shape is checked only when present.
+
 tools/ledger.py consumes both this format and the legacy r1–r7 shapes;
 :func:`validate_record` is the schema check the tier-1 tests pin, and
 ``brc-tpu ledger --check`` (the regression sentinel) compares the committed
@@ -139,8 +151,11 @@ RECORD_VERSION = 1
 # (round 20) the fused block (ABI v6 fused round kernel: per-config
 # bytes/dispatch vs the xla baseline, the bit-match / steady-compile pins,
 # the device-of-record debt field the ledger tracks) + the env fingerprint's
-# pallas_pack_versions / fused_state_pack packing-law fields.
-RECORD_REVISION = 11
+# pallas_pack_versions / fused_state_pack packing-law fields; v1.12
+# (round 21) the session block (spec §11 replicated-log sessions: the
+# L-slot-vs-L-independent amortization ratio, re-seed counts, and the
+# steady-compile / differential-mismatch / offline-replay pins).
+RECORD_REVISION = 12
 
 
 def env_fingerprint() -> dict:
@@ -572,6 +587,33 @@ def fused_block(stats: dict | None) -> dict | None:
             if k in stats}
 
 
+#: The fields a schema-v1.12 ``session`` block must carry (the spec-§11
+#: replicated-log session bench of tools/loadgen.py: the measured session
+#: population, decisions/s for the L-slot session path vs L
+#: dependency-honoring independent requests, the amortization ratio that is
+#: the round's headline, and the standing pins — steady-state compiles,
+#: per-slot numpy differential mismatches, and offline bit-replay).
+SESSION_BLOCK_KEYS = ("sessions", "slots", "decisions", "amortization_ratio",
+                      "session_cps", "independent_cps",
+                      "steady_state_compiles", "mismatches", "replay_ok")
+
+
+def session_block(stats: dict | None) -> dict | None:
+    """The schema-v1.12 ``session`` block from a session-bench stats dict
+    (tools/loadgen.py ``--session-bench``). None in, None out — a record
+    without the block stays a valid v1.x record. ``session_cps`` /
+    ``independent_cps`` are decisions per second for the two legs over the
+    same seeded population; ``amortization_ratio`` is their quotient;
+    ``replay_ok`` is True iff every measured session bit-replays offline
+    from its base seed alone (spec §11's pure-function-of-seed law)."""
+    if stats is None:
+        return None
+    return {k: stats.get(k) for k in
+            (SESSION_BLOCK_KEYS + ("generator_version", "session_reseeds",
+                                   "population", "duration_s"))
+            if k in stats}
+
+
 def validate_record(doc: dict) -> list:
     """Schema check: returns a list of problems (empty = valid v1 record)."""
     problems = []
@@ -752,6 +794,22 @@ def validate_record(doc: dict) -> list:
                             problems.append(
                                 f"fused row {i} missing "
                                 "'key'/'fused_bytes_per_dispatch'")
+    sb = doc.get("session")
+    if sb is not None:
+        if not isinstance(sb, dict):
+            problems.append("session block is not a dict")
+        else:
+            for key in SESSION_BLOCK_KEYS:
+                if key not in sb:
+                    problems.append(f"session block missing {key!r}")
+            ok = sb.get("replay_ok")
+            if ok is not None and not isinstance(ok, bool):
+                problems.append("session block 'replay_ok' is not a bool")
+            ratio = sb.get("amortization_ratio")
+            if ratio is not None and (isinstance(ratio, bool)
+                                      or not isinstance(ratio, (int, float))):
+                problems.append(
+                    "session block 'amortization_ratio' is not a number")
     pg = doc.get("programs")
     if pg is not None:
         if not isinstance(pg, dict):

@@ -139,8 +139,15 @@ FAULT_OMIT = 14     # omission: burst gate (send=1) / per-replica bit (send=0)
 # send=0 is the per-(instance, round, phase, replica) membership word
 # (member iff word % n < C), send=1 the per-receiver committee drop word
 # feeding the §10 count law. The purpose field is 4 bits; 15 is its last
-# free value.
+# free value, so the session chain (spec §11) sub-addresses it further:
+# send=2 is the session word — slot k+1 of a replicated-log session derives
+# its seed from slot k's decision through one draw at that coordinate
+# (:func:`session_chain_seed`).
 COMMITTEE = 15
+
+#: The ``send`` coordinate of the spec-§11 session word under COMMITTEE
+#: (§10 uses send 0/1 only, so 2 is free in every packing law).
+SESSION_SEND = 2
 
 # Urn-delivery LCG (spec §4b): full period mod 2^32 (A ≡ 1 mod 4, C odd).
 URN_LCG_A = 0x915F77F5
@@ -276,3 +283,43 @@ def prf_sender(seed, instance, rnd, step, tag, sender, purpose, xp=np,
 def prf_bit(seed, instance, rnd, step, recv, send, purpose, xp=np, pack=1):
     return prf_u32(seed, instance, rnd, step, recv, send, purpose, xp=xp,
                    pack=pack) & xp.uint32(1)
+
+
+def session_digest(slot, decision) -> int:
+    """The spec-§11 decision digest: the slot's per-instance decision codes
+    folded through the §4b LCG multiplier, seeded by the slot index.
+
+    ``d_0 = slot + 1``; ``d_{i+1} = (URN_LCG_A·d_i + dec_i + 1) mod 2^32``
+    over the decision vector in instance order — every decided bit (and
+    every undecided-at-cap 2) enters the chain. Computed in closed affine
+    form (uint32 wraparound cumprod), bit-identical to the sequential fold.
+    """
+    dec = np.ravel(np.asarray(decision)).astype(np.uint32)
+    d0 = (int(slot) + 1) & 0xFFFFFFFF
+    if dec.size == 0:
+        return d0
+    # d = A^I·d0 + Σ_i A^(I-1-i)·(dec_i + 1), all mod 2^32.
+    pw = np.cumprod(np.full(dec.size, URN_LCG_A, dtype=np.uint32),
+                    dtype=np.uint32)
+    weights = np.concatenate([np.ones(1, dtype=np.uint32), pw[:-1]])[::-1]
+    acc = int(np.sum(weights * (dec + np.uint32(1)), dtype=np.uint32))
+    return (int(pw[-1]) * d0 + acc) & 0xFFFFFFFF
+
+
+def session_chain_seed(seed, slot, decision, pack=1) -> int:
+    """Slot ``slot + 1``'s derived seed from slot ``slot``'s decision vector
+    (spec §11, the replicated-log session chain).
+
+    One PRF draw under COMMITTEE sub-addressed at ``send=SESSION_SEND``,
+    with the :func:`session_digest` split across the (instance, rnd, recv)
+    coordinates masked to 12/12/6 bits — at or under the narrowest field
+    any packing law gives those coordinates, so the same draw is legal (and
+    collision-free against every frozen purpose) under v1, v2 AND v3. The
+    whole log is therefore a pure function of (seed, config): replaying the
+    slots from the base seed reproduces every decision bit-for-bit.
+    """
+    dig = session_digest(slot, decision)
+    word = prf_u32(seed, dig & 0xFFF, (dig >> 12) & 0xFFF, 0,
+                   (dig >> 24) & 0x3F, SESSION_SEND, COMMITTEE,
+                   xp=np, pack=pack)
+    return int(word)

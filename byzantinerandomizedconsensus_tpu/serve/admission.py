@@ -47,7 +47,8 @@ REQUEST_FIELDS = tuple(f.name for f in dataclasses.fields(SimConfig))
 #: validation — they must never become SimConfig fields, because SimConfig
 #: feeds the PRF draw coordinates and the fused bucket key (bit-identity
 #: and the zero-recompile pin both depend on that separation).
-ENVELOPE_FIELDS = ("check_invariants", "tenant", "deadline_ms", "priority")
+ENVELOPE_FIELDS = ("check_invariants", "tenant", "deadline_ms", "priority",
+                   "session_slots")
 
 #: The tenant every envelope-less request belongs to — its behavior is
 #: pinned bit-for-bit against the pre-round-18 server.
@@ -83,7 +84,7 @@ def envelope(payload):
     malformed values.
     """
     env = {"check_invariants": False, "tenant": DEFAULT_TENANT,
-           "deadline_ms": None, "priority": 0}
+           "deadline_ms": None, "priority": 0, "session_slots": 1}
     if not isinstance(payload, dict):
         return payload, env
     payload = dict(payload)
@@ -117,6 +118,23 @@ def envelope(payload):
             raise ValueError(
                 f"priority must be an int in [-8, 8], got {prio!r}")
         env["priority"] = prio
+    if "session_slots" in payload:
+        # Spec-§11 session request kind: L chained decision slots, one
+        # stream. L is an envelope key — NOT a SimConfig field — so the
+        # program cache keys and the bit-identity law never see it; the
+        # grid derives slot k+1's seed from slot k's decision.
+        slots = payload.pop("session_slots")
+        if slots is None:
+            slots = 1
+        from byzantinerandomizedconsensus_tpu.models.session import (
+            MAX_SESSION_SLOTS)
+        if isinstance(slots, bool) or not isinstance(slots, int) \
+                or not (1 <= slots <= MAX_SESSION_SLOTS):
+            _reject("bad_envelope")
+            raise ValueError(
+                f"session_slots must be an int in [1, {MAX_SESSION_SLOTS}], "
+                f"got {slots!r}")
+        env["session_slots"] = slots
     return payload, env
 
 

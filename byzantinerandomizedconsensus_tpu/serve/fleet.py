@@ -85,11 +85,12 @@ class FleetRequest:
 
     __slots__ = ("id", "cfg", "bucket", "t_submit", "t_reply", "record",
                  "error", "done", "tenant", "deadline_ms", "priority",
-                 "t_deadline", "cancelled")
+                 "t_deadline", "cancelled", "session_slots")
 
     def __init__(self, rid: str, cfg, bucket,
                  tenant: str = _admission.DEFAULT_TENANT,
-                 deadline_ms: Optional[float] = None, priority: int = 0):
+                 deadline_ms: Optional[float] = None, priority: int = 0,
+                 session_slots: int = 1):
         self.id = rid
         self.cfg = cfg
         self.bucket = bucket
@@ -98,6 +99,10 @@ class FleetRequest:
         self.tenant = tenant
         self.deadline_ms = deadline_ms
         self.priority = int(priority)
+        # spec-§11 session length: a session is bucket-affine and rides one
+        # worker whole (its slots chain inside that worker's grid), so its
+        # routing weight is L slots' worth — see _WorkerBase.load
+        self.session_slots = int(session_slots)
         self.cancelled = False
         self.t_submit = time.perf_counter()
         self.t_deadline = (None if deadline_ms is None
@@ -162,11 +167,13 @@ class _WorkerBase:
         poor balance key when the population has a fat tail — one
         round_cap-ceiling request is worth dozens of quickies, and a
         worker that is handed two fat-tailed buckets becomes the
-        whole-burst straggler even though its request count looks fair."""
-        total = sum(r.cfg.round_cap * r.cfg.instances
+        whole-burst straggler even though its request count looks fair.
+        A session (spec §11) weighs its full L-slot chain."""
+        total = sum(r.cfg.round_cap * r.cfg.instances * r.session_slots
                     for r in self.inflight.values())
         for reqs in self.pending.values():
-            total += sum(r.cfg.round_cap * r.cfg.instances for r in reqs)
+            total += sum(r.cfg.round_cap * r.cfg.instances
+                         * r.session_slots for r in reqs)
         return total
 
     # subclasses: start() / send(req) / live_stats() / request_shutdown()
@@ -261,9 +268,13 @@ class _ProcessWorker(_WorkerBase):
 
     def send(self, req: FleetRequest) -> None:
         # a dead pipe surfaces through the reader's EOF → _worker_lost
-        # re-admits this request with everything else in flight here
-        self._emit({"op": "submit", "id": req.id,
-                    "cfg": dataclasses.asdict(req.cfg)})
+        # re-admits this request with everything else in flight here.
+        # session_slots rides inside the cfg dict as an envelope key — the
+        # inner server's admission pops it before SimConfig validation
+        payload = dataclasses.asdict(req.cfg)
+        if req.session_slots > 1:
+            payload["session_slots"] = req.session_slots
+        self._emit({"op": "submit", "id": req.id, "cfg": payload})
 
     def send_cancel(self, rid: str) -> None:
         # the child's inner cancel answers through a fail(cancelled) frame;
@@ -387,7 +398,10 @@ class _ThreadWorker(_WorkerBase):
 
     def send(self, req: FleetRequest) -> None:
         try:
-            handle = self.inner.submit(dataclasses.asdict(req.cfg))
+            payload = dataclasses.asdict(req.cfg)
+            if req.session_slots > 1:
+                payload["session_slots"] = req.session_slots
+            handle = self.inner.submit(payload)
         except Exception as e:  # noqa: BLE001 — surface as a request fail
             threading.Thread(target=self.fleet._resolve,
                              args=(self, req.id),
@@ -601,7 +615,8 @@ class FleetServer:
             req = FleetRequest(f"f{self._counter:06d}", cfg, bucket,
                                tenant=tenant,
                                deadline_ms=env["deadline_ms"],
-                               priority=env["priority"])
+                               priority=env["priority"],
+                               session_slots=env["session_slots"])
             self._requests.append(req)
             self._byid[req.id] = req
             self._tenant_inflight[tenant] = \
@@ -780,7 +795,8 @@ class FleetServer:
         requests (worker loss) are credited again; the bias is toward the
         unlucky tenant's *competitors*, which only errs safe."""
         for req in reqs:
-            w = int(req.cfg.round_cap) * int(req.cfg.instances)
+            w = (int(req.cfg.round_cap) * int(req.cfg.instances)
+                 * req.session_slots)
             self._tenant_served[req.tenant] = \
                 self._tenant_served.get(req.tenant, 0) + w
             if _metrics.enabled():
@@ -836,8 +852,9 @@ class FleetServer:
         """LPT weight of a pending rotation: its segment chain is bounded
         by the longest member round_cap (a rotation is indivisible once
         resident, so dispatching long chains first keeps the end-game
-        straggler short — classic longest-processing-time packing)."""
-        return (max(r.cfg.round_cap for r in reqs),
+        straggler short — classic longest-processing-time packing). A
+        session's chain is its cap times its slot count (spec §11)."""
+        return (max(r.cfg.round_cap * r.session_slots for r in reqs),
                 sum(r.cfg.instances for r in reqs))
 
     def _rotation_key_locked(self, bucket, reqs) -> tuple:
@@ -883,7 +900,7 @@ class FleetServer:
         def backlog(o):
             # stealable lane-round weight only: inflight and pinned work
             # cannot move, so it must not make a peer look "busiest"
-            return sum(r.cfg.round_cap * r.cfg.instances
+            return sum(r.cfg.round_cap * r.cfg.instances * r.session_slots
                        for b in stealable(o) for r in o.pending[b])
 
         victim = max(victims, key=lambda o: (backlog(o), -o.idx))

@@ -69,14 +69,21 @@ class ServeRequest:
     __slots__ = ("id", "cfg", "bucket", "t_submit", "t_dispatch", "t_reply",
                  "result", "record", "error", "done", "check_invariants",
                  "tenant", "deadline_ms", "priority", "t_deadline",
-                 "cancelled")
+                 "cancelled", "session_slots", "slot_results")
 
     def __init__(self, rid: str, cfg, bucket, check_invariants: bool = False,
                  tenant: str = _admission.DEFAULT_TENANT,
-                 deadline_ms: Optional[float] = None, priority: int = 0):
+                 deadline_ms: Optional[float] = None, priority: int = 0,
+                 session_slots: int = 1):
         self.id = rid
         self.cfg = cfg
         self.bucket = bucket
+        # spec-§11 session request kind: L chained decision slots streamed
+        # over one handle; the grid re-seeds slot k+1 from slot k's decision
+        # at its retire seam, and _retire accumulates the per-slot results
+        # until the last slot completes the request
+        self.session_slots = int(session_slots)
+        self.slot_results: list = []
         # opt-in safety checking at retirement (round 17 satellite): the
         # reply record carries an Agreement/Validity verdict summary
         self.check_invariants = bool(check_invariants)
@@ -248,7 +255,11 @@ class ConsensusServer:
             env["check_invariants"] = True
         cfg = _admission.admit(payload, round_cap_ceiling=self._ceiling)
         bucket = _admission.bucket_of(cfg)
-        weight = int(cfg.round_cap) * int(cfg.instances)
+        # a session's true lane-round claim is L slots' worth — the r18
+        # deficit-weighted fairness must see it, or a long log rides at
+        # single-request weight (the session_hog scenario pins this)
+        weight = (int(cfg.round_cap) * int(cfg.instances)
+                  * int(env["session_slots"]))
         with self._cv:
             if self._stop:
                 raise RuntimeError("server is shutting down")
@@ -264,11 +275,13 @@ class ConsensusServer:
                                check_invariants=env["check_invariants"],
                                tenant=tenant,
                                deadline_ms=env["deadline_ms"],
-                               priority=env["priority"])
+                               priority=env["priority"],
+                               session_slots=env["session_slots"])
             placed = False
             if self._active is not None and self._active[0] == bucket:
                 try:
-                    self._active[1].push(cfg, token=req)
+                    self._active[1].push(cfg, token=req,
+                                         session=req.session_slots)
                     req.t_dispatch = time.perf_counter()
                     self._active[2].append(req)
                     self._tenant_served[tenant] = \
@@ -310,6 +323,14 @@ class ConsensusServer:
                 self._tenant_inflight.get(tenant, 0) + 1
             _trace.event("serve.request", id=req.id, bucket=bucket.label(),
                          instances=int(cfg.instances), tenant=tenant)
+            if req.session_slots > 1:
+                _trace.event("serve.session_open", id=req.id,
+                             slots=req.session_slots, bucket=bucket.label(),
+                             tenant=tenant)
+                if _metrics.enabled():
+                    _metrics.counter(
+                        "brc_session_opened_total",
+                        "Session requests admitted (spec §11)").inc()
             self._cv.notify_all()
         return req
 
@@ -441,9 +462,11 @@ class ConsensusServer:
                 # close cannot land mid-seed (seeding ignores the depth
                 # bound — these requests were already admitted)
                 for req in reqs:
-                    feed.push(req.cfg, token=req, force=True)
+                    feed.push(req.cfg, token=req, force=True,
+                              session=req.session_slots)
                     req.t_dispatch = time.perf_counter()
-                    w = int(req.cfg.round_cap) * int(req.cfg.instances)
+                    w = (int(req.cfg.round_cap) * int(req.cfg.instances)
+                         * req.session_slots)
                     self._tenant_served[req.tenant] = \
                         self._tenant_served.get(req.tenant, 0) + w
                     if _metrics.enabled():
@@ -497,22 +520,35 @@ class ConsensusServer:
         Cancels that land while an item is queued were already stripped by
         ``WorkFeed.cancel``; a cancel that races the run itself is dropped
         at :meth:`_retire` (the reply is discarded, as on the lane path)."""
+        from byzantinerandomizedconsensus_tpu.models import (
+            session as _session_mod)
+
         while True:
             items = feed.pull(block=True)
             if items is None:
                 return
             feed.pop_cancelled()  # queued cancels already left the feed
-            for cfg, ids, token in items:
+            for cfg, ids, token, session in items:
+                slots = int(session) if session else 1
+                slot_cfg = cfg
                 try:
-                    result = self._backend.run(cfg, inst_ids=ids)
+                    for k in range(slots):
+                        result = self._backend.run(slot_cfg, inst_ids=ids)
+                        if token is not None:
+                            self._retire(token, result)
+                        if k + 1 < slots:
+                            # spec §11 inline: this leg has no lane grid, so
+                            # the chain runs here — same law, same seeds
+                            slot_cfg = _session_mod.next_slot_config(
+                                slot_cfg, k, result.decision)
                 except Exception as e:  # noqa: BLE001 — isolate the item
                     if token is not None:
                         with self._cv:
                             if not token.done.is_set():
                                 self._fail(token, f"dispatch error: {e!r}")
-                    continue
-                if token is not None:
-                    self._retire(token, result)
+                finally:
+                    if session:
+                        feed.session_done(token)
 
     def _retire(self, req: ServeRequest, result) -> None:
         with self._cv:
@@ -521,6 +557,26 @@ class ConsensusServer:
                 # boundary than this retirement): the reply is dropped —
                 # the request already answered "cancelled"
                 return
+            if req.session_slots > 1:
+                # one call per slot (same token): accumulate the partials,
+                # complete the request only at the last slot
+                req.slot_results.append(result)
+                slot = len(req.slot_results) - 1
+                _trace.event("serve.session_slot", id=req.id, slot=slot,
+                             slots=req.session_slots,
+                             seed=int(result.config.seed))
+                if _metrics.enabled():
+                    _metrics.counter(
+                        "brc_session_slots_replied_total",
+                        "Session slots streamed at retire (spec §11)").inc()
+                if len(req.slot_results) < req.session_slots:
+                    return
+                _trace.event("serve.session_done", id=req.id,
+                             slots=req.session_slots)
+                if _metrics.enabled():
+                    _metrics.counter(
+                        "brc_session_completed_total",
+                        "Sessions that streamed every slot").inc()
             req.t_reply = time.perf_counter()
             self._replied += 1
             self._release_locked(req)
@@ -569,14 +625,28 @@ class ConsensusServer:
         req.done.set()
 
     def _reply_record(self, req: ServeRequest, result) -> dict:
-        """The schema-v1.5 reply document streamed back per request."""
+        """The schema-v1.5 reply document streamed back per request. A
+        session reply's top-level rounds/decision are slot 0's (the base
+        config's own run, so existing differential checks hold unchanged);
+        the ``session`` block carries the whole per-slot log — enough to
+        bit-replay the chain offline from the base seed alone."""
+        base = req.slot_results[0] if req.session_slots > 1 else result
         doc = _record.new_record("serve_reply", config=req.cfg)
         doc["request_id"] = req.id
         doc["bucket"] = req.bucket.label()
-        doc["inst_ids"] = [int(i) for i in result.inst_ids]
-        doc["rounds"] = [int(r) for r in result.rounds]
-        doc["decision"] = [int(d) for d in result.decision]
+        doc["inst_ids"] = [int(i) for i in base.inst_ids]
+        doc["rounds"] = [int(r) for r in base.rounds]
+        doc["decision"] = [int(d) for d in base.decision]
         doc["latency_s"] = round(req.latency_s, 6)
+        if req.session_slots > 1:
+            doc["session"] = {
+                "slots": req.session_slots,
+                "seeds": [int(r.config.seed) for r in req.slot_results],
+                "rounds": [[int(x) for x in r.rounds]
+                           for r in req.slot_results],
+                "decisions": [[int(x) for x in r.decision]
+                              for r in req.slot_results],
+            }
         if req.check_invariants:
             doc["invariants"] = self._invariant_summary(req.cfg)
         return doc
@@ -627,9 +697,11 @@ class ConsensusServer:
             load = 0
             if self._active is not None:
                 load += sum(r.cfg.round_cap * r.cfg.instances
+                            * r.session_slots
                             for r in self._active[2] if not r.done.is_set())
             for reqs in self._pending.values():
-                load += sum(r.cfg.round_cap * r.cfg.instances for r in reqs)
+                load += sum(r.cfg.round_cap * r.cfg.instances
+                            * r.session_slots for r in reqs)
             out = {
                 "submitted": self._submitted,
                 "feed_depth": feed_depth,

@@ -33,6 +33,15 @@ its own exit-code-enforced gates:
     some live in lanes (reclaimed at the next segment boundary). Every
     request must resolve (reply or ``cancelled``) and every *surviving*
     reply must stay bit-identical to the offline path.
+``session_hog``
+    Round 21: one tenant floods the service with max-weight spec-§11
+    **sessions** (the ``session_slots`` envelope at heavy instance
+    counts — each one a round_cap × instances × slots lane-round claim)
+    while the interactive tenant submits small deadline-carrying
+    requests. The deficit-weighted fairness must price the TRUE session
+    weight (p99 fairness gate, exit 5 on breach via the scenario gate),
+    and every hog session must bit-replay offline from its base seed
+    alone (models/session.py).
 
 Every scenario's population is a pure function of ``(suite seed,
 scenario index)``; observed counts (rejections, cancel timing splits)
@@ -76,7 +85,7 @@ from byzantinerandomizedconsensus_tpu.utils.rounds import default_artifact
 HOSTILE_GENERATOR_VERSION = 1
 
 SCENARIOS = ("flash_crowd", "heavy_tail", "bucket_churn", "tenant_hog",
-             "cancel_storm")
+             "cancel_storm", "session_hog")
 
 #: Admitted round_cap ceiling for the hostile servers — half the serving
 #: default: the suite's populations are many small requests, and the
@@ -90,7 +99,12 @@ _SIZES = {
     "bucket_churn": (18, 9),
     "tenant_hog": (24, 10),   # hog 2/3, interactive 1/3
     "cancel_storm": (24, 10),
+    "session_hog": (15, 8),  # hog sessions 1/3, interactive 2/3
 }
+
+#: session_hog: chained decision slots per hog session (each hog envelope
+#: is a round_cap x instances x slots lane-round claim).
+_HOG_SESSION_SLOTS = 4
 
 #: The fairness bound (tenant_hog): the interactive tenant's p99 must stay
 #: under max(half the hog's p99, this floor) — the floor keeps the gate
@@ -523,12 +537,112 @@ def _scenario_cancel_storm(args, seed: int) -> dict:
                 cancel_where=where)
 
 
+def _scenario_session_hog(args, seed: int) -> dict:
+    """One tenant floods with max-weight spec-§11 sessions, the
+    interactive tenant must stay responsive: the deficit-weighted rotation
+    order prices a session envelope at its TRUE lane-round weight
+    (round_cap × instances × slots), so a slots-heavy hog cannot buy more
+    grid time than its deficit allows. Every hog session is additionally
+    bit-replayed offline from its base seed (the spec-§11 law)."""
+    from byzantinerandomizedconsensus_tpu.backends.base import get_backend
+    from byzantinerandomizedconsensus_tpu.models import session as _session
+    from byzantinerandomizedconsensus_tpu.serve.server import ConsensusServer
+
+    n_req = _SIZES["session_hog"][1 if args.smoke else 0]
+    n_hog = n_req // 3
+    n_int = n_req - n_hog
+    slots = _HOG_SESSION_SLOTS
+    hog_cfgs = [_cfg("benor", 9, 3, seed * 1000 + i, instances=8,
+                     round_cap=ROUND_CAP_CEILING) for i in range(n_hog)]
+    int_cfgs = [_cfg("benor", 5, 1, seed * 1000 + 500 + i, instances=2,
+                     round_cap=16) for i in range(n_int)]
+
+    with ConsensusServer(backend=args.backend, policy=args.policy,
+                         round_cap_ceiling=ROUND_CAP_CEILING,
+                         tenant_inflight_cap=8) as srv:
+        buckets = [_admission.bucket_of(hog_cfgs[0]),
+                   _admission.bucket_of(int_cfgs[0])]
+        warm_compiles = _warm(srv, buckets, burst=3)
+        hog_handles: list = []
+        int_handles: list = []
+        errors: list = []
+
+        def hog() -> None:
+            try:
+                for c in hog_cfgs:
+                    payload = {**dataclasses.asdict(c), "tenant": "hog",
+                               "session_slots": slots}
+                    while True:
+                        try:
+                            hog_handles.append(srv.submit(payload))
+                            break
+                        except _admission.Backpressure as e:
+                            time.sleep(e.retry_after_s)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(f"hog: {e}")
+
+        def interactive() -> None:
+            try:
+                time.sleep(0.1)  # let the session flood establish itself
+                for c in int_cfgs:
+                    payload = {**dataclasses.asdict(c),
+                               "tenant": "interactive",
+                               "deadline_ms": 8000.0}
+                    int_handles.append(srv.submit(payload))
+                    time.sleep(0.05)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(f"interactive: {e}")
+
+        threads = [threading.Thread(target=hog, name="brc-session-hog"),
+                   threading.Thread(target=interactive,
+                                    name="brc-session-int")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise RuntimeError(f"session_hog submit errors: {errors}")
+        for h in hog_handles + int_handles:
+            h.wait(timeout=900.0)
+        steady = srv.compile_count() - warm_compiles
+
+    (hog_p99,) = metrics.percentiles(
+        [h.latency_s * 1000.0 for h in hog_handles], (99,))
+    (int_p99,) = metrics.percentiles(
+        [h.latency_s * 1000.0 for h in int_handles], (99,))
+    # A hog request is ~slots× the interactive weight by construction, so
+    # the tenant_hog bound applies unchanged: the interactive p99 must not
+    # inflate toward the session-stretched hog p99.
+    bound = max(0.5 * hog_p99, _FAIRNESS_FLOOR_MS)
+    fairness = {"hog_p99_ms": round(hog_p99, 3),
+                "non_hog_p99_ms": round(int_p99, 3),
+                "bound_ms": round(bound, 3),
+                "ok": int_p99 <= bound}
+    mism = _mismatch_count(
+        [(c, h.record) for c, h in zip(hog_cfgs, hog_handles)]
+        + [(c, h.record) for c, h in zip(int_cfgs, int_handles)])
+    be = get_backend("numpy")
+    replay_ok = True
+    for c, h in zip(hog_cfgs, hog_handles):
+        blk = h.record["session"]
+        served = list(zip(blk["rounds"], blk["decisions"]))
+        if not _session.replay_matches(be, c, served):
+            replay_ok = False
+            mism += 1
+    return _row("session_hog", seed, n_req,
+                len(hog_handles) + len(int_handles), mismatches=mism,
+                steady=steady, slo_ok=(fairness["ok"] and replay_ok),
+                sessions=n_hog, session_slots=slots,
+                session_replay_ok=replay_ok, fairness=fairness)
+
+
 _RUNNERS = {
     "flash_crowd": _scenario_flash_crowd,
     "heavy_tail": _scenario_heavy_tail,
     "bucket_churn": _scenario_bucket_churn,
     "tenant_hog": _scenario_tenant_hog,
     "cancel_storm": _scenario_cancel_storm,
+    "session_hog": _scenario_session_hog,
 }
 
 
@@ -601,8 +715,8 @@ def main(argv=None) -> int:
             "hostile",
             description="Hostile-load suite: seeded adversarial traffic "
                         "(flash crowd, heavy tail, bucket churn, tenant "
-                        "hog, cancel storm) through the bounded "
-                        "continuous-batching consensus service."),
+                        "hog, cancel storm, session hog) through the "
+                        "bounded continuous-batching consensus service."),
         "seed": args.seed,
         "smoke": bool(args.smoke),
         "backend": args.backend,

@@ -42,6 +42,13 @@ machine-readable record (kind="ledger", sentinel verdict included) to stdout
 instead of the human table, ``--json FILE`` writes it next to the table.
 Exit code 0 iff zero parse errors — and, with ``--check``, iff the sentinel
 verdict is clean too.
+
+Round 21 adds ``--debts``: print ONLY the standing DEBT rows — the claims
+whose evidence has not yet run on the device of record (the r5 device-chain
+anchor with every later round CPU-only, and the r20 fused bit-match whose
+``device_of_record`` is still ``interpret/cpu``) — as an aligned table, and
+exit 0. The verb is the one-glance answer to "what still owes a TPU run";
+tests/test_ledger.py pins both rows.
 """
 
 from __future__ import annotations
@@ -426,6 +433,31 @@ def _fused_rows_of(name: str, doc) -> list:
     return rows
 
 
+def _session_rows_of(name: str, doc) -> list:
+    """Schema-v1.12 ``session`` blocks of one artifact: (path, sessions,
+    slots, decisions, amortization ratio, session vs independent decisions/s,
+    steady-state compiles, mismatches, replay verdict) rows — the ledger's
+    replicated-log session-amortization columns (spec §11)."""
+    from byzantinerandomizedconsensus_tpu.obs import record as _record
+
+    rows = []
+    for path, sb in _blocks_of(doc, "session", _record.SESSION_BLOCK_KEYS):
+        rows.append({
+            "artifact": name,
+            "path": path,
+            "sessions": sb.get("sessions"),
+            "slots": sb.get("slots"),
+            "decisions": sb.get("decisions"),
+            "amortization_ratio": sb.get("amortization_ratio"),
+            "session_cps": sb.get("session_cps"),
+            "independent_cps": sb.get("independent_cps"),
+            "steady_state_compiles": sb.get("steady_state_compiles"),
+            "mismatches": sb.get("mismatches"),
+            "replay_ok": sb.get("replay_ok"),
+        })
+    return rows
+
+
 def sentinel_verdict(bench: dict, wall_chain: list,
                      programs_rows: list) -> dict:
     """The ``--check`` verdict: wall-chain regressions past
@@ -680,6 +712,12 @@ def build_ledger(root=None) -> dict:
     for name, doc in sorted(docs.items()):
         fused_rows.extend(_fused_rows_of(name, doc))
 
+    # ---- session-amortization columns (schema v1.12, round 21): every
+    # committed artifact carrying a §11 replicated-log session block.
+    session_rows = []
+    for name, doc in sorted(docs.items()):
+        session_rows.extend(_session_rows_of(name, doc))
+
     from byzantinerandomizedconsensus_tpu.obs import record
 
     return {
@@ -700,6 +738,7 @@ def build_ledger(root=None) -> dict:
         "hostile_rows": hostile_rows,
         "committee_rows": committee_rows,
         "fused_rows": fused_rows,
+        "session_rows": session_rows,
         "bench_rounds": {str(r): bench[r] for r in rounds_seen},
         "wall_chain": chain,
         "device_chain": device_chain,
@@ -894,6 +933,23 @@ def format_report(doc: dict) -> str:
                 f"device of record {row['device_of_record']}"
                 + (" — DEBT: bit-match not yet re-run on TPU"
                    if row["device_debt"] else ""))
+    # Present only once an artifact carries the v1.12 session block.
+    if doc.get("session_rows"):
+        lines.append("session-amortization columns (schema v1.12 — "
+                     "artifact[path]: sessions x slots decisions "
+                     "session-cps/independent-cps ratio steady-state "
+                     "compiles mismatches replay):")
+        for row in doc["session_rows"]:
+            rep = row["replay_ok"]
+            rep_s = "n/a" if rep is None else ("OK" if rep else "FAIL")
+            lines.append(
+                f"  {row['artifact']}[{row['path']}]: "
+                f"{row['sessions']} sessions x {row['slots']} slots, "
+                f"{row['decisions']} decisions, "
+                f"{row['session_cps']} vs {row['independent_cps']} dec/s "
+                f"(amortization x{row['amortization_ratio']}), "
+                f"{row['steady_state_compiles']} steady-state compiles, "
+                f"{row['mismatches']} mismatches, replay {rep_s}")
     sent = doc.get("sentinel")
     if sent is not None:
         lines.append(
@@ -907,6 +963,62 @@ def format_report(doc: dict) -> str:
             lines.append(f"  skipped: {s}")
         for f in sent["failures"]:
             lines.append(f"  SENTINEL FAIL: {f}")
+    return "\n".join(lines)
+
+
+def debts_of(doc: dict) -> list:
+    """The standing DEBT rows of a ledger document — claims whose evidence
+    has not yet run on the device of record. Two standing families as of
+    round 21: the r5 device-chain anchor (every later committed round is
+    CPU-only, so the noise-immune chain cannot extend) and the r20 fused
+    bit-match whose ``device_of_record`` is still ``interpret/cpu``. Pure
+    function of :func:`build_ledger`'s output so tests can feed it
+    fabricated ledgers."""
+    debts = []
+    dc = doc.get("device_chain") or {}
+    broken = dc.get("broken_rounds") or []
+    if broken:
+        debts.append({
+            "debt": "device-chain",
+            "where": (f"anchor r{dc.get('anchor_round')} "
+                      f"({dc.get('anchor_artifact')})"),
+            "evidence": (f"{len(broken)} round(s) "
+                         f"{_round_span(b['round'] for b in broken)} with no "
+                         "device_busy_s leg"
+                         + (" (CPU-only sessions)"
+                            if all(b.get("cpu_only") for b in broken)
+                            else "")),
+            "closes_with": "re-run bench.py on a TPU session",
+        })
+    for row in doc.get("fused_rows") or []:
+        if row.get("device_debt"):
+            debts.append({
+                "debt": "fused-bitmatch",
+                "where": f"{row['artifact']}[{row['path']}]",
+                "evidence": (f"device_of_record="
+                             f"{row.get('device_of_record')}, "
+                             f"{row.get('mismatches')} mismatches"),
+                "closes_with": ("re-run `brc-tpu programs fused` on a TPU "
+                                "session"),
+            })
+    return debts
+
+
+def format_debts(doc: dict) -> str:
+    """The ``--debts`` table: one row per standing debt, aligned columns."""
+    debts = debts_of(doc)
+    if not debts:
+        return "standing debts: none"
+    cols = ("debt", "where", "evidence", "closes_with")
+    heads = ("DEBT", "WHERE", "EVIDENCE", "CLOSES WITH")
+    rows = [[str(d[c]) for c in cols] for d in debts]
+    widths = [max(len(h), *(len(r[i]) for r in rows))
+              for i, h in enumerate(heads)]
+    lines = [f"standing debts — {len(debts)} row(s)",
+             "  ".join(h.ljust(w) for h, w in zip(heads, widths)).rstrip()]
+    for r in rows:
+        lines.append("  ".join(v.ljust(w)
+                               for v, w in zip(r, widths)).rstrip())
     return "\n".join(lines)
 
 
@@ -925,9 +1037,17 @@ def main(argv=None) -> int:
                          "regression past timing.REGRESSION_THRESHOLD, "
                          "recorded-vs-recomputed drift, or program-"
                          "fingerprint drift (the mechanical r5 rule)")
+    ap.add_argument("--debts", action="store_true",
+                    help="print only the standing DEBT rows (claims whose "
+                         "evidence has not yet run on the device of record: "
+                         "the r5 device-chain anchor, the r20 fused "
+                         "interpret/cpu bit-match) as a table; exit 0")
     args = ap.parse_args(argv)
 
     doc = build_ledger(args.root)
+    if args.debts:
+        print(format_debts(doc))
+        return 0
     if args.json == "-":
         print(json.dumps(doc, indent=1))
     else:

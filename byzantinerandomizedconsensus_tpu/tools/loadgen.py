@@ -80,17 +80,35 @@ fresh registry, so a p99 regression at any width fails the run. The
 artifact records the scraped digest + verdict as the schema-v1.7
 ``metrics`` block.
 
+**Session bench (round 21)** — ``--session-bench`` measures the spec-§11
+replicated-log amortization claim: K sessions of L chained decision slots
+(one submit each, the grid re-seeding retiring lanes in place) against the
+dependency-honoring alternative — K concurrent clients each submitting L
+single requests sequentially, deriving every next seed from the previous
+reply exactly as the chain law does. Same seeded population, same warm
+bucket, same decisions; the ratio of the two legs' decisions/s is the
+**amortization ratio**. Every session reply is bit-replayed offline from
+its base seed alone (models/session.py) AND compared slot-for-slot
+against the independent leg's replies; zero steady-state compiles is
+enforced across both legs. The committed schema-v1.12 artifact::
+
+    python -m byzantinerandomizedconsensus_tpu.tools.loadgen \\
+        --session-bench --sessions 8 --session-slots 12 --seed 21 \\
+        --out artifacts/session_r21.json
+
 **Hostile mode (round 18)** — ``--scenario
-flash_crowd|heavy_tail|bucket_churn|tenant_hog|cancel_storm|all``
-delegates the whole invocation to the hostile-load suite
+flash_crowd|heavy_tail|bucket_churn|tenant_hog|cancel_storm|session_hog|
+all`` delegates the whole invocation to the hostile-load suite
 (tools/hostile.py): seeded adversarial traffic against *bounded* servers
 — 429 + Retry-After backpressure, per-tenant fairness, EDF deadline
 scheduling, cancellation storms — with its own exit-code ladder (see
 that module's docstring) and the committed ``artifacts/hostile_r18.json``.
 
-Exit codes: 1 differential mismatch, 2 steady-state compiles, 3 invalid
-record, 4 fleet scaling below ``--min-scaling``, 5 SLO breach
-(``--slo-p99-ms`` / ``--slo-error-rate`` vs the live ``/metrics`` scrape).
+Exit codes: 1 differential mismatch (including a session replay or
+cross-leg mismatch), 2 steady-state compiles, 3 invalid record, 4 fleet
+scaling below ``--min-scaling`` or session amortization below
+``--min-amortization``, 5 SLO breach (``--slo-p99-ms`` /
+``--slo-error-rate`` vs the live ``/metrics`` scrape).
 """
 
 from __future__ import annotations
@@ -127,13 +145,23 @@ from byzantinerandomizedconsensus_tpu.utils.rounds import default_artifact
 # speedup below 3x regardless of scheduling. One wave pins the
 # per-request chain at <= round_cap segments. (v1 streams remain
 # reproducible from v1 checkouts; artifacts record the version.)
-GENERATOR_VERSION = 2
+# v3: stream items gain a session slot count — a seeded ~15% of requests
+# become spec-§11 replicated-log sessions (2..8 chained decision slots,
+# the ``session_slots`` envelope key) — and the stream digest covers the
+# slot counts, so same-seed session streams pin byte-identical.
+GENERATOR_VERSION = 3
 
 #: The admitted round_cap ceiling (mirrors serve/server.py): every
 #: population draw stays at or under it by construction.
 ROUND_CAP_CEILING = 128
 
 _MIX = (("chaos", 0.5), ("keys", 0.3), ("fat_tail", 0.2))
+
+#: Generator-v3 session mix: the fraction of stream items that become
+#: spec-§11 sessions, and the admitted slot-count draws. Drawn AFTER the
+#: config so the population families above keep their v2 shapes.
+_SESSION_RATE = 0.15
+_SESSION_SLOTS = (2, 3, 4, 6, 8)
 
 
 def _keys_config(rng: random.Random) -> SimConfig:
@@ -171,11 +199,14 @@ def _fat_tail_config(rng: random.Random) -> SimConfig:
 
 
 def request_stream(requests: int, seed: int, rate: float) -> list:
-    """The seeded open-loop request stream: ``[(arrival_s, SimConfig)]``.
+    """The seeded open-loop request stream:
+    ``[(arrival_s, SimConfig, session_slots)]``.
 
     A pure function of its arguments (plus GENERATOR_VERSION): one
-    ``random.Random(seed)`` drives both the Poisson gaps and the population
-    draws, so the stream reproduces byte-for-byte."""
+    ``random.Random(seed)`` drives the Poisson gaps, the population draws,
+    and (v3) the session slot counts, so the stream reproduces
+    byte-for-byte. ``session_slots`` is 1 for an ordinary request and
+    2..8 for the seeded ~15% that become spec-§11 sessions."""
     rng = random.Random(seed)
     t = 0.0
     out = []
@@ -188,7 +219,9 @@ def request_stream(requests: int, seed: int, rate: float) -> list:
             cfg = _keys_config(rng)
         else:
             cfg = _fat_tail_config(rng)
-        out.append((t, cfg))
+        slots = (rng.choice(_SESSION_SLOTS)
+                 if rng.random() < _SESSION_RATE else 1)
+        out.append((t, cfg, slots))
     return out
 
 
@@ -206,8 +239,9 @@ def fleet_request_stream(requests: int, seed: int, rate: float,
 
 def stream_digest(stream) -> str:
     """sha256 over the canonical JSON of the stream — the byte-for-byte
-    determinism pin (arrival times AND configs)."""
-    doc = [[round(t, 9), dataclasses.asdict(cfg)] for t, cfg in stream]
+    determinism pin (arrival times, configs AND session slot counts)."""
+    doc = [[round(t, 9), dataclasses.asdict(cfg), int(slots)]
+           for t, cfg, slots in stream]
     blob = json.dumps(doc, sort_keys=True, separators=(",", ":"))
     return hashlib.sha256(blob.encode()).hexdigest()
 
@@ -361,12 +395,16 @@ def _drive(server, stream, open_loop: bool) -> dict:
     server._on_reply = on_done
     t0 = time.perf_counter()
     handles = []
-    for arrival, cfg in stream:
+    for arrival, cfg, slots in stream:
         if open_loop:
             delay = t0 + arrival - time.perf_counter()
             if delay > 0:
                 time.sleep(delay)
-        handles.append(server.submit(cfg))
+        # session_slots rides as an envelope key next to the SimConfig
+        # fields (serve/admission.py pops it before config validation)
+        payload = (cfg if slots == 1
+                   else {**dataclasses.asdict(cfg), "session_slots": slots})
+        handles.append(server.submit(payload))
     for h in handles:
         h.wait(timeout=1800.0)
     server._on_reply = None
@@ -395,18 +433,33 @@ def _offline_fused_leg(backend_name: str, cfgs, reps: int) -> dict:
 
 def _differential(cfgs, handles) -> dict:
     """Every served reply vs the per-config offline path (numpy backend),
-    bit-for-bit. Mismatches are counted, never swallowed."""
+    bit-for-bit. A session reply's top level is its slot-0 run (same base
+    config), so the check is uniform; replies carrying a ``session`` block
+    are additionally replayed slot-by-slot from the base seed alone
+    (models/session.py — the spec-§11 law). Mismatches are counted, never
+    swallowed."""
     from byzantinerandomizedconsensus_tpu.backends.base import get_backend
+    from byzantinerandomizedconsensus_tpu.models import session as _session
 
     be = get_backend("numpy")
     mismatches = []
+    sessions_replayed = 0
     for cfg, h in zip(cfgs, handles):
         ref = be.run(cfg)
         if (h.record["rounds"] != [int(r) for r in ref.rounds]
                 or h.record["decision"] != [int(d) for d in ref.decision]):
             mismatches.append({"request_id": h.id,
                                "config": dataclasses.asdict(cfg)})
+            continue
+        blk = h.record.get("session")
+        if blk:
+            sessions_replayed += 1
+            served = list(zip(blk["rounds"], blk["decisions"]))
+            if not _session.replay_matches(be, cfg, served):
+                mismatches.append({"request_id": h.id, "leg": "session",
+                                   "config": dataclasses.asdict(cfg)})
     return {"backend": "numpy", "configs": len(cfgs),
+            "sessions_replayed": sessions_replayed,
             "mismatches": len(mismatches), "detail": mismatches[:10]}
 
 
@@ -432,6 +485,194 @@ def _fleet_differential(backend_name: str, policy, cfgs, leg_handles) -> dict:
     return {"backend": backend_name, "mode": "run_many_compaction",
             "configs": len(cfgs), "compared": compared,
             "mismatches": len(mismatches), "detail": mismatches[:10]}
+
+
+#: Session-bench compaction policy (used unless --policy is explicit):
+#: multi-round segments are where the in-grid chain pays — a retiring
+#: independent request waits out the superstep boundary PLUS the client
+#: round-trip before its next slot can refill, while a session splices its
+#: next slot at the retire seam inside the grid.
+_SESSION_BENCH_POLICY = "width=64,segment=4"
+
+
+def _session_population(sessions: int, seed: int) -> list:
+    """The session-bench population: one fused bucket (so the warm-up is
+    one chain and zero steady-state compiles is a clean pin), short
+    fast-deciding slots (the chain seam dominates, not the per-slot
+    compute), seeds drawn from one ``random.Random(seed)`` — a pure
+    function of its arguments."""
+    rng = random.Random(seed)
+    return [SimConfig(protocol="benor", n=5, f=1, instances=4,
+                      adversary="none", coin="local", init="random",
+                      seed=rng.randrange(1 << 32), round_cap=16,
+                      delivery="keys").validate() for _ in range(sessions)]
+
+
+def _session_counter(name: str) -> float:
+    """Sum of a counter's series in the live registry (0.0 if untouched)."""
+    ent = _metrics.snapshot().get(name)
+    if not ent:
+        return 0.0
+    return sum(s.get("value", 0.0) for s in ent.get("series", []))
+
+
+def _run_session_bench(args, policy, out) -> int:
+    """The ``--session-bench`` driver: the L-slot session path vs L
+    dependency-honoring independent requests over the same population, the
+    spec-§11 replay pin, and the schema-v1.12 session artifact."""
+    from byzantinerandomizedconsensus_tpu.backends.base import get_backend
+    from byzantinerandomizedconsensus_tpu.models import session as _session
+    from byzantinerandomizedconsensus_tpu.serve import admission
+    from byzantinerandomizedconsensus_tpu.serve.server import ConsensusServer
+
+    K, L = args.sessions, args.session_slots
+    cfgs = _session_population(K, args.seed)
+    bucket = admission.bucket_of(cfgs[0])
+    _metrics.configure()  # the reseed counter is part of the artifact
+    t_suite0 = time.perf_counter()
+    print(f"loadgen: session bench — {K} sessions x {L} slots, "
+          f"seed {args.seed}, bucket {bucket.label()}")
+
+    server = ConsensusServer(backend=args.backend, policy=policy,
+                             round_cap_ceiling=ROUND_CAP_CEILING)
+    with server:
+        warm_handles = warm_up(server, [bucket])
+        for h in warm_handles:
+            h.wait(timeout=1800.0)
+        # warm the chain seam too: one short session exercises the in-grid
+        # re-seed before the measured legs (it reuses the same programs —
+        # a derived seed is a dynamic operand, never a new program key —
+        # so this is belt-and-braces, not a compile)
+        pre = server.submit({**dataclasses.asdict(cfgs[0]),
+                             "session_slots": 2})
+        pre.wait(timeout=1800.0)
+        warm_compiles = server.compile_count()
+        reseeds0 = _session_counter("brc_session_reseeds_total")
+
+        # ---- leg A: K sessions, one submit each; slots chain in-grid.
+        t0 = time.perf_counter()
+        sess_handles = [server.submit({**dataclasses.asdict(c),
+                                       "session_slots": L}) for c in cfgs]
+        for h in sess_handles:
+            h.wait(timeout=1800.0)
+        wall_a = time.perf_counter() - t0
+
+        # ---- leg B: K concurrent clients, each submitting L single
+        # requests SEQUENTIALLY — the dependency is real (slot k+1's seed
+        # needs slot k's decision), so this is the honest alternative a
+        # session-less service forces on a replicated-log consumer.
+        results_b: list = [None] * K
+        errors: list = []
+
+        def client(i: int) -> None:
+            try:
+                cfg = cfgs[i]
+                recs = []
+                for k in range(L):
+                    h = server.submit(cfg)
+                    h.wait(timeout=1800.0)
+                    recs.append(h.record)
+                    if k + 1 < L:
+                        cfg = _session.next_slot_config(
+                            cfg, k, h.record["decision"])
+                results_b[i] = recs
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(f"client {i}: {e}")
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(i,),
+                                    name=f"brc-session-indep-{i}")
+                   for i in range(K)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall_b = time.perf_counter() - t0
+        if errors:
+            raise RuntimeError(f"independent-leg client errors: {errors}")
+
+        steady = server.compile_count() - warm_compiles
+        reseeds = int(_session_counter("brc_session_reseeds_total")
+                      - reseeds0)
+        server_stats = server.stats()
+
+    # ---- the pins: offline numpy replay of every measured session, and
+    # slot-for-slot bit-identity between the two legs.
+    be = get_backend("numpy")
+    mismatches = 0
+    replay_ok = True
+    for i, (cfg, h) in enumerate(zip(cfgs, sess_handles)):
+        blk = h.record["session"]
+        served = list(zip(blk["rounds"], blk["decisions"]))
+        if not _session.replay_matches(be, cfg, served):
+            replay_ok = False
+        for k in range(L):
+            rec = results_b[i][k]
+            if (blk["rounds"][k] != rec["rounds"]
+                    or blk["decisions"][k] != rec["decision"]):
+                mismatches += 1
+
+    decisions = K * L * int(cfgs[0].instances)
+    ratio = round(wall_b / wall_a, 3) if wall_a > 0 else None
+    stats = {
+        "sessions": K,
+        "slots": L,
+        "decisions": decisions,
+        "amortization_ratio": ratio,
+        "session_cps": round(decisions / wall_a, 3),
+        "independent_cps": round(decisions / wall_b, 3),
+        "steady_state_compiles": steady,
+        "mismatches": mismatches,
+        "replay_ok": replay_ok,
+        "generator_version": GENERATOR_VERSION,
+        "session_reseeds": reseeds,
+        "population": {"bucket": bucket.label(),
+                       "instances": int(cfgs[0].instances),
+                       "round_cap": int(cfgs[0].round_cap)},
+        "duration_s": round(time.perf_counter() - t_suite0, 3),
+    }
+    doc = {
+        **record.new_record(
+            "session",
+            description="Replicated-log session bench: K sessions of L "
+                        "chained decision slots resident in the grid vs "
+                        "L dependency-honoring independent requests — the "
+                        "spec-§11 amortization claim with the offline "
+                        "bit-replay pin."),
+        "seed": args.seed,
+        "backend": args.backend,
+        "policy": policy.doc(),
+        "session": record.session_block(stats),
+        "legs": {
+            "session": {"mode": "session", "wall_s": round(wall_a, 3),
+                        "throughput_cps": round(decisions / wall_a, 3)},
+            "independent": {"mode": "independent_chained",
+                            "wall_s": round(wall_b, 3),
+                            "throughput_cps": round(decisions / wall_b, 3)},
+        },
+        "compile_cache": server_stats["compile_cache"],
+    }
+    problems = record.validate_record(doc)
+    if problems:
+        print(f"loadgen: INVALID RECORD: {problems}", file=sys.stderr)
+        return 3
+    out.write_text(json.dumps(doc, indent=1) + "\n")
+    print(f"loadgen: wrote {out}")
+    print(f"loadgen: session {stats['session_cps']} dec/s vs independent "
+          f"{stats['independent_cps']} dec/s — amortization x{ratio}, "
+          f"{reseeds} in-grid reseeds, {steady} steady-state compiles, "
+          f"{mismatches} mismatches, replay "
+          f"{'OK' if replay_ok else 'FAIL'}")
+    if mismatches or not replay_ok:
+        return 1
+    if steady:
+        return 2
+    if args.min_amortization is not None and ratio is not None \
+            and ratio < args.min_amortization:
+        print(f"loadgen: amortization {ratio} below --min-amortization "
+              f"{args.min_amortization}", file=sys.stderr)
+        return 4
+    return 0
 
 
 def _fleet_leg(args, policy, k: int, stream, buckets,
@@ -743,6 +984,19 @@ def main(argv=None) -> int:
     ap.add_argument("--slo-error-rate", type=float, default=None,
                     help="enforce failed/(replied+failed) against the same "
                          "live /metrics scrape; breach = exit 5")
+    ap.add_argument("--session-bench", action="store_true",
+                    help="run the round-21 replicated-log session bench "
+                         "instead of the open-loop stream: K sessions of L "
+                         "chained slots vs L dependency-honoring "
+                         "independent requests (schema-v1.12 artifact)")
+    ap.add_argument("--sessions", type=int, default=8,
+                    help="session bench: number of sessions (K)")
+    ap.add_argument("--session-slots", type=int, default=12,
+                    help="session bench: chained decision slots per "
+                         "session (L)")
+    ap.add_argument("--min-amortization", type=float, default=1.5,
+                    help="session bench: exit 4 if the session-vs-"
+                         "independent decisions/s ratio falls below this")
     ap.add_argument("--rotation-cap", type=int, default=64,
                     help="fleet mode: max instance-lanes per dispatched "
                          "rotation (work-sharing granularity; default = one "
@@ -757,6 +1011,20 @@ def main(argv=None) -> int:
     if args.smoke:
         args.requests = min(args.requests, 24)
         args.reps = 1
+        args.sessions = min(args.sessions, 3)
+        args.session_slots = min(args.session_slots, 4)
+
+    if args.session_bench:
+        from byzantinerandomizedconsensus_tpu.utils import devices as _devices
+
+        _devices.ensure_live_backend()
+        if not any(a == "--policy" or a.startswith("--policy=")
+                   for a in raw):
+            args.policy = _SESSION_BENCH_POLICY
+        policy = _compaction.CompactionPolicy.parse(args.policy)
+        out = pathlib.Path(args.out or default_artifact("session"))
+        out.parent.mkdir(parents=True, exist_ok=True)
+        return _run_session_bench(args, policy, out)
 
     try:
         workers_list = [int(x) for x in str(args.workers).split(",")
@@ -790,16 +1058,17 @@ def main(argv=None) -> int:
     stream = fleet_request_stream(args.requests, args.seed, args.rate,
                                   workers=max(workers_list))
     digest = stream_digest(stream)
-    cfgs = [cfg for _, cfg in stream]
+    cfgs = [cfg for _, cfg, _ in stream]
+    n_sessions = sum(1 for _, _, s in stream if s > 1)
     buckets = []
     for cfg in cfgs:
         from byzantinerandomizedconsensus_tpu.serve import admission
         b = admission.bucket_of(cfg)
         if b not in buckets:
             buckets.append(b)
-    print(f"loadgen: {args.requests} requests, seed {args.seed}, "
-          f"rate {args.rate}/s, {len(buckets)} fused buckets, "
-          f"digest {digest[:12]}…")
+    print(f"loadgen: {args.requests} requests ({n_sessions} sessions), "
+          f"seed {args.seed}, rate {args.rate}/s, {len(buckets)} fused "
+          f"buckets, digest {digest[:12]}…")
 
     if fleet_mode:
         return _run_fleet(args, policy, workers_list, stream, digest, cfgs,

@@ -57,9 +57,11 @@ def test_span_kind_census_is_nontrivial_and_complete():
                      "fleet.shutdown", "hunt.run", "hunt.generation",
                      "hunt.harvest", "hunt.best", "hunt.violation",
                      "hunt.done", "serve.backpressure", "serve.cancel",
-                     "serve.rotate", "compaction.cancel"):
+                     "serve.rotate", "compaction.cancel",
+                     "compaction.reseed", "serve.session_open",
+                     "serve.session_slot", "serve.session_done"):
         assert expected in kinds, (expected, sorted(kinds))
-    assert len(kinds) >= 48
+    assert len(kinds) >= 52
 
 
 def test_every_emitted_span_kind_is_documented():
@@ -129,9 +131,12 @@ def test_metric_name_census_is_nontrivial_and_complete():
                      "brc_serve_cancelled_total",
                      "brc_serve_cancel_too_late_total",
                      "brc_serve_deadline_met_total",
-                     "brc_serve_deadline_missed_total"):
+                     "brc_serve_deadline_missed_total",
+                     "brc_session_reseeds_total", "brc_session_opened_total",
+                     "brc_session_slots_replied_total",
+                     "brc_session_completed_total"):
         assert expected in names, (expected, sorted(names))
-    assert len(names) >= 44
+    assert len(names) >= 48
 
 
 def test_every_registered_metric_is_documented():
@@ -165,6 +170,8 @@ def test_every_record_block_key_is_documented():
         "hunt": record.HUNT_BLOCK_KEYS,
         "hostile": record.HOSTILE_BLOCK_KEYS,
         "committee": record.COMMITTEE_BLOCK_KEYS,
+        "fused": record.FUSED_BLOCK_KEYS,
+        "session": record.SESSION_BLOCK_KEYS,
         "counters": ("supported", "totals"),
     }
     missing = []

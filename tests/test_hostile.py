@@ -25,7 +25,7 @@ import pytest
 
 from byzantinerandomizedconsensus_tpu.backends.base import get_backend
 from byzantinerandomizedconsensus_tpu.backends.compaction import (
-    CompactionPolicy)
+    CompactionPolicy, WorkFeed)
 from byzantinerandomizedconsensus_tpu.config import SimConfig
 from byzantinerandomizedconsensus_tpu.obs import record
 from byzantinerandomizedconsensus_tpu.serve import admission
@@ -104,6 +104,34 @@ def test_cancel_queued_and_live_survivors_bit_identical():
             _assert_bit_identical(cfgs[i], h.record)
     survivors = sum(1 for h in handles if h.record is not None)
     assert survivors + len(cancelled) == len(handles)
+
+
+def test_cancel_last_queued_item_keeps_session_owned_feed_open():
+    """Round-21 WorkFeed.cancel edge case: a spec-§11 session's slot 0 is
+    already flying (pulled into the grid) when the ONLY item still queued
+    is cancelled. The queue empties, but the feed — owned by the live
+    session, whose future slots materialize at the grid's retire seam, not
+    here — must NOT report drained (``pull() -> None``) even once closed;
+    that would close the feed out from under the dispatcher mid-session.
+    Only ``session_done`` (the boundary-reap release path) ends the
+    stream."""
+    feed = WorkFeed(round_cap_ceiling=_CEILING)
+    owner, bystander = object(), object()
+    feed.push(_cfg(60), token=owner, session=3)
+    items = feed.pull()
+    assert [(it[2], it[3]) for it in items] == [(owner, 3)]  # grid owns it
+    feed.push(_cfg(61), token=bystander)
+    assert feed.cancel(bystander) is True  # the last queued item dies
+    assert feed.pending() == 0
+    feed.close()
+    # empty + closed but session-owned: the stream stays open
+    assert feed.pull() == []
+    # cancelling the FLYING session releases nothing here either — the
+    # grid owns it now, so the reap path must still run session_done
+    assert feed.cancel(owner) is False
+    assert feed.pull() == []
+    feed.session_done(owner)
+    assert feed.pull() is None  # last owner gone: drained at last
 
 
 def test_tenant_hog_cannot_starve_interactive_tenant():

@@ -684,3 +684,70 @@ def test_census_includes_fused_artifact():
     report = ledger.format_report(doc)
     assert "fused-kernel columns" in report
     assert "DEBT: bit-match not yet re-run on TPU" in report
+
+def test_census_includes_session_artifact():
+    """The round-21 replicated-log session artifact: the spec-§11 chain
+    measured end to end — an L-slot session beating L independent requests
+    past the 1.5x amortization floor at zero steady-state compiles, zero
+    differential mismatches, and a bit-identical offline replay of every
+    measured session — with the schema-v1.12 session columns reconstructed
+    by the ledger."""
+    import pathlib
+
+    from byzantinerandomizedconsensus_tpu.utils.rounds import repo_root
+
+    doc = ledger.build_ledger()
+    assert doc["parse_errors"] == []
+    rows = {r["artifact"]: r for r in doc["session_rows"]}
+    assert "artifacts/session_r21.json" in rows, \
+        "session_r21.json must yield session-amortization columns"
+    row = rows["artifacts/session_r21.json"]
+    assert row["sessions"] >= 4 and row["slots"] >= 8
+    assert row["decisions"] == row["sessions"] * row["slots"] * 4  # inst=4
+    assert row["amortization_ratio"] >= 1.5   # the acceptance floor
+    assert row["session_cps"] > row["independent_cps"] > 0
+    assert row["steady_state_compiles"] == 0  # one program, L slots
+    assert row["mismatches"] == 0             # slot-for-slot cross-leg pin
+    assert row["replay_ok"] is True           # numpy replay from base seed
+
+    sv = json.loads((pathlib.Path(repo_root())
+                     / "artifacts/session_r21.json").read_text())
+    assert sv["kind"] == "session"
+    assert record.validate_record(sv) == []
+    assert sv["record_revision"] >= 12  # schema v1.12
+    sb = sv["session"]
+    assert sb["generator_version"] == 3
+    assert sb["session_reseeds"] >= sb["sessions"] * (sb["slots"] - 2)
+    assert sb["population"]["bucket"].startswith("fused/")
+
+    report = ledger.format_report(doc)
+    assert "session-amortization columns" in report
+    assert "replay OK" in report
+
+
+def test_debts_verb_prints_standing_rows(capsys):
+    """``brc-tpu ledger --debts``: the one-glance "what still owes a TPU
+    run" table. As committed, both standing families appear — the r5
+    device-chain anchor (every later round CPU-only) and the r20 fused
+    bit-match at device_of_record interpret/cpu — and the verb exits 0."""
+    doc = ledger.build_ledger()
+    debts = ledger.debts_of(doc)
+    assert {d["debt"] for d in debts} == {"device-chain", "fused-bitmatch"}
+    for d in debts:
+        assert d["where"] and d["evidence"] and d["closes_with"]
+
+    table = ledger.format_debts(doc)
+    lines = table.splitlines()
+    assert lines[0] == f"standing debts — {len(debts)} row(s)"
+    assert lines[1].split() == ["DEBT", "WHERE", "EVIDENCE", "CLOSES", "WITH"]
+    assert any(line.startswith("device-chain") for line in lines[2:])
+    assert any(line.startswith("fused-bitmatch") for line in lines[2:])
+
+    assert ledger.main(["--debts"]) == 0
+    out = capsys.readouterr().out
+    assert "device-chain" in out and "fused-bitmatch" in out
+
+    # a debt-free ledger renders the explicit all-clear, not an empty table
+    clean = {"device_chain": {"broken_rounds": []}, "fused_rows": []}
+    assert ledger.format_debts(clean) == "standing debts: none"
+    assert ledger.debts_of(clean) == []

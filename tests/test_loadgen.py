@@ -2,8 +2,10 @@
 
 The request stream must be a pure function of (generator_version, seed,
 requests, rate): two runs at the same seed reproduce the identical stream
-byte-for-byte (arrival times AND configs), and serving the stream returns
-results bit-identical to the offline batched path over the same configs.
+byte-for-byte (arrival times, configs AND session slot counts — generator
+v3 mixes spec-§11 sessions into the population), and serving the stream
+returns results bit-identical to the offline batched path over the same
+configs.
 """
 
 import dataclasses
@@ -17,7 +19,7 @@ from byzantinerandomizedconsensus_tpu.serve import admission
 from byzantinerandomizedconsensus_tpu.serve.server import ConsensusServer
 from byzantinerandomizedconsensus_tpu.tools import loadgen
 
-#: Pinned so the stream below stays 2 fused buckets (compile-light in CI);
+#: Pinned so the stream below stays 3 fused buckets (compile-light in CI);
 #: a generator change that moves it shows up as a digest change here.
 _SEED = 35
 
@@ -26,10 +28,10 @@ def test_stream_reproduces_byte_for_byte():
     a = loadgen.request_stream(40, seed=_SEED, rate=4.0)
     b = loadgen.request_stream(40, seed=_SEED, rate=4.0)
     assert loadgen.stream_digest(a) == loadgen.stream_digest(b)
-    assert [(t, dataclasses.asdict(c)) for t, c in a] == \
-        [(t, dataclasses.asdict(c)) for t, c in b]
+    assert [(t, dataclasses.asdict(c), s) for t, c, s in a] == \
+        [(t, dataclasses.asdict(c), s) for t, c, s in b]
     # arrival times strictly increase (open-loop Poisson gaps)
-    times = [t for t, _ in a]
+    times = [t for t, _, _ in a]
     assert all(t1 > t0 for t0, t1 in zip(times, times[1:]))
     # a different seed is a different stream
     c = loadgen.request_stream(40, seed=_SEED + 1, rate=4.0)
@@ -55,16 +57,34 @@ def test_stream_population_is_admissible():
     validated configs, round_cap at or under the ceiling, the three
     population modes all present at this size."""
     stream = loadgen.request_stream(120, seed=7, rate=4.0)
-    fat, keys = 0, 0
-    for _, cfg in stream:
+    fat, keys, sessions = 0, 0, 0
+    for _, cfg, slots in stream:
         cfg.validate()
         assert cfg.round_cap <= loadgen.ROUND_CAP_CEILING
+        assert 1 <= slots <= 8
         if cfg.instances > 32:
             fat += 1
         if cfg.delivery == "keys" and cfg.adversary == "none":
             keys += 1
+        if slots > 1:
+            sessions += 1
     assert fat > 0, "fat-tail shapes absent from the population"
     assert keys > 0, "keys-model validation traffic absent"
+    assert sessions > 0, "session traffic absent (generator v3 mix)"
+
+
+def test_generator_v3_session_mix_is_pinned():
+    """Generator v3 (round 21) draws a session slot count per request;
+    the draw is part of the stream, so the digest covers it — a slot-count
+    change at a fixed seed is a digest change, and the mix shows up at
+    modest stream sizes."""
+    assert loadgen.GENERATOR_VERSION == 3
+    stream = loadgen.request_stream(40, seed=_SEED, rate=4.0)
+    n_sessions = sum(1 for _, _, s in stream if s > 1)
+    assert n_sessions == 7  # seed pin: v3 mix at _SEED/40
+    mutated = [(t, c, (s + 1 if i == 0 else s))
+               for i, (t, c, s) in enumerate(stream)]
+    assert loadgen.stream_digest(mutated) != loadgen.stream_digest(stream)
 
 
 @pytest.mark.slow
@@ -73,8 +93,8 @@ def test_served_results_bit_identical_to_offline_batched_path():
     offline batched path (grid barrier, run_many over the shared compile
     cache): per-instance rounds/decisions equal bit-for-bit."""
     stream = loadgen.request_stream(6, seed=_SEED, rate=50.0)
-    cfgs = [c for _, c in stream]
-    assert len({admission.bucket_of(c) for c in cfgs}) == 2  # seed pin
+    cfgs = [c for _, c, _ in stream]
+    assert len({admission.bucket_of(c) for c in cfgs}) == 3  # seed pin
     policy = CompactionPolicy(width=8, segment=1)
     with ConsensusServer(policy=policy) as srv:
         handles = [srv.submit(c) for c in cfgs]

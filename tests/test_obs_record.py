@@ -65,7 +65,7 @@ def test_validate_record_rejects_unknown_revision():
                                            "record_revision": bad})), bad
     # Every revision this build knows — including the legacy implied-v1
     # absence — stays valid.
-    for ok in (None, 0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10,
+    for ok in (None, 0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11,
                record.RECORD_REVISION):
         doc = record.new_record("x")
         if ok is None:
@@ -230,6 +230,43 @@ def test_validate_record_checks_fused_block():
     assert record.fused_block(None) is None
 
 
+def test_validate_record_checks_session_block():
+    """Schema v1.12: a session block missing its required keys fails by
+    name (torn blocks caught at validate time, not in a future ledger);
+    the session bench's own block validates, with the optional columns
+    riding along."""
+    bad = {**record.new_record("session"), "session": {"sessions": 8}}
+    problems = record.validate_record(bad)
+    assert any("session block missing 'amortization_ratio'" in p
+               for p in problems)
+    assert any("'replay_ok'" in p for p in problems)
+    assert any(p.startswith("session block is not a dict") for p in
+               record.validate_record(
+                   {**record.new_record("session"), "session": []}))
+
+    stats = {
+        "sessions": 8, "slots": 12, "decisions": 384,
+        "amortization_ratio": 1.7, "session_cps": 1800.0,
+        "independent_cps": 1050.0, "steady_state_compiles": 0,
+        "mismatches": 0, "replay_ok": True,
+        "generator_version": 3, "session_reseeds": 88, "duration_s": 2.0}
+    good = {**record.new_record("session"),
+            "session": record.session_block(stats)}
+    assert record.validate_record(good) == []
+    assert good["session"]["session_reseeds"] == 88  # optionals ride
+
+    torn = {**good, "session": {**record.session_block(stats),
+                                "replay_ok": "yes"}}
+    assert any("'replay_ok' is not a bool" in p for p in
+               record.validate_record(torn))
+    torn2 = {**good, "session": {**record.session_block(stats),
+                                 "amortization_ratio": "1.7"}}
+    assert any("'amortization_ratio' is not a number" in p for p in
+               record.validate_record(torn2))
+
+    assert record.session_block(None) is None
+
+
 def test_timing_block_maps_suspect_to_error():
     """Absence-of-signal device 0.0s must land as errors (VERDICT r5 weak #1),
     real measurements as device_busy_s — the one mapping every tool shares."""
@@ -329,7 +366,7 @@ def test_schema_census_every_committed_artifact_validates():
     # artifacts must be in the checked set, so the unknown-revision,
     # serve-block, fleet-block, metrics-block, and hunt-block checks
     # above provably ran against real revision-4..8 heads.
-    assert len(checked) >= 11, checked
+    assert len(checked) >= 13, checked
     assert "programs_r13.json" in checked, checked
     assert "serve_r14.json" in checked, checked
     assert "serve_fleet_r15.json" in checked, checked
@@ -337,3 +374,4 @@ def test_schema_census_every_committed_artifact_validates():
     assert "hunt_r17.json" in checked, checked
     assert "hunt_regressions.json" in checked, checked
     assert "fused_r20.json" in checked, checked  # the v1.11 fused block
+    assert "session_r21.json" in checked, checked  # the v1.12 session block

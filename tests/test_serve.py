@@ -166,14 +166,14 @@ def test_workfeed_contract():
     feed.push(cfg, token="a")
     with pytest.raises(ValueError, match="exceeds the feed ceiling"):
         feed.push(SimConfig(n=4, f=1, round_cap=128))
-    assert feed.pull() == [(cfg, None, "a")]
+    assert feed.pull() == [(cfg, None, "a", None)]
     assert feed.pull() == []  # open + empty
     feed.push(cfg, token="b")
     feed.close()
     with pytest.raises(RuntimeError, match="closed WorkFeed"):
         feed.push(cfg)
     # items pushed before close are still drained, THEN the None sentinel
-    assert feed.pull() == [(cfg, None, "b")]
+    assert feed.pull() == [(cfg, None, "b", None)]
     assert feed.pull() is None
     assert feed.pull(block=True) is None
 

@@ -1,0 +1,145 @@
+"""Replicated-log session pins (round 21, tier-1).
+
+The spec-§11 law under test: a session is a pure function of
+(seed, config, L). Slot k+1's seed derives from slot k's decision vector
+through the SESSION_SEND PRF purpose, so the offline replay
+(models/session.run_session) must be bit-identical across backends AND
+bit-identical to what the serving stack streamed back — the in-grid lane
+re-seeding (backends/compaction.py retire seam) is an optimization, never
+an observable.
+"""
+
+import dataclasses
+
+import pytest
+
+from byzantinerandomizedconsensus_tpu.backends.base import get_backend
+from byzantinerandomizedconsensus_tpu.backends.compaction import (
+    CompactionPolicy)
+from byzantinerandomizedconsensus_tpu.config import SimConfig
+from byzantinerandomizedconsensus_tpu.models import session
+from byzantinerandomizedconsensus_tpu.ops import prf
+from byzantinerandomizedconsensus_tpu.serve import admission
+from byzantinerandomizedconsensus_tpu.serve.server import ConsensusServer
+
+
+def _cfg(seed=91, **kw):
+    base = dict(protocol="benor", n=4, f=1, instances=3, adversary="none",
+                coin="local", init="random", seed=seed, round_cap=24,
+                delivery="keys")
+    base.update(kw)
+    return SimConfig(**base).validate()
+
+
+# -- the chain law itself -------------------------------------------------
+
+def test_session_digest_folds_every_decision_bit():
+    """The §11 digest is the sequential LCG fold over the decision vector
+    (closed affine form == the loop), seeded by the slot index; every
+    entry — including an undecided-at-cap 2 — moves it."""
+    dec = [1, 0, 1, 2, 0]
+    d = (0 + 1) & 0xFFFFFFFF
+    for x in dec:
+        d = (prf.URN_LCG_A * d + x + 1) & 0xFFFFFFFF
+    assert prf.session_digest(0, dec) == d
+    # slot index is part of the digest; so is every decision position
+    assert prf.session_digest(1, dec) != prf.session_digest(0, dec)
+    for i in range(len(dec)):
+        flipped = list(dec)
+        flipped[i] = 1 - flipped[i] if flipped[i] in (0, 1) else 0
+        assert prf.session_digest(0, flipped) != prf.session_digest(0, dec)
+    assert prf.session_digest(3, []) == 4  # empty vector: d0 = slot + 1
+
+
+def test_next_slot_config_is_pure_seed_derivation():
+    """Chained init is seed derivation only: everything except the seed is
+    the base config, the derived seed is deterministic in
+    (seed, slot, decision), and it matches the prf law directly."""
+    cfg = _cfg()
+    dec = [1, 1, 0]
+    nxt = session.next_slot_config(cfg, 0, dec)
+    assert nxt.seed == prf.session_chain_seed(cfg.seed, 0, dec,
+                                             pack=cfg.pack_version)
+    assert dataclasses.replace(nxt, seed=cfg.seed) == cfg
+    assert session.next_slot_config(cfg, 0, dec) == nxt
+    assert session.next_slot_config(cfg, 1, dec).seed != nxt.seed
+    assert session.next_slot_config(cfg, 0, [1, 0, 0]).seed != nxt.seed
+
+
+def test_run_session_bit_identical_numpy_vs_jax():
+    """The offline replay law across backends: same (seed, config, L) →
+    the same per-slot seeds, rounds and decisions bit-for-bit on numpy
+    and jax (coordinate-addressed draws, never draw order)."""
+    cfg = _cfg(seed=77)
+    n_np = session.run_session(get_backend("numpy"), cfg, 4)
+    n_jx = session.run_session(get_backend("jax"), cfg, 4)
+    assert len(n_np) == len(n_jx) == 4
+    for a, b in zip(n_np, n_jx):
+        assert a.config.seed == b.config.seed
+        assert [int(x) for x in a.rounds] == [int(x) for x in b.rounds]
+        assert [int(x) for x in a.decision] == [int(x) for x in b.decision]
+    # the chain moved: at least one derived seed differs from the base
+    assert any(r.config.seed != cfg.seed for r in n_np[1:])
+    # and session_slot_configs re-derives exactly the configs that ran
+    redone = session.session_slot_configs(
+        cfg, [[int(x) for x in r.decision] for r in n_np])
+    assert [c.seed for c in redone] == [r.config.seed for r in n_np]
+
+
+def test_replay_matches_rejects_tampered_slots():
+    be = get_backend("numpy")
+    cfg = _cfg(seed=13)
+    ref = session.run_session(be, cfg, 3)
+    served = [([int(x) for x in r.rounds], [int(x) for x in r.decision])
+              for r in ref]
+    assert session.replay_matches(be, cfg, served)
+    rounds, decision = served[1]
+    assert not session.replay_matches(
+        be, cfg, [served[0], (rounds, [1 - decision[0]] + decision[1:]),
+                  served[2]])
+    assert not session.replay_matches(
+        be, cfg, [served[0], ([r + 1 for r in rounds], decision), served[2]])
+
+
+def test_session_envelope_admission_bounds():
+    """session_slots is an envelope key, never a SimConfig field: it is
+    popped before admit(), bounded by MAX_SESSION_SLOTS, and rejected by
+    name when malformed."""
+    payload = dataclasses.asdict(_cfg())
+    rest, env = admission.envelope({**payload, "session_slots": 5})
+    assert env["session_slots"] == 5
+    assert "session_slots" not in rest
+    assert not hasattr(admission.admit(rest), "session_slots")
+    for bad in (0, -1, session.MAX_SESSION_SLOTS + 1, True, 2.0, "4"):
+        with pytest.raises(ValueError):
+            admission.envelope({**payload, "session_slots": bad})
+    # None means "not a session", the pre-round-21 default
+    assert admission.envelope(
+        {**payload, "session_slots": None})[1]["session_slots"] == 1
+
+
+# -- the serving path against the offline law -----------------------------
+
+@pytest.mark.slow
+def test_served_session_bit_identical_to_offline_replay():
+    """A session served in-grid (lane re-seeding at the retire seam,
+    slot-by-slot streaming) replays bit-identically offline on numpy AND
+    jax from the base seed alone — the whole log is (seed, config, L)."""
+    cfg = _cfg(seed=35, instances=2)
+    slots = 4
+    policy = CompactionPolicy(width=8, segment=2)
+    with ConsensusServer(policy=policy) as srv:
+        h = srv.submit({**dataclasses.asdict(cfg), "session_slots": slots})
+        rec = h.wait(timeout=600.0)
+    blk = rec["session"]
+    assert blk["slots"] == slots and len(blk["rounds"]) == slots
+    # the reply's top level is slot 0 (existing differentials hold)
+    assert rec["rounds"] == blk["rounds"][0]
+    assert rec["decision"] == blk["decisions"][0]
+    served = list(zip(blk["rounds"], blk["decisions"]))
+    for backend in ("numpy", "jax"):
+        assert session.replay_matches(get_backend(backend), cfg, served), \
+            f"served session diverged from the {backend} offline replay"
+    # the streamed seeds are the chain the replay derives
+    ref = session.run_session(get_backend("numpy"), cfg, slots)
+    assert blk["seeds"] == [int(r.config.seed) for r in ref]
