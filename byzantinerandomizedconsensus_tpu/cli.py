@@ -339,7 +339,12 @@ def main(argv=None) -> int:
                         "zero steady-state recompiles; --workers N shards "
                         "the service across subprocess workers with "
                         "bucket-affine routing + work stealing "
-                        "(serve/fleet.py) (all further options pass "
+                        "(serve/fleet.py); --wal DIR journals every "
+                        "admission write-ahead and --recover DIR replays "
+                        "a crashed dispatcher's in-flight work "
+                        "bit-identically (serve/wal.py); --max-workers N "
+                        "turns on the metrics-driven autoscaler "
+                        "(serve/autoscale.py) (all further options pass "
                         "through)")
     sub.add_parser("loadgen",
                    help="seeded open-loop load generator for the service "
@@ -354,7 +359,10 @@ def main(argv=None) -> int:
                         "flash_crowd|heavy_tail|bucket_churn|tenant_hog|"
                         "cancel_storm|session_hog|all runs the hostile-"
                         "load suite (tools/hostile.py, schema-v1.9 "
-                        "hostile block); --session-bench measures the "
+                        "hostile block); --scenario dispatcher_kill|"
+                        "autoscale_crowd|elastic runs the round-22 "
+                        "durability/autoscaling drills (schema-v1.13 "
+                        "elastic block); --session-bench measures the "
                         "spec-§11 session amortization ratio (schema-"
                         "v1.12 session block)")
     sub.add_parser("dash",

@@ -119,6 +119,21 @@ base seed alone, spec §11). Carried by ``artifacts/session_r21.json``. Same
 compatibility rule as v1.1–v1.11: ``record_version`` stays 1, the revision
 is declarative, and the block shape is checked only when present.
 
+Schema v1.13 (round 22) adds the **elastic** block (:func:`elastic_block` —
+the durability/elasticity drills of tools/hostile.py, ``loadgen --scenario
+dispatcher_kill`` / ``autoscale_crowd``): the suite seed, one row per drill
+carrying its request counts, the number of requests recovered from the
+write-ahead admission log after a dispatcher SIGKILL, the named
+``recovering`` 503 rejections, autoscaler scale-up/scale-down event counts,
+and the standing pins — ``mismatches`` (every recovered or autoscaled reply
+bit-identical to the uninterrupted control and the offline differential,
+sessions included), ``steady_state_compiles`` (0 across scale events on
+pinned traffic), and the ``slo_ok`` verdict (the autoscaled fleet meets the
+p99 bound a pinned static fleet misses). Carried by
+``artifacts/elastic_r22.json``. Same compatibility rule as v1.1–v1.12:
+``record_version`` stays 1, the revision is declarative, and the block
+shape is checked only when present.
+
 tools/ledger.py consumes both this format and the legacy r1–r7 shapes;
 :func:`validate_record` is the schema check the tier-1 tests pin, and
 ``brc-tpu ledger --check`` (the regression sentinel) compares the committed
@@ -154,8 +169,12 @@ RECORD_VERSION = 1
 # pallas_pack_versions / fused_state_pack packing-law fields; v1.12
 # (round 21) the session block (spec §11 replicated-log sessions: the
 # L-slot-vs-L-independent amortization ratio, re-seed counts, and the
-# steady-compile / differential-mismatch / offline-replay pins).
-RECORD_REVISION = 12
+# steady-compile / differential-mismatch / offline-replay pins); v1.13
+# (round 22) the elastic block (durable/elastic serving: write-ahead
+# admission-log recovery counts from the dispatcher-kill drill, autoscaler
+# scale-event counts from the flash-crowd leg, the named recovering-503
+# rejections, and the bit-match / steady-compile / SLO pins).
+RECORD_REVISION = 13
 
 
 def env_fingerprint() -> dict:
@@ -614,6 +633,39 @@ def session_block(stats: dict | None) -> dict | None:
             if k in stats}
 
 
+#: The fields a schema-v1.13 ``elastic`` block must carry (the
+#: durability/elasticity drills of tools/hostile.py: suite identity,
+#: per-drill rows, WAL recovery and autoscale scale-event counts, and the
+#: suite-wide mismatch / steady-compile / SLO pins).
+ELASTIC_BLOCK_KEYS = ("suite_seed", "scenarios", "recovered",
+                      "scale_up_events", "scale_down_events",
+                      "mismatches", "steady_state_compiles", "slo_ok")
+
+#: The fields every row of an elastic block's ``scenarios`` list must carry
+#: (one row per seeded drill; the ledger's elastic columns).
+ELASTIC_SCENARIO_KEYS = ("scenario", "seed", "requests", "replied",
+                         "recovered", "rejected_recovering",
+                         "scale_up_events", "scale_down_events",
+                         "mismatches", "steady_state_compiles", "slo_ok")
+
+
+def elastic_block(stats: dict | None) -> dict | None:
+    """The schema-v1.13 ``elastic`` block from an elastic-drill stats dict
+    (tools/hostile.py ``dispatcher_kill`` / ``autoscale_crowd``). None in,
+    None out — a record without the block stays a valid v1.x record.
+    ``recovered`` counts the in-flight requests replayed from the
+    write-ahead admission log after the dispatcher SIGKILL; ``mismatches``,
+    ``steady_state_compiles`` and ``slo_ok`` are the pins whose committed
+    values (0, 0, True) are the round's claim."""
+    if stats is None:
+        return None
+    return {k: stats.get(k) for k in
+            (ELASTIC_BLOCK_KEYS + ("generator_version", "duration_s",
+                                   "static_p99_ms", "elastic_p99_ms",
+                                   "slo_ms"))
+            if k in stats}
+
+
 def validate_record(doc: dict) -> list:
     """Schema check: returns a list of problems (empty = valid v1 record)."""
     problems = []
@@ -810,6 +862,32 @@ def validate_record(doc: dict) -> list:
                                       or not isinstance(ratio, (int, float))):
                 problems.append(
                     "session block 'amortization_ratio' is not a number")
+    eb = doc.get("elastic")
+    if eb is not None:
+        if not isinstance(eb, dict):
+            problems.append("elastic block is not a dict")
+        else:
+            for key in ELASTIC_BLOCK_KEYS:
+                if key not in eb:
+                    problems.append(f"elastic block missing {key!r}")
+            ok = eb.get("slo_ok")
+            if ok is not None and not isinstance(ok, bool):
+                problems.append("elastic block 'slo_ok' is not a bool")
+            rows = eb.get("scenarios")
+            if rows is not None:
+                if not isinstance(rows, list):
+                    problems.append("elastic scenarios is not a list")
+                else:
+                    for i, row in enumerate(rows):
+                        if not isinstance(row, dict):
+                            problems.append(
+                                f"elastic scenario row {i} is not a dict")
+                            continue
+                        for key in ELASTIC_SCENARIO_KEYS:
+                            if key not in row:
+                                problems.append(
+                                    f"elastic scenario row {i} missing "
+                                    f"{key!r}")
     pg = doc.get("programs")
     if pg is not None:
         if not isinstance(pg, dict):

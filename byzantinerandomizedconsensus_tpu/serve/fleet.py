@@ -47,10 +47,23 @@ on this box every worker shares the host device (``shared: true``); a
 multi-device session gives each worker its own accelerator and the
 ``--segment-latency-s`` fabric stub becomes a real device round-trip.
 
+Round 22 adds elasticity and a budgeted failure path. ``scale_up()``
+spawns an extra worker through the same ladder; ``scale_down()`` marks the
+least-loaded worker **retiring** — it is excluded from routing and
+stealing, its parent-side queue re-admits to the survivors (the same
+re-admission path a crash uses, so replies stay bit-identical), it drains
+its in-flight rotation, and leaves through the graceful shutdown
+handshake: no ``brc_fleet_workers_lost_total`` increment, no
+``dead_workers`` entry, no 503. ``max_respawns`` (default 0 keeps the
+pre-22 behavior) lets the fleet replace a worker lost *mid-stream*:
+exponential backoff between replacements, and a **named terminal state**
+(``respawn_budget_exhausted`` in ``health()``/``stats()``) once the budget
+runs out, instead of silent permanent loss.
+
 Trace kinds (docs/OBSERVABILITY.md §3f, role ``fleet-coord``):
 ``fleet.spawn``, ``fleet.backoff``, ``fleet.route``, ``fleet.dispatch``,
 ``fleet.steal``, ``fleet.worker_lost``, ``fleet.readmit``,
-``fleet.shutdown``.
+``fleet.shutdown``, ``fleet.retire``, ``fleet.respawn``.
 """
 
 from __future__ import annotations
@@ -146,6 +159,13 @@ class _WorkerBase:
         self.fleet = fleet
         self.idx = idx
         self.alive = False
+        # autoscaler scale-down (round 22): retiring = excluded from
+        # routing/stealing while its in-flight rotation drains; retired =
+        # gone through the graceful handshake (never counted dead);
+        # replaced = crashed but re-covered by a budgeted respawn
+        self.retiring = False
+        self.retired = False
+        self.replaced = False
         self.pid: Optional[int] = None
         # the bucket whose rotation this worker currently runs (the
         # single-bucket-inflight invariant: every inflight req shares it)
@@ -210,7 +230,10 @@ class _ProcessWorker(_WorkerBase):
         if f._segment_latency_s > 0:
             argv += ["--segment-latency-s", str(f._segment_latency_s)]
         if f.placement is not None:
-            argv += ["--placement", json.dumps(f.placement[self.idx])]
+            # respawned / scaled-up workers carry indices past the initial
+            # placement list: they inherit a slot modulo the fleet shape
+            slot = f.placement[self.idx % len(f.placement)]
+            argv += ["--placement", json.dumps(slot)]
         env = dict(os.environ)
         if f._trace_dir is not None:
             env[_trace.TRACE_ENV] = str(f._trace_dir)
@@ -482,7 +505,9 @@ class FleetServer:
                  rotation_cap: Optional[int] = None,
                  rotation_queue_depth: Optional[int] = None,
                  tenant_inflight_cap: Optional[int] = None,
-                 aging_s: float = 5.0):
+                 aging_s: float = 5.0,
+                 max_respawns: int = 0,
+                 wal_dir=None):
         if workers < 1:
             raise ValueError(f"workers={workers} out of range (>= 1)")
         if mode not in ("process", "thread"):
@@ -537,9 +562,22 @@ class FleetServer:
         self._steals = 0
         self._readmitted = 0
         self._lost_workers = 0
+        self._retired_n = 0
         self._stop = False
         self._started = False
         self.placement: Optional[list] = None
+        # round 22: budgeted mid-stream respawns (0 = pre-22 behavior:
+        # a worker lost after the initial ladder stays lost)
+        if max_respawns < 0:
+            raise ValueError(f"max_respawns={max_respawns} out of range "
+                             "(>= 0)")
+        self._max_respawns = int(max_respawns)
+        self._respawns_used = 0
+        self._respawn_terminal: Optional[str] = None
+        # round 22: write-ahead admission log (durable-serving seam)
+        from byzantinerandomizedconsensus_tpu.serve.wal import WriteAheadLog
+        self._wal = WriteAheadLog(wal_dir) if wal_dir else None
+        self._recovering = False
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -574,8 +612,8 @@ class FleetServer:
 
     # -- submission & routing ----------------------------------------------
 
-    def submit(self, payload, pin_worker: Optional[int] = None
-               ) -> FleetRequest:
+    def submit(self, payload, pin_worker: Optional[int] = None,
+               _rid: Optional[str] = None) -> FleetRequest:
         """Admit a payload and route it. ``pin_worker`` bypasses affinity
         routing (the warm-up seam: the loadgen warms every bucket on every
         worker before measuring).
@@ -584,7 +622,12 @@ class FleetServer:
         (``tenant``/``deadline_ms``/``priority``); a configured
         rotation-queue bound or per-tenant cap rejects with
         :class:`~byzantinerandomizedconsensus_tpu.serve.admission
-        .Backpressure` (HTTP 429 + Retry-After)."""
+        .Backpressure` (HTTP 429 + Retry-After). While a WAL recovery
+        replay is in progress new submits reject with the named
+        ``recovering`` reason (HTTP 503 + Retry-After). ``_rid`` pins the
+        request id — the recovery path replays journaled envelopes under
+        their original ids, which is what keeps recovered replies
+        addressable (and bit-identical) to the dead dispatcher's."""
         payload, env = _admission.envelope(payload)
         cfg = _admission.admit(payload, round_cap_ceiling=self._ceiling)
         bucket = _admission.bucket_of(cfg)
@@ -593,6 +636,11 @@ class FleetServer:
                 raise RuntimeError("fleet is shutting down")
             if not self._started:
                 raise RuntimeError("fleet not started")
+            if self._recovering and _rid is None:
+                self._backpressure_locked(
+                    "recovering",
+                    "WAL recovery replay in progress; new work would "
+                    "interleave ahead of replayed work")
             tenant = env["tenant"]
             if self._tenant_cap is not None and \
                     self._tenant_inflight.get(tenant, 0) >= self._tenant_cap:
@@ -611,8 +659,12 @@ class FleetServer:
                     "overflow",
                     f"fleet rotation backlog is at its bound "
                     f"({self._rotation_queue_depth})")
-            self._counter += 1
-            req = FleetRequest(f"f{self._counter:06d}", cfg, bucket,
+            if _rid is None:
+                self._counter += 1
+                rid = f"f{self._counter:06d}"
+            else:
+                rid = _rid
+            req = FleetRequest(rid, cfg, bucket,
                                tenant=tenant,
                                deadline_ms=env["deadline_ms"],
                                priority=env["priority"],
@@ -622,7 +674,15 @@ class FleetServer:
             self._tenant_inflight[tenant] = \
                 self._tenant_inflight.get(tenant, 0) + 1
             self._submitted += 1
-            self._route_locked(req, pin_worker=pin_worker)
+        # The WAL write sits between admission and dispatch, outside the
+        # routing lock: the fsync group-commits across concurrent submits,
+        # and the request is not routable until the journal entry is
+        # durable. Recovery replays (_rid set) are already journaled.
+        if self._wal is not None and _rid is None:
+            self._wal.append_admit(req.id, dataclasses.asdict(cfg), env)
+        with self._cv:
+            if not req.done.is_set():    # raced with cancel()
+                self._route_locked(req, pin_worker=pin_worker)
         return req
 
     def _backpressure_locked(self, reason: str, msg: str) -> None:
@@ -689,6 +749,9 @@ class FleetServer:
                 req.error = "cancelled"
                 self._cancelled_n += 1
                 self._release_locked(req)
+                if self._wal is not None:
+                    # a cancelled request must not be replayed at recovery
+                    self._wal.append_done(req.id, failed=True)
                 req.done.set()
             self._cv.notify_all()
         if forward is not None:
@@ -703,18 +766,18 @@ class FleetServer:
 
     def _route_locked(self, req: FleetRequest,
                       pin_worker: Optional[int] = None) -> None:
-        alive = [w for w in self._workers if w.alive]
+        alive = [w for w in self._workers if w.alive and not w.retiring]
         if not alive:
             self._fail_locked(req, "no live fleet workers")
             return
         affinity = False
         if pin_worker is not None:
             w = self._workers[pin_worker]
-            if not w.alive:
+            if not w.alive or w.retiring:
                 raise RuntimeError(f"pinned worker {pin_worker} is dead")
         else:
             w = self._where.get(req.bucket)
-            affinity = w is not None and w.alive
+            affinity = w is not None and w.alive and not w.retiring
             if not affinity:
                 # new bucket: least-loaded live worker by lane-round
                 # weight (see Worker.load), ties to lowest idx — counting
@@ -751,7 +814,8 @@ class FleetServer:
             # idle peer a pump pass now (it will steal this — or an older —
             # pending rotation), not only on the reply path
             idle = next((o for o in self._workers
-                         if o.alive and o is not w and not o.inflight
+                         if o.alive and not o.retiring and o is not w
+                         and not o.inflight
                          and o.current_bucket is None and not o.pending),
                         None)
             if idle is not None:
@@ -838,11 +902,15 @@ class FleetServer:
             # peer would idle forever while the victim serially drains its
             # chunked rotations.
             for o in self._workers:
-                if (o.alive and o is not w and not o.inflight
-                        and o.current_bucket is None):
+                if (o.alive and not o.retiring and o is not w
+                        and not o.inflight and o.current_bucket is None):
                     self._pump_locked(o)
             cb = self._on_reply
             self._cv.notify_all()
+        if self._wal is not None:
+            # journal the completion BEFORE waking waiters: anyone who saw
+            # this reply must never see the request replayed at recovery
+            self._wal.append_done(req.id, failed=req.record is None)
         req.done.set()
         if req.record is not None and cb is not None:
             cb(req)
@@ -874,8 +942,9 @@ class FleetServer:
         """An idle worker takes its own most urgent (EDF; LPT among ties)
         pending rotation, else steals the most urgent rotation from the
         live peer with the heaviest stealable backlog (lane-round weight,
-        see Worker.load)."""
-        if not w.alive:
+        see Worker.load). A retiring worker neither pumps nor steals — it
+        only drains what it already holds."""
+        if not w.alive or w.retiring:
             return
         if w.pending:
             bucket = min(w.pending,
@@ -947,7 +1016,8 @@ class FleetServer:
             n_orphans = sum(len(r) for _, r in orphans)
             _trace.event("fleet.worker_lost", worker=w.idx, pid=w.pid,
                          orphans=n_orphans)
-            survivors = [o for o in self._workers if o.alive]
+            survivors = [o for o in self._workers
+                         if o.alive and not o.retiring]
             if not survivors:
                 for _, reqs in orphans:
                     for req in reqs:
@@ -964,6 +1034,8 @@ class FleetServer:
                             req.error = "cancelled"
                             self._cancelled_n += 1
                             self._release_locked(req)
+                            if self._wal is not None:
+                                self._wal.append_done(req.id, failed=True)
                             req.done.set()
                             continue
                         self._readmitted += 1
@@ -972,6 +1044,51 @@ class FleetServer:
                             "Orphaned requests re-admitted to survivors"
                         ).inc()
                         self._route_locked(req)
+            # budgeted mid-stream respawn (round 22): replace the lost
+            # worker with a fresh one after exponential backoff — or, once
+            # the budget is spent, land in a NAMED terminal state instead
+            # of silent permanent loss
+            if self._max_respawns > 0 and not self._stop:
+                if self._respawns_used < self._max_respawns:
+                    self._respawns_used += 1
+                    attempt = self._respawns_used
+                    delay = self._backoff_s * (2 ** (attempt - 1))
+                    _trace.event("fleet.respawn", lost_worker=w.idx,
+                                 attempt=attempt,
+                                 budget=self._max_respawns, delay_s=delay)
+                    threading.Thread(
+                        target=self._respawn, args=(delay, w),
+                        name=f"fleet-respawn-{attempt}",
+                        daemon=True).start()
+                elif self._respawn_terminal is None:
+                    self._respawn_terminal = "respawn_budget_exhausted"
+            self._cv.notify_all()
+
+    def _respawn(self, delay: float, lost) -> None:
+        """Replace a lost worker: back off, spawn through the same ladder
+        as the initial fleet, then join the routing fabric and pump. The
+        crashed worker is marked ``replaced`` so health goes green again;
+        a failed replacement spawn lands in the named terminal state."""
+        time.sleep(delay)
+        with self._cv:
+            if self._stop:
+                return
+            idx = len(self._workers)
+        cls = _ProcessWorker if self._mode == "process" else _ThreadWorker
+        w = cls(self, idx)
+        try:
+            w.start()
+        except RuntimeError:
+            with self._cv:
+                self._respawn_terminal = "respawn_budget_exhausted"
+                self._cv.notify_all()
+            return
+        _metrics.counter("brc_fleet_respawns_total",
+                         "Worker spawn retries (backoff ladder)").inc()
+        with self._cv:
+            lost.replaced = True
+            self._workers.append(w)
+            self._pump_locked(w)
             self._cv.notify_all()
 
     def _fail_locked(self, req: FleetRequest, why: str) -> None:
@@ -980,7 +1097,99 @@ class FleetServer:
         self._release_locked(req)
         _metrics.counter("brc_serve_failed_total",
                          "Requests failed after admission").inc()
+        if self._wal is not None:
+            self._wal.append_done(req.id, failed=True)
         req.done.set()
+
+    # -- elasticity (round 22) ---------------------------------------------
+
+    def scale_up(self) -> int:
+        """Spawn one extra worker through the same ready-or-timeout /
+        backoff ladder as the initial fleet and join it to the routing
+        fabric (it immediately pumps — i.e. steals — from the backlog).
+        Returns the new worker index. The new worker pays its own warm-up
+        compiles, exactly as an initial worker does (the r15 exemption)."""
+        with self._cv:
+            if not self._started or self._stop:
+                raise RuntimeError("fleet not running")
+            idx = len(self._workers)
+        cls = _ProcessWorker if self._mode == "process" else _ThreadWorker
+        w = cls(self, idx)
+        w.start()   # outside the lock: the ladder can take seconds
+        with self._cv:
+            self._workers.append(w)
+            self._pump_locked(w)
+            self._cv.notify_all()
+        return idx
+
+    def scale_down(self, idx: Optional[int] = None) -> Optional[int]:
+        """Gracefully retire one worker (the least-loaded routable one,
+        or ``idx``). The worker is marked **retiring** — excluded from
+        routing and stealing, never reported dead — its parent-side queue
+        re-admits to the survivors through the same path a crash uses
+        (same fleet ids, so replies stay bit-identical), and once its
+        in-flight rotation drains it leaves through the graceful shutdown
+        handshake. Returns the retired index, or None when only one
+        routable worker remains (the fleet never scales to zero)."""
+        with self._cv:
+            routable = [w for w in self._workers
+                        if w.alive and not w.retiring]
+            if len(routable) <= 1:
+                return None
+            if idx is None:
+                # least loaded; ties to the HIGHEST index so a fleet that
+                # scaled up and back down returns to its original shape
+                w = min(routable, key=lambda o: (o.load(), o.queued(),
+                                                 -o.idx))
+            else:
+                w = self._workers[idx]
+                if not w.alive or w.retiring:
+                    return None
+            w.retiring = True
+            self._retired_n += 1
+            _metrics.counter(
+                "brc_fleet_retired_total",
+                "Workers gracefully retired by scale-down").inc()
+            orphans = list(w.pending.items())
+            w.pending.clear()
+            w.pinned.clear()
+            for bucket in [b for b, o in self._where.items() if o is w]:
+                del self._where[bucket]
+            _trace.event("fleet.retire", worker=w.idx,
+                         inflight=len(w.inflight),
+                         orphans=sum(len(r) for _, r in orphans))
+            for bucket, reqs in orphans:
+                _trace.event("fleet.readmit", worker=w.idx,
+                             bucket=bucket.label(), requests=len(reqs))
+                for req in reqs:
+                    if req.cancelled:
+                        continue
+                    self._readmitted += 1
+                    _metrics.counter(
+                        "brc_fleet_readmitted_total",
+                        "Orphaned requests re-admitted to survivors").inc()
+                    self._route_locked(req)
+            self._cv.notify_all()
+        threading.Thread(target=self._finish_retire, args=(w,),
+                         name=f"fleet-retire-w{w.idx}", daemon=True).start()
+        return w.idx
+
+    def _finish_retire(self, w) -> None:
+        """Drain-then-leave for a retiring worker: wait for its in-flight
+        rotation to resolve, then run the graceful shutdown handshake —
+        the ``bye`` path, so ``_worker_lost`` (and the lost-worker
+        counter, and the ``dead_workers`` health row) never fires."""
+        with self._cv:
+            while w.inflight and w.alive and not self._stop:
+                self._cv.wait(timeout=1.0)
+            if not w.alive or self._stop:
+                return   # crashed mid-drain (handled as a loss) or torn
+                         # down by shutdown(), which owns the handshake
+        w.request_shutdown()
+        w.finish_shutdown()
+        with self._cv:
+            w.retired = True
+            self._cv.notify_all()
 
     # -- teardown ----------------------------------------------------------
 
@@ -1016,7 +1225,56 @@ class FleetServer:
         _trace.event("fleet.shutdown", submitted=self._submitted,
                      replied=self._replied, failed=self._failed,
                      steals=self._steals, readmitted=self._readmitted,
-                     lost_workers=self._lost_workers)
+                     lost_workers=self._lost_workers,
+                     retired=self._retired_n)
+        if self._wal is not None:
+            self._wal.close()
+
+    # -- WAL recovery (round 22) -------------------------------------------
+
+    @property
+    def recovering(self) -> bool:
+        return self._recovering
+
+    def recover(self, timeout: Optional[float] = None,
+                on_submitted=None) -> dict:
+        """Replay the WAL's admitted-but-unreplied envelopes through
+        normal admission under their original request ids and wait for
+        their replies. Deterministic replay makes each recovered reply
+        bit-identical to what the dead dispatcher would have returned
+        (spec-§11 session logs included). While the replay runs, external
+        submits reject with the named ``recovering`` 503. Recovering twice
+        is a no-op: replayed completions are journaled, so the second plan
+        is empty."""
+        from byzantinerandomizedconsensus_tpu.serve import wal as _wal
+        if self._wal is None:
+            raise RuntimeError("recover() needs a WAL (wal_dir=...)")
+        pairs, counter = _wal.recover_payloads(self._wal.directory)
+        with self._cv:
+            self._counter = max(self._counter, counter)
+            self._recovering = True
+        handles = []
+        try:
+            for rid, payload in pairs:
+                while True:
+                    try:
+                        handles.append(self.submit(payload, _rid=rid))
+                        break
+                    except _admission.Backpressure as e:
+                        time.sleep(e.retry_after_s)
+                if on_submitted is not None:
+                    on_submitted(handles[-1])
+            for h in handles:
+                h.done.wait(timeout)
+        finally:
+            with self._cv:
+                self._recovering = False
+                self._cv.notify_all()
+        recovered = sum(1 for h in handles if h.record is not None)
+        _trace.event("serve.recovered", replayed=len(handles),
+                     recovered=recovered)
+        return {"replayed": len(handles), "recovered": recovered,
+                "ids": [h.id for h in handles], "handles": handles}
 
     # -- monitoring --------------------------------------------------------
 
@@ -1040,15 +1298,25 @@ class FleetServer:
                     for w in self._workers]
             out = {
                 "mode": self._mode,
-                "workers": self._n_workers,
+                "workers": sum(1 for w in self._workers
+                               if not w.retired and not w.replaced),
                 "alive": sum(1 for w in self._workers if w.alive),
+                # workers new admissions can route to (alive, not draining
+                # toward retirement) — the autoscaler's denominator
+                "routable": sum(1 for w in self._workers
+                                if w.alive and not w.retiring),
                 "submitted": self._submitted,
                 "replied": self._replied,
                 "failed": self._failed,
                 "cancelled": self._cancelled_n,
+                "recovering": self._recovering,
                 "steals": self._steals,
                 "readmitted": self._readmitted,
                 "lost_workers": self._lost_workers,
+                "retired_workers": self._retired_n,
+                "respawns": {"budget": self._max_respawns,
+                             "used": self._respawns_used,
+                             "terminal": self._respawn_terminal},
                 "policy": self._policy.doc(),
                 "round_cap_ceiling": self._ceiling,
                 "rotation_cap": self._rotation_cap,
@@ -1078,15 +1346,29 @@ class FleetServer:
         return out
 
     def health(self) -> dict:
-        """Liveness doc for ``GET /healthz``: the fleet never respawns a
-        worker after its initial backoff ladder, so any non-alive worker is
-        down for good — the doc goes non-ok and names it."""
+        """Liveness doc for ``GET /healthz``. A worker that crashed
+        mid-stream is **dead** (the doc goes non-ok and names it — unless
+        a ``max_respawns`` budget replaces it); a worker the autoscaler
+        retired left through the graceful handshake and is neither dead
+        nor counted, so scale-down never trips a health probe. Extra keys
+        appear only when the state they report is non-empty (``retiring``
+        while a drain is in progress, ``terminal`` once the respawn budget
+        is exhausted)."""
         with self._cv:
-            total = len(self._workers)
-            dead = [w.idx for w in self._workers if not w.alive]
+            counted = [w for w in self._workers
+                       if not w.retired and not w.replaced]
+            dead = [w.idx for w in counted if not w.alive]
+            retiring = [w.idx for w in counted if w.alive and w.retiring]
+            terminal = self._respawn_terminal
+        total = len(counted)
         ok = self._started and total > 0 and not dead
-        return {"ok": ok, "workers": total, "alive": total - len(dead),
-                "dead_workers": dead}
+        out = {"ok": ok, "workers": total, "alive": total - len(dead),
+               "dead_workers": dead}
+        if retiring:
+            out["retiring"] = retiring
+        if terminal is not None:
+            out["terminal"] = terminal
+        return out
 
     def refresh_metrics(self) -> None:
         """Update fleet gauges and pull each live worker's registry
@@ -1094,8 +1376,11 @@ class FleetServer:
         if not _metrics.enabled():
             return
         with self._cv:
+            # retired / replaced workers left the fleet cleanly: their
+            # per-worker gauges would read as dead rows on the dash
             rows = [(w, w.idx, w.alive, w.load(), len(w.inflight))
-                    for w in self._workers]
+                    for w in self._workers
+                    if not w.retired and not w.replaced]
             tenants = {t: self._tenant_inflight.get(t, 0)
                        for t in set(self._tenant_inflight)
                        | set(self._tenant_served)}

@@ -44,6 +44,7 @@ GET /stats and GET /healthz for monitoring.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import random
@@ -134,7 +135,8 @@ class ConsensusServer:
                  feed_depth: Optional[int] = None,
                  rotation_queue_depth: Optional[int] = None,
                  tenant_inflight_cap: Optional[int] = None,
-                 aging_s: float = 5.0):
+                 aging_s: float = 5.0,
+                 wal_dir=None):
         from byzantinerandomizedconsensus_tpu.backends.base import get_backend
 
         self._backend = get_backend(backend)
@@ -187,6 +189,12 @@ class ConsensusServer:
         self._tenant_inflight: dict = {}
         self._tenant_served: dict = {}
         self._thread: Optional[threading.Thread] = None
+        # round 22: write-ahead admission log — every admitted envelope is
+        # journaled (durably) before dispatch, so a dispatcher crash loses
+        # nothing: recover() replays incomplete entries bit-identically
+        from byzantinerandomizedconsensus_tpu.serve.wal import WriteAheadLog
+        self._wal = WriteAheadLog(wal_dir) if wal_dir else None
+        self._recovering = False
         # The persistent XLA compilation cache (BRC_COMPILATION_CACHE) keeps
         # warm-up compiles across server restarts, not just across requests.
         _batch.maybe_enable_cache_from_env()
@@ -227,10 +235,13 @@ class ConsensusServer:
             self._cv.notify_all()
         if self._thread is not None:
             self._thread.join(timeout)
+        if self._wal is not None:
+            self._wal.close()
 
     # -- submission --------------------------------------------------------
 
-    def submit(self, payload, check_invariants: bool = False) -> ServeRequest:
+    def submit(self, payload, check_invariants: bool = False,
+               _rid: Optional[str] = None) -> ServeRequest:
         """Admit a request payload and queue it. Returns the
         :class:`ServeRequest` handle; ``handle.wait()`` blocks for the
         reply record. Raises on invalid payloads or a stopped server.
@@ -263,6 +274,12 @@ class ConsensusServer:
         with self._cv:
             if self._stop:
                 raise RuntimeError("server is shutting down")
+            if self._recovering and _rid is None:
+                # round 22: replay in progress — new work must not
+                # interleave ahead of the dead dispatcher's admissions
+                self._backpressure_locked(
+                    "recovering",
+                    "WAL recovery replay in progress")
             tenant = env["tenant"]
             if self._tenant_cap is not None and \
                     self._tenant_inflight.get(tenant, 0) >= self._tenant_cap:
@@ -270,53 +287,75 @@ class ConsensusServer:
                     "tenant_cap",
                     f"tenant {tenant!r} is at its in-flight cap "
                     f"({self._tenant_cap})")
-            self._counter += 1
-            req = ServeRequest(f"r{self._counter:06d}", cfg, bucket,
+            if _rid is None:
+                self._counter += 1
+                rid = f"r{self._counter:06d}"
+            else:
+                rid = _rid  # recovery replay keeps the original id
+            req = ServeRequest(rid, cfg, bucket,
                                check_invariants=env["check_invariants"],
                                tenant=tenant,
                                deadline_ms=env["deadline_ms"],
                                priority=env["priority"],
                                session_slots=env["session_slots"])
-            placed = False
-            if self._active is not None and self._active[0] == bucket:
-                try:
-                    self._active[1].push(cfg, token=req,
-                                         session=req.session_slots)
-                    req.t_dispatch = time.perf_counter()
-                    self._active[2].append(req)
-                    self._tenant_served[tenant] = \
-                        self._tenant_served.get(tenant, 0) + weight
-                    if _metrics.enabled():
-                        _metrics.counter(
-                            "brc_serve_tenant_served_weight_total",
-                            "Lane-round weight dispatched, by tenant",
-                            tenant=tenant).inc(weight)
-                    placed = True
-                except _compaction.WorkFeedOverflow:
-                    # a bounded feed refuses the join outright: queueing it
-                    # anyway would defeat backpressure, so the client is
-                    # told to retry (it likely lands in the next rotation)
-                    self._backpressure_locked(
-                        "overflow",
-                        f"active feed for {bucket.label()} is at its bound "
-                        f"({self._feed_depth})")
-                except RuntimeError:
-                    # the feed closed under us (rotation/shutdown race):
-                    # the request queues for the bucket's next grid
-                    placed = False
-            if not placed:
-                if self._rotation_queue_depth is not None and \
-                        sum(len(v) for v in self._pending.values()) \
-                        >= self._rotation_queue_depth:
-                    self._backpressure_locked(
-                        "overflow",
-                        f"rotation queue is at its bound "
-                        f"({self._rotation_queue_depth})")
-                self._pending.setdefault(bucket, []).append(req)
-                if self._active is not None and self._active[0] != bucket:
-                    # rotation: the resident grid stops refilling, drains
-                    # its stragglers, and yields to this bucket
-                    self._active[1].close()
+        # round 22: journal the admitted envelope OUTSIDE the dispatch lock
+        # (group-committed fsync must not serialize the dispatcher) and
+        # strictly BEFORE placement — a crash after this line loses nothing.
+        # Replays skip re-journaling: their admit entry already exists.
+        if self._wal is not None and _rid is None:
+            self._wal.append_admit(req.id, dataclasses.asdict(cfg), env)
+        with self._cv:
+            if self._stop:
+                if self._wal is not None and _rid is None:
+                    self._wal.append_done(req.id, failed=True)
+                raise RuntimeError("server is shutting down")
+            try:
+                placed = False
+                if self._active is not None and self._active[0] == bucket:
+                    try:
+                        self._active[1].push(cfg, token=req,
+                                             session=req.session_slots)
+                        req.t_dispatch = time.perf_counter()
+                        self._active[2].append(req)
+                        self._tenant_served[tenant] = \
+                            self._tenant_served.get(tenant, 0) + weight
+                        if _metrics.enabled():
+                            _metrics.counter(
+                                "brc_serve_tenant_served_weight_total",
+                                "Lane-round weight dispatched, by tenant",
+                                tenant=tenant).inc(weight)
+                        placed = True
+                    except _compaction.WorkFeedOverflow:
+                        # a bounded feed refuses the join outright: queueing
+                        # it anyway would defeat backpressure, so the client
+                        # is told to retry (it likely lands next rotation)
+                        self._backpressure_locked(
+                            "overflow",
+                            f"active feed for {bucket.label()} is at its "
+                            f"bound ({self._feed_depth})")
+                    except RuntimeError:
+                        # the feed closed under us (rotation/shutdown race):
+                        # the request queues for the bucket's next grid
+                        placed = False
+                if not placed:
+                    if self._rotation_queue_depth is not None and \
+                            sum(len(v) for v in self._pending.values()) \
+                            >= self._rotation_queue_depth:
+                        self._backpressure_locked(
+                            "overflow",
+                            f"rotation queue is at its bound "
+                            f"({self._rotation_queue_depth})")
+                    self._pending.setdefault(bucket, []).append(req)
+                    if self._active is not None and self._active[0] != bucket:
+                        # rotation: the resident grid stops refilling, drains
+                        # its stragglers, and yields to this bucket
+                        self._active[1].close()
+            except _admission.Backpressure:
+                # the journaled admit was refused after all — close it so
+                # recovery never replays a request the client saw rejected
+                if self._wal is not None and _rid is None:
+                    self._wal.append_done(req.id, failed=True)
+                raise
             self._submitted += 1
             self._byid[req.id] = req
             self._tenant_inflight[tenant] = \
@@ -401,6 +440,10 @@ class ConsensusServer:
             req.error = "cancelled"
             self._cancelled_n += 1
             self._release_locked(req)
+            if self._wal is not None:
+                # a cancelled request must not rise from the dead at
+                # recovery: close its journal entry like any other reply
+                self._wal.append_done(req.id, failed=True)
             req.done.set()
             self._cv.notify_all()
         if _metrics.enabled():
@@ -611,6 +654,10 @@ class ConsensusServer:
                         max(0.0, req.t_reply - req.t_dispatch))
         _trace.event("serve.reply", id=req.id, bucket=req.bucket.label(),
                      latency_s=round(req.latency_s, 6))
+        if self._wal is not None:
+            # journal the completion BEFORE waking waiters: anyone who saw
+            # this reply must never see the request replayed at recovery
+            self._wal.append_done(req.id)
         req.done.set()
         if self._on_reply is not None:
             self._on_reply(req)
@@ -622,6 +669,8 @@ class ConsensusServer:
         self._release_locked(req)
         _metrics.counter("brc_serve_failed_total",
                          "Requests failed after admission").inc()
+        if self._wal is not None:
+            self._wal.append_done(req.id, failed=True)
         req.done.set()
 
     def _reply_record(self, req: ServeRequest, result) -> dict:
@@ -683,6 +732,56 @@ class ConsensusServer:
             "detail": viols[:8],
         }
 
+    # -- WAL recovery (round 22) -------------------------------------------
+
+    @property
+    def recovering(self) -> bool:
+        """True while a WAL replay is in flight (fresh submits get the
+        named ``recovering`` backpressure — HTTP 503 + Retry-After)."""
+        return self._recovering
+
+    def recover(self, timeout: Optional[float] = None,
+                on_submitted=None) -> dict:
+        """Replay the WAL's admitted-but-unreplied envelopes through
+        normal admission under their original request ids and wait for
+        their replies. Deterministic replay makes each recovered reply
+        bit-identical to what the dead dispatcher would have returned
+        (spec-§11 session logs included). While the replay runs, external
+        submits reject with the named ``recovering`` 503. Recovering twice
+        is a no-op: replayed completions are journaled, so the second plan
+        is empty. ``on_submitted`` (optional) is called with each handle
+        right after its re-admission — the HTTP front end registers them
+        so ``/result/<original id>`` answers for recovered requests."""
+        from byzantinerandomizedconsensus_tpu.serve import wal as _wal
+        if self._wal is None:
+            raise RuntimeError("recover() needs a WAL (wal_dir=...)")
+        pairs, counter = _wal.recover_payloads(self._wal.directory)
+        with self._cv:
+            self._counter = max(self._counter, counter)
+            self._recovering = True
+        handles = []
+        try:
+            for rid, payload in pairs:
+                while True:
+                    try:
+                        handles.append(self.submit(payload, _rid=rid))
+                        break
+                    except _admission.Backpressure as e:
+                        time.sleep(e.retry_after_s)
+                if on_submitted is not None:
+                    on_submitted(handles[-1])
+            for h in handles:
+                h.done.wait(timeout)
+        finally:
+            with self._cv:
+                self._recovering = False
+                self._cv.notify_all()
+        recovered = sum(1 for h in handles if h.record is not None)
+        _trace.event("serve.recovered", replayed=len(handles),
+                     recovered=recovered)
+        return {"replayed": len(handles), "recovered": recovered,
+                "ids": [h.id for h in handles], "handles": handles}
+
     # -- monitoring --------------------------------------------------------
 
     def stats(self) -> dict:
@@ -708,6 +807,7 @@ class ConsensusServer:
                 "replied": self._replied,
                 "failed": self._failed,
                 "cancelled": self._cancelled_n,
+                "recovering": self._recovering,
                 "active_bucket": active,
                 "pending": pending,
                 # round-18 traffic plane: per-tenant outstanding requests
@@ -894,12 +994,16 @@ def serve_http(server: ConsensusServer, host: str = "127.0.0.1",
                     _admission.Backpressure) as e:
                 # backpressure, not failure: 429 + a Retry-After hint
                 # (seeded jitter) — before the RuntimeError→503 arm, since
-                # both types subclass RuntimeError
+                # both types subclass RuntimeError. The round-22 exception:
+                # a WAL replay in flight answers 503 (unavailable, not
+                # overloaded) so fresh work can't interleave ahead of the
+                # dead dispatcher's admissions; Retry-After still rides.
                 retry_after = getattr(e, "retry_after_s", 0.1)
+                reason = getattr(e, "reason", "overflow")
                 return self._reply(
-                    429,
+                    503 if reason == "recovering" else 429,
                     {"error": str(e),
-                     "reason": getattr(e, "reason", "overflow"),
+                     "reason": reason,
                      "retry_after_s": retry_after},
                     headers={"Retry-After": f"{retry_after:.3f}"})
             except RuntimeError as e:
@@ -913,7 +1017,11 @@ def serve_http(server: ConsensusServer, host: str = "127.0.0.1",
             except Exception as e:  # timeout / failed dispatch
                 return self._reply(500, {"id": req.id, "error": str(e)})
 
-    return ThreadingHTTPServer((host, port), Handler)
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    # round 22: the recovery thread registers replayed handles here so
+    # /result/<original id> answers for recovered requests too
+    httpd.requests, httpd.requests_lock = requests, lock
+    return httpd
 
 
 def main(argv=None) -> int:
@@ -957,27 +1065,58 @@ def main(argv=None) -> int:
     ap.add_argument("--tenant-cap", type=int, default=0,
                     help="per-tenant outstanding-request cap "
                          "(0 = uncapped, the pinned default)")
+    ap.add_argument("--wal", default=None, metavar="DIR",
+                    help="write-ahead admission log (round 22): journal "
+                         "every admitted envelope to DIR before dispatch "
+                         "so a dispatcher crash loses nothing; see "
+                         "--recover and docs/SERVING.md §Durability")
+    ap.add_argument("--recover", default=None, metavar="DIR",
+                    help="replay DIR's incomplete WAL entries through "
+                         "normal admission under their original request "
+                         "ids before taking new work (implies --wal DIR); "
+                         "deterministic replay makes recovered replies "
+                         "bit-identical; new submits get 503 + Retry-After "
+                         "while the replay runs")
+    ap.add_argument("--max-respawns", type=int, default=0,
+                    help="budget for respawning crashed fleet workers "
+                         "(exponential backoff between attempts; a named "
+                         "terminal state when exhausted; 0 = the pinned "
+                         "no-respawn default)")
+    ap.add_argument("--min-workers", type=int, default=0,
+                    help="autoscaler floor (used with --max-workers; "
+                         "defaults to --workers)")
+    ap.add_argument("--max-workers", type=int, default=0,
+                    help=">0 enables the metrics-driven autoscaler "
+                         "(serve/autoscale.py): scale the fleet between "
+                         "--min-workers and this ceiling on queue-wait "
+                         "p99 / backlog pressure")
     args = ap.parse_args(argv)
 
+    wal_dir = args.recover or args.wal
+    autoscale = args.max_workers > 0
+    n_workers = max(args.workers, args.min_workers, 1)
+    use_fleet = n_workers > 1 or autoscale
     if args.trace_dir:
         _trace.configure(out_dir=args.trace_dir,
-                         role="fleet-coord" if args.workers > 1 else "serve")
+                         role="fleet-coord" if use_fleet else "serve")
     if args.metrics:
         _metrics.configure()
     else:
         _metrics.maybe_enable_from_env()
     _devices.ensure_live_backend()
     policy = _compaction.CompactionPolicy.parse(args.policy)
-    if args.workers > 1:
+    if use_fleet:
         from byzantinerandomizedconsensus_tpu.serve.fleet import FleetServer
 
-        server_cm = FleetServer(workers=args.workers, backend=args.backend,
+        server_cm = FleetServer(workers=n_workers, backend=args.backend,
                                 policy=policy,
                                 round_cap_ceiling=args.round_cap_ceiling,
                                 trace_dir=args.trace_dir,
                                 rotation_queue_depth=(
                                     args.rotation_queue_depth or None),
-                                tenant_inflight_cap=args.tenant_cap or None)
+                                tenant_inflight_cap=args.tenant_cap or None,
+                                max_respawns=args.max_respawns,
+                                wal_dir=wal_dir)
     else:
         server_cm = ConsensusServer(backend=args.backend, policy=policy,
                                     round_cap_ceiling=args.round_cap_ceiling,
@@ -985,12 +1124,35 @@ def main(argv=None) -> int:
                                     rotation_queue_depth=(
                                         args.rotation_queue_depth or None),
                                     tenant_inflight_cap=args.tenant_cap
-                                    or None)
+                                    or None,
+                                    wal_dir=wal_dir)
     with server_cm as srv:
         httpd = serve_http(srv, host=args.host, port=args.port)
+        scaler = None
+        if autoscale:
+            from byzantinerandomizedconsensus_tpu.serve.autoscale import (
+                Autoscaler)
+            scaler = Autoscaler(srv, min_workers=max(1, args.min_workers),
+                                max_workers=args.max_workers)
+            scaler.start()
+        if args.recover:
+            # replay in the background while the HTTP plane answers 503s;
+            # recovered handles register so /result/<original id> works
+            def _register(handle):
+                with httpd.requests_lock:
+                    httpd.requests[handle.id] = handle
+
+            def _replay():
+                rec = srv.recover(on_submitted=_register)
+                print(f"brc-tpu serve: recovery replayed "
+                      f"{rec['replayed']} request(s), "
+                      f"{rec['recovered']} recovered")
+
+            threading.Thread(target=_replay, name="wal-recover",
+                             daemon=True).start()
         print(f"brc-tpu serve: listening on http://{args.host}:{args.port} "
               f"(policy {policy.doc()}, cap ceiling "
-              f"{args.round_cap_ceiling}, workers {args.workers})")
+              f"{args.round_cap_ceiling}, workers {n_workers})")
         try:
             httpd.serve_forever()
         except KeyboardInterrupt:
@@ -998,6 +1160,8 @@ def main(argv=None) -> int:
         finally:
             httpd.shutdown_requested = True
             httpd.server_close()
+            if scaler is not None:
+                scaler.stop()
     return 0
 
 

@@ -5,8 +5,11 @@ Prometheus text exposition from obs/metrics.py) and renders a compact
 terminal view: request p50/p99 + throughput, admission/rejection
 counters, grid occupancy and refill depth, compile-cache deltas (the
 zero-steady-state-recompile pin, live), consensus health (decided
-fraction + a rounds-to-decision sparkline) and the per-worker fleet
-table (up/load/inflight, steals, respawns, orphan re-admissions).
+fraction + a rounds-to-decision sparkline), the per-worker fleet
+table (up/load/inflight, steals, respawns, orphan re-admissions), and —
+when the round-22 elastic plane is live — the autoscaler row (target
+workers, up/down decisions, graceful retirements) and the write-ahead
+admission log row (records by kind, entries replayed at recovery).
 
 Stdlib only, read-only, and resilient: a dead endpoint renders an
 UNREACHABLE frame and keeps polling — the dash never takes the service
@@ -160,6 +163,21 @@ def render_frame(snap, prev=None, dt: float | None = None,
             lines.append(f"    w{w:<3} {mark:<5} "
                          f"load {_fmt(load.get(w))}  "
                          f"inflight {_fmt(infl.get(w))}")
+
+    target = _val(snap, "brc_autoscale_target_workers")
+    if target is not None:
+        lines.append(
+            f"  scale    target {_fmt(target)}"
+            f"  ups {_fmt(_val(snap, 'brc_autoscale_up_total'))}"
+            f"  downs {_fmt(_val(snap, 'brc_autoscale_down_total'))}"
+            f"  retired {_fmt(_val(snap, 'brc_fleet_retired_total'))}")
+
+    wal = _by_label(snap, "brc_wal_records_total", "op")
+    if wal:
+        ops = " ".join(f"{k}={int(v)}" for k, v in sorted(wal.items()))
+        lines.append(
+            f"  wal      {ops}"
+            f"  recovered {_fmt(_val(snap, 'brc_wal_recovered_total'))}")
     return "\n".join(lines) + "\n"
 
 

@@ -43,6 +43,31 @@ its own exit-code-enforced gates:
     and every hog session must bit-replay offline from its base seed
     alone (models/session.py).
 
+Round 22 adds the **elastic** drills (:data:`ELASTIC_SCENARIOS`,
+``--scenario dispatcher_kill`` / ``autoscale_crowd`` / ``elastic`` for
+both) — a separate suite writing the schema-v1.13 ``elastic`` block
+(``artifacts/elastic_r22.json``), durability and elasticity proven by
+measurement, not claims:
+
+``dispatcher_kill``
+    A real ``brc-tpu serve`` subprocess with a write-ahead admission log
+    (``--wal``), SIGKILLed mid-stream at a seeded point, restarted with
+    ``--recover``. Every in-flight request must be replayed under its
+    original request id with a reply **bit-identical** to the offline
+    numpy oracle — spec-§11 session logs included — and a submit probe
+    during the replay must answer 503 ``recovering``. The drill reads
+    the journal back itself (torn final line tolerated) to know exactly
+    which ids a correct recovery owes it.
+``autoscale_crowd``
+    A flash crowd against a one-worker thread fleet with the
+    metrics-driven autoscaler (serve/autoscale.py) scaling toward
+    ``max_workers``, vs the same crowd against a pinned static
+    one-worker fleet. Timing is sleep-dominated (``segment_latency_s``)
+    so the p99 gate is about elasticity, not host speed: the elastic
+    p99 must meet the SLO bound the static baseline misses (exit 5),
+    scale-down must retire — not kill — workers (health stays ok,
+    0 lost), and surviving-worker steady-state compiles stay 0.
+
 Every scenario's population is a pure function of ``(suite seed,
 scenario index)``; observed counts (rejections, cancel timing splits)
 are measurements, the gates are the claims. The committed artifact::
@@ -87,6 +112,11 @@ HOSTILE_GENERATOR_VERSION = 1
 SCENARIOS = ("flash_crowd", "heavy_tail", "bucket_churn", "tenant_hog",
              "cancel_storm", "session_hog")
 
+#: Round-22 durability/elasticity drills — a separate family so
+#: ``--scenario all`` keeps its r18 meaning (and its flash-crowd
+#: overflow gate); they write the schema-v1.13 ``elastic`` record.
+ELASTIC_SCENARIOS = ("dispatcher_kill", "autoscale_crowd")
+
 #: Admitted round_cap ceiling for the hostile servers — half the serving
 #: default: the suite's populations are many small requests, and the
 #: ceiling is the drain-segment length every warm-up must pay for.
@@ -100,6 +130,8 @@ _SIZES = {
     "tenant_hog": (24, 10),   # hog 2/3, interactive 1/3
     "cancel_storm": (24, 10),
     "session_hog": (15, 8),  # hog sessions 1/3, interactive 2/3
+    "dispatcher_kill": (12, 6),   # last third are 32-slot sessions
+    "autoscale_crowd": (36, 18),  # interleaved across 3 fused buckets
 }
 
 #: session_hog: chained decision slots per hog session (each hog envelope
@@ -646,7 +678,403 @@ _RUNNERS = {
 }
 
 
+# ------------------------------------------------ elastic drills (r22) --
+
+def _erow(name: str, seed: int, requests: int, replied: int, *,
+          recovered: int = 0, rejected_recovering: int = 0,
+          scale_up: int = 0, scale_down: int = 0, mismatches: int = 0,
+          steady: int = 0, slo_ok: bool = True, **extra) -> dict:
+    """One ``elastic`` scenarios row (record.ELASTIC_SCENARIO_KEYS)."""
+    row = {"scenario": name, "seed": seed, "requests": requests,
+           "replied": replied, "recovered": recovered,
+           "rejected_recovering": rejected_recovering,
+           "scale_up_events": scale_up, "scale_down_events": scale_down,
+           "mismatches": mismatches, "steady_state_compiles": steady,
+           "slo_ok": bool(slo_ok)}
+    row.update(extra)
+    return row
+
+
+def _free_port() -> int:
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_http_up(base: str, timeout: float = 300.0, proc=None) -> None:
+    """Poll ``/healthz`` until the server answers anything at all (a 503
+    is up too — a recovering fleet still serves its health page)."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if proc is not None and proc.poll() is not None:
+            raise RuntimeError(
+                f"serve subprocess exited {proc.returncode} before "
+                "answering HTTP")
+        try:
+            _http("GET", base + "/healthz", timeout=5.0)
+            return
+        except (urllib.error.URLError, ConnectionError, OSError):
+            time.sleep(0.1)
+    raise TimeoutError(f"{base} not up after {timeout}s")
+
+
+def _fetch_recovered(base: str, rid: str, timeout: float = 900.0) -> dict:
+    """Like :func:`_fetch_result`, but tolerates 404 while the recovery
+    thread is still re-admitting (a recovered id registers the moment its
+    replay is submitted, so the window is short)."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        code, body, _ = _http("GET", base + f"/result/{rid}")
+        if code == 200:
+            return body
+        if code not in (202, 404):
+            raise RuntimeError(f"result {rid}: HTTP {code}: {body}")
+        time.sleep(0.05)
+    raise TimeoutError(f"recovered result {rid} not done after {timeout}s")
+
+
+def _scenario_dispatcher_kill(args, seed: int) -> dict:
+    """SIGKILL the dispatcher mid-stream, restart with ``--recover``, and
+    demand every in-flight request back bit-identically under its
+    original id. The drill reads the admission WAL itself after the kill
+    (crash-torn final line and all) to compute exactly which ids a
+    correct recovery owes it — the gate is against that plan, not against
+    whatever the server chooses to return."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    from byzantinerandomizedconsensus_tpu.backends.base import get_backend
+    from byzantinerandomizedconsensus_tpu.models import session as _session
+    from byzantinerandomizedconsensus_tpu.serve.wal import WriteAheadLog
+
+    n_req = _SIZES["dispatcher_kill"][1 if args.smoke else 0]
+    rng = random.Random(seed)
+    cfgs, payloads = [], []
+    for i in range(n_req):
+        c = _cfg("benor", 5, 1, seed * 1000 + i, instances=8, round_cap=48)
+        cfgs.append(c)
+        payload = dataclasses.asdict(c)
+        if 3 * i >= 2 * n_req:
+            # the tail of the stream is long spec-§11 sessions — slots run
+            # sequentially, so these are the slowest work by construction
+            # and the seeded kill reliably catches them in flight; recovery
+            # must then reproduce full per-slot logs
+            payload["session_slots"] = 32
+        payloads.append(payload)
+
+    wal_dir = tempfile.mkdtemp(prefix="brc-elastic-wal-")
+    port = _free_port()
+    base = f"http://127.0.0.1:{port}"
+    argv = [sys.executable, "-m",
+            "byzantinerandomizedconsensus_tpu.serve.server",
+            "--backend", args.backend, "--host", "127.0.0.1",
+            "--port", str(port), "--policy", args.policy_spec,
+            "--round-cap-ceiling", str(ROUND_CAP_CEILING),
+            "--wal", wal_dir]
+    try:
+        proc = subprocess.Popen(argv, stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+        try:
+            _wait_http_up(base, proc=proc)
+            ids = []
+            for payload in payloads:
+                rid, _ = _submit_retrying(base, payload)
+                ids.append(rid)
+            # the seeded kill point: SIGKILL once 1-2 replies landed AND
+            # the journal still carries open admits — a crash with work in
+            # flight is the whole drill, so a backend quick enough to
+            # drain the stream first gets fed another long-session wave
+            # rather than letting the kill land on an idle dispatcher
+            kill_after = 1 + rng.randrange(2)
+            done: dict = {}
+            waves = 0
+            while True:
+                if proc.poll() is not None:
+                    raise RuntimeError("serve subprocess died on its own")
+                for rid in ids:
+                    if rid in done:
+                        continue
+                    code, body, _ = _http("GET", base + f"/result/{rid}")
+                    if code == 200:
+                        done[rid] = body
+                        if len(done) >= kill_after:
+                            break
+                if len(done) >= kill_after:
+                    live_plan, _ = WriteAheadLog.plan_recovery(wal_dir)
+                    if live_plan:
+                        break
+                    waves += 1
+                    c = _cfg("benor", 5, 1, seed * 1000 + n_req + waves,
+                             instances=8, round_cap=48)
+                    cfgs.append(c)
+                    payload = dataclasses.asdict(c)
+                    payload["session_slots"] = 32
+                    payloads.append(payload)
+                    rid, _ = _submit_retrying(base, payload)
+                    ids.append(rid)
+                time.sleep(0.02)
+        finally:
+            proc.kill()  # SIGKILL: no drain, no WAL close — the crash
+            proc.wait(timeout=60)
+
+        # What does a correct recovery owe us? Read the journal the way
+        # the server will: incomplete admits, in admission order.
+        plan, _counter = WriteAheadLog.plan_recovery(wal_dir)
+        owed = [e["id"] for e in plan]
+
+        proc2 = subprocess.Popen(argv + ["--recover", wal_dir],
+                                 stdout=subprocess.DEVNULL,
+                                 stderr=subprocess.DEVNULL)
+        try:
+            _wait_http_up(base, proc=proc2)
+            # probe: a fresh submit during the replay answers 503 with the
+            # named ``recovering`` reason (satellite pin); if the replay
+            # already finished, the accepted probe is harmless traffic
+            rejected_recovering = 0
+            code, body, headers = _http("POST", base + "/submit",
+                                        dataclasses.asdict(cfgs[0]))
+            if code == 503 and body.get("reason") == "recovering":
+                rejected_recovering = 1
+                assert "Retry-After" in headers
+            recovered: dict = {}
+            for rid in owed:
+                recovered[rid] = _fetch_recovered(base, rid)
+            if code == 200:
+                # the probe slipped in after the replay finished: drain
+                # it so its (possibly cold) compile lands before the
+                # steady-state window opens
+                _fetch_result(base, body["id"])
+            # steady-state pin: the replay warmed exactly the owed
+            # entries' programs (warm-up compiles are exempt, as any cold
+            # start is) — re-submitting those same payloads must compile
+            # NOTHING new
+            idx_of = {rid: i for i, rid in enumerate(ids)}
+            rewave = [payloads[idx_of[rid]] for rid in owed[:3]]
+            _, st, _ = _http("GET", base + "/stats")
+            c0 = (st.get("compile_cache") or {}).get("compiles", 0)
+            for payload in rewave:
+                rid, _ = _submit_retrying(base, payload)
+                _fetch_result(base, rid)
+            _, st, _ = _http("GET", base + "/stats")
+            c1 = (st.get("compile_cache") or {}).get("compiles", 0)
+            steady = int(c1) - int(c0)
+        finally:
+            proc2.kill()
+            proc2.wait(timeout=60)
+    finally:
+        shutil.rmtree(wal_dir, ignore_errors=True)
+
+    # bit-compare every reply the drill holds — fetched pre-kill or
+    # recovered — against the offline numpy oracle; recovered sessions
+    # additionally replay their full per-slot log (spec §11)
+    by_id = {rid: i for i, rid in enumerate(ids)}
+    pairs = [(cfgs[by_id[rid]], rec)
+             for rid, rec in {**done, **recovered}.items()]
+    mism = _mismatch_count(pairs)
+    be = get_backend("numpy")
+    session_replays = 0
+    for rid, rec in recovered.items():
+        if "session" in rec:
+            blk = rec["session"]
+            served = list(zip(blk["rounds"], blk["decisions"]))
+            session_replays += 1
+            if not _session.replay_matches(be, cfgs[by_id[rid]], served):
+                mism += 1
+    slo_ok = (len(owed) >= 1 and len(recovered) == len(owed)
+              and session_replays >= (0 if args.smoke else 1))
+    return _erow("dispatcher_kill", seed, len(ids),
+                 len(done) + len(recovered), recovered=len(recovered),
+                 rejected_recovering=rejected_recovering, mismatches=mism,
+                 steady=steady, slo_ok=slo_ok,
+                 killed_after_replies=len(done), owed=len(owed),
+                 extra_waves=waves, session_replays=session_replays)
+
+
+def _scenario_autoscale_crowd(args, seed: int) -> dict:
+    """The same seeded crowd twice — against a pinned one-worker fleet
+    and against the autoscaled fleet — with sleep-dominated segment
+    timing, so the p99 ratio measures elasticity, not the host. The
+    elastic leg must clear the SLO bound the static leg misses, scale
+    down gracefully afterwards (retired, not lost), and keep the
+    surviving original worker at zero steady-state compiles."""
+    from byzantinerandomizedconsensus_tpu.serve.autoscale import Autoscaler
+    from byzantinerandomizedconsensus_tpu.serve.fleet import FleetServer
+
+    n_req = _SIZES["autoscale_crowd"][1 if args.smoke else 0]
+    lat = 0.05
+    max_workers = 3
+    # three distinct fused buckets, interleaved: a one-bucket crowd would
+    # mid-flight JOIN the live rotation on worker 0 (nothing left pending,
+    # nothing stealable) and no amount of scaling could help it — the
+    # elastic claim needs a backlog the newcomers can actually steal
+    kinds = (("benor", "keys"), ("bracha", "keys"), ("benor", "urn2"))
+    cfgs = [_cfg(kinds[i % 3][0], 5 if kinds[i % 3][0] == "benor" else 7,
+                 1, seed * 1000 + i, delivery=kinds[i % 3][1])
+            for i in range(n_req)]
+
+    def crowd(fl) -> tuple:
+        handles = [fl.submit(c) for c in cfgs]
+        for h in handles:
+            h.wait(timeout=900.0)
+        return ([h.latency_s * 1000.0 for h in handles],
+                [(c, h.record) for c, h in zip(cfgs, handles)])
+
+    def fleet() -> FleetServer:
+        # pinned to the numpy backend on purpose: timing here is the
+        # injected segment sleep, so the p99 ratio measures scheduling
+        # elasticity, not host compile speed — the real backend's crash /
+        # recovery surface is the dispatcher_kill drill's job
+        return FleetServer(workers=1, mode="thread", backend="numpy",
+                           policy=args.policy,
+                           round_cap_ceiling=ROUND_CAP_CEILING,
+                           segment_latency_s=lat)
+
+    # warm-up in both legs is one unmeasured replay of the exact crowd
+    # population: programs are keyed by bucket and shape, so this compiles
+    # precisely what the measured crowd will need — all on worker 0
+    with fleet() as fl:
+        crowd(fl)
+        static_lat, _static_pairs = crowd(fl)
+
+    with fleet() as fl:
+        crowd(fl)
+        warm0 = fl.compile_counts()[0] or 0
+        scaler = Autoscaler(fl, min_workers=1, max_workers=max_workers,
+                            interval_s=0.04, up_per_worker=3.0,
+                            down_per_worker=0.5, up_ticks=1, down_ticks=8,
+                            cooldown_s=0.1)
+        scaler.start()
+        elastic_lat, elastic_pairs = crowd(fl)
+        # idle tail: the crowd is gone, so sustained under-pressure must
+        # retire the extra workers back toward min_workers — gracefully
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 30.0:
+            st = fl.stats(live=False)
+            if scaler._downs >= 1 and st["routable"] <= 1:
+                break
+            time.sleep(0.05)
+        counts = scaler.stop()
+        # every retirement must drain, not drop: wait the handshakes out
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 60.0:
+            if not fl.health().get("retiring"):
+                break
+            time.sleep(0.05)
+        health = fl.health()
+        st = fl.stats(live=False)
+        steady = int((fl.compile_counts()[0] or 0) - warm0)
+        lost = st["lost_workers"]
+        retired = st["retired_workers"]
+
+    (static_p99,) = metrics.percentiles(static_lat, (99,))
+    (elastic_p99,) = metrics.percentiles(elastic_lat, (99,))
+    # the pinned bound sits below the static baseline by construction:
+    # meeting it REQUIRES the scale-up to have actually absorbed load
+    slo_ms = round(0.75 * static_p99, 3)
+    slo_ok = (elastic_p99 <= slo_ms < static_p99
+              and counts["ups"] >= 1 and counts["downs"] >= 1
+              and lost == 0 and retired >= 1 and health["ok"])
+    # where a request ran (and whether its worker later retired) must
+    # never touch the math: the scaled crowd's replies stay bit-identical
+    mism = _mismatch_count(elastic_pairs)
+    return _erow("autoscale_crowd", seed, n_req, len(elastic_lat),
+                 scale_up=counts["ups"], scale_down=counts["downs"],
+                 mismatches=mism, steady=steady, slo_ok=slo_ok,
+                 static_p99_ms=round(static_p99, 3),
+                 elastic_p99_ms=round(elastic_p99, 3), slo_ms=slo_ms,
+                 segment_latency_s=lat, max_workers=max_workers,
+                 lost_workers=lost, retired_workers=retired)
+
+
+_ELASTIC_RUNNERS = {
+    "dispatcher_kill": _scenario_dispatcher_kill,
+    "autoscale_crowd": _scenario_autoscale_crowd,
+}
+
+
 # ---------------------------------------------------------------- main --
+
+def _elastic_main(args) -> int:
+    """Run the round-22 durability/elasticity drills and write the
+    schema-v1.13 ``elastic`` record (``artifacts/elastic_r22.json``).
+    Same exit ladder as the hostile suite: 3 invalid record, 1 mismatch,
+    2 steady-state compiles, 5 drill SLO/verdict failure."""
+    names = (ELASTIC_SCENARIOS if args.scenario == "elastic"
+             else (args.scenario,))
+    out = pathlib.Path(args.out or default_artifact("elastic"))
+    out.parent.mkdir(parents=True, exist_ok=True)
+
+    t0 = time.perf_counter()
+    rows = []
+    for i, name in enumerate(names):
+        seed = args.seed * 100 + i
+        print(f"elastic: [{name}] seed {seed} …")
+        row = _ELASTIC_RUNNERS[name](args, seed)
+        rows.append(row)
+        print(f"elastic: [{name}] replied {row['replied']}/"
+              f"{row['requests']}, recovered {row['recovered']}, "
+              f"scale +{row['scale_up_events']}/-"
+              f"{row['scale_down_events']}, mismatches "
+              f"{row['mismatches']}, steady compiles "
+              f"{row['steady_state_compiles']}, "
+              f"slo {'OK' if row['slo_ok'] else 'BREACH'}")
+
+    autoscale = next((r for r in rows
+                      if r["scenario"] == "autoscale_crowd"), {})
+    stats = {
+        "suite_seed": args.seed,
+        "generator_version": HOSTILE_GENERATOR_VERSION,
+        "scenarios": rows,
+        "recovered": sum(r["recovered"] for r in rows),
+        "scale_up_events": sum(r["scale_up_events"] for r in rows),
+        "scale_down_events": sum(r["scale_down_events"] for r in rows),
+        "mismatches": sum(r["mismatches"] for r in rows),
+        "steady_state_compiles": sum(r["steady_state_compiles"]
+                                     for r in rows),
+        "slo_ok": all(r["slo_ok"] for r in rows),
+        "duration_s": round(time.perf_counter() - t0, 3),
+        "static_p99_ms": autoscale.get("static_p99_ms"),
+        "elastic_p99_ms": autoscale.get("elastic_p99_ms"),
+        "slo_ms": autoscale.get("slo_ms"),
+    }
+
+    doc = {
+        **record.new_record(
+            "elastic",
+            description="Durability/elasticity drills: a SIGKILLed "
+                        "dispatcher recovered bit-identically from the "
+                        "write-ahead admission log, and a flash crowd "
+                        "absorbed by the metrics-driven autoscaler "
+                        "against a pinned static baseline."),
+        "seed": args.seed,
+        "smoke": bool(args.smoke),
+        "backend": args.backend,
+        "policy": args.policy.doc(),
+        "round_cap_ceiling": ROUND_CAP_CEILING,
+        "elastic": record.elastic_block(stats),
+    }
+    problems = record.validate_record(doc)
+    if problems:
+        print(f"elastic: INVALID RECORD: {problems}", file=sys.stderr)
+        return 3
+    out.write_text(json.dumps(doc, indent=1) + "\n")
+    print(f"elastic: wrote {out}")
+
+    if stats["mismatches"]:
+        print("elastic: DIFFERENTIAL MISMATCH", file=sys.stderr)
+        return 1
+    if stats["steady_state_compiles"]:
+        print("elastic: STEADY-STATE RECOMPILES", file=sys.stderr)
+        return 2
+    if not stats["slo_ok"]:
+        print("elastic: DRILL SLO BREACH", file=sys.stderr)
+        return 5
+    return 0
+
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
@@ -655,7 +1083,12 @@ def main(argv=None) -> int:
                     "scheduling and cancellation under adversarial "
                     "traffic, every gate exit-code enforced.")
     ap.add_argument("--scenario", default="all",
-                    choices=SCENARIOS + ("all",))
+                    choices=SCENARIOS + ELASTIC_SCENARIOS
+                    + ("all", "elastic"),
+                    help="'all' runs the six r18 hostile scenarios; "
+                         "'elastic' the two r22 durability drills "
+                         "(dispatcher_kill + autoscale_crowd, schema-v1.13 "
+                         "elastic record)")
     ap.add_argument("--seed", type=int, default=18)
     ap.add_argument("--backend", default="jax")
     ap.add_argument("--policy", default="width=8,segment=1",
@@ -674,7 +1107,11 @@ def main(argv=None) -> int:
     # The rejection/fairness/cancel gates read the live metrics plane.
     _metrics.configure()
     _devices.ensure_live_backend()
+    args.policy_spec = args.policy  # the serve-subprocess spelling
     args.policy = _compaction.CompactionPolicy.parse(args.policy)
+
+    if args.scenario == "elastic" or args.scenario in ELASTIC_SCENARIOS:
+        return _elastic_main(args)
 
     names = SCENARIOS if args.scenario == "all" else (args.scenario,)
     out = pathlib.Path(args.out or default_artifact("hostile"))

@@ -49,6 +49,13 @@ anchor with every later round CPU-only, and the r20 fused bit-match whose
 ``device_of_record`` is still ``interpret/cpu``) — as an aligned table, and
 exit 0. The verb is the one-glance answer to "what still owes a TPU run";
 tests/test_ledger.py pins both rows.
+
+Round 22 adds the durability/autoscaling columns: every committed artifact
+carrying a schema-v1.13 ``elastic`` block (the dispatcher-kill recovery and
+autoscale flash-crowd drills, tools/hostile.py) reports its recovered
+request count, scale up/down events, mismatches, steady-state compiles,
+and the per-drill SLO verdicts. These are evidence columns, not a new debt
+class — both drills run to completion on any host.
 """
 
 from __future__ import annotations
@@ -458,6 +465,35 @@ def _session_rows_of(name: str, doc) -> list:
     return rows
 
 
+def _elastic_rows_of(name: str, doc) -> list:
+    """Schema-v1.13 ``elastic`` blocks of one artifact: (path, recovered
+    requests, scale up/down events, mismatches, steady-state compiles,
+    p99 vs SLO, per-drill verdicts) rows — the ledger's durability /
+    autoscaling columns (round 22)."""
+    from byzantinerandomizedconsensus_tpu.obs import record as _record
+
+    rows = []
+    for path, eb in _blocks_of(doc, "elastic", _record.ELASTIC_BLOCK_KEYS):
+        drills = {s.get("scenario"): bool(s.get("slo_ok"))
+                  for s in eb.get("scenarios") or []
+                  if isinstance(s, dict)}
+        rows.append({
+            "artifact": name,
+            "path": path,
+            "recovered": eb.get("recovered"),
+            "scale_up_events": eb.get("scale_up_events"),
+            "scale_down_events": eb.get("scale_down_events"),
+            "mismatches": eb.get("mismatches"),
+            "steady_state_compiles": eb.get("steady_state_compiles"),
+            "static_p99_ms": eb.get("static_p99_ms"),
+            "elastic_p99_ms": eb.get("elastic_p99_ms"),
+            "slo_ms": eb.get("slo_ms"),
+            "slo_ok": eb.get("slo_ok"),
+            "drills": drills,
+        })
+    return rows
+
+
 def sentinel_verdict(bench: dict, wall_chain: list,
                      programs_rows: list) -> dict:
     """The ``--check`` verdict: wall-chain regressions past
@@ -718,6 +754,12 @@ def build_ledger(root=None) -> dict:
     for name, doc in sorted(docs.items()):
         session_rows.extend(_session_rows_of(name, doc))
 
+    # ---- durability/autoscaling columns (schema v1.13, round 22): every
+    # committed artifact carrying an elastic drill block.
+    elastic_rows = []
+    for name, doc in sorted(docs.items()):
+        elastic_rows.extend(_elastic_rows_of(name, doc))
+
     from byzantinerandomizedconsensus_tpu.obs import record
 
     return {
@@ -739,6 +781,7 @@ def build_ledger(root=None) -> dict:
         "committee_rows": committee_rows,
         "fused_rows": fused_rows,
         "session_rows": session_rows,
+        "elastic_rows": elastic_rows,
         "bench_rounds": {str(r): bench[r] for r in rounds_seen},
         "wall_chain": chain,
         "device_chain": device_chain,
@@ -950,6 +993,25 @@ def format_report(doc: dict) -> str:
                 f"(amortization x{row['amortization_ratio']}), "
                 f"{row['steady_state_compiles']} steady-state compiles, "
                 f"{row['mismatches']} mismatches, replay {rep_s}")
+    # Present only once an artifact carries the v1.13 elastic block.
+    if doc.get("elastic_rows"):
+        lines.append("durability/autoscaling columns (schema v1.13 — "
+                     "artifact[path]: recovered requests, scale events, "
+                     "mismatches, steady-state compiles, p99 vs SLO, "
+                     "per-drill verdicts):")
+        for row in doc["elastic_rows"]:
+            drills = ", ".join(
+                f"{name} {'OK' if ok else 'BREACH'}"
+                for name, ok in sorted((row.get("drills") or {}).items()))
+            lines.append(
+                f"  {row['artifact']}[{row['path']}]: "
+                f"{row['recovered']} recovered, "
+                f"+{row['scale_up_events']}/-{row['scale_down_events']} "
+                f"scale events, {row['mismatches']} mismatches, "
+                f"{row['steady_state_compiles']} steady-state compiles, "
+                f"elastic p99 {row['elastic_p99_ms']} ms vs SLO "
+                f"{row['slo_ms']} ms (static {row['static_p99_ms']} ms) — "
+                f"{drills or 'no drills'}")
     sent = doc.get("sentinel")
     if sent is not None:
         lines.append(

@@ -104,6 +104,15 @@ all`` delegates the whole invocation to the hostile-load suite
 scheduling, cancellation storms — with its own exit-code ladder (see
 that module's docstring) and the committed ``artifacts/hostile_r18.json``.
 
+**Elastic mode (round 22)** — ``--scenario
+dispatcher_kill|autoscale_crowd|elastic`` delegates the same way to the
+round-22 durability/elasticity drills: a SIGKILLed dispatcher recovered
+bit-identically from the write-ahead admission log (serve/wal.py), and a
+flash crowd absorbed by the metrics-driven autoscaler
+(serve/autoscale.py) against a pinned static baseline — the committed
+schema-v1.13 ``artifacts/elastic_r22.json`` (exit 1 mismatch, 2
+steady-state compiles, 3 invalid record, 5 drill SLO breach).
+
 Exit codes: 1 differential mismatch (including a session replay or
 cross-leg mismatch), 2 steady-state compiles, 3 invalid record, 4 fleet
 scaling below ``--min-scaling`` or session amortization below

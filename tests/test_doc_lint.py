@@ -59,9 +59,12 @@ def test_span_kind_census_is_nontrivial_and_complete():
                      "hunt.done", "serve.backpressure", "serve.cancel",
                      "serve.rotate", "compaction.cancel",
                      "compaction.reseed", "serve.session_open",
-                     "serve.session_slot", "serve.session_done"):
+                     "serve.session_slot", "serve.session_done",
+                     "serve.recover", "serve.recovered", "fleet.retire",
+                     "fleet.respawn", "autoscale.start", "autoscale.stop",
+                     "autoscale.up", "autoscale.down"):
         assert expected in kinds, (expected, sorted(kinds))
-    assert len(kinds) >= 52
+    assert len(kinds) >= 60
 
 
 def test_every_emitted_span_kind_is_documented():
@@ -134,9 +137,13 @@ def test_metric_name_census_is_nontrivial_and_complete():
                      "brc_serve_deadline_missed_total",
                      "brc_session_reseeds_total", "brc_session_opened_total",
                      "brc_session_slots_replied_total",
-                     "brc_session_completed_total"):
+                     "brc_session_completed_total",
+                     "brc_wal_records_total", "brc_wal_recovered_total",
+                     "brc_fleet_retired_total",
+                     "brc_autoscale_target_workers",
+                     "brc_autoscale_up_total", "brc_autoscale_down_total"):
         assert expected in names, (expected, sorted(names))
-    assert len(names) >= 48
+    assert len(names) >= 54
 
 
 def test_every_registered_metric_is_documented():
@@ -172,6 +179,7 @@ def test_every_record_block_key_is_documented():
         "committee": record.COMMITTEE_BLOCK_KEYS,
         "fused": record.FUSED_BLOCK_KEYS,
         "session": record.SESSION_BLOCK_KEYS,
+        "elastic": record.ELASTIC_BLOCK_KEYS,
         "counters": ("supported", "totals"),
     }
     missing = []

@@ -1,0 +1,448 @@
+"""Round-22 durability/elasticity tests (serve/wal.py, serve/autoscale.py).
+
+Tier-1 layer: the write-ahead admission log's property surface (torn-line
+tolerance, tail repair, replay idempotence, bit-identical recovery —
+session envelopes included), the autoscaler control law driven
+deterministically through an injected clock and a fake fleet, the
+recovering-503 admission gate, and the real thread-fleet scale-up /
+scale-down path with bit-identical replies. Slow layer: the budgeted
+respawn ladder on a real subprocess fleet, and the ``loadgen --scenario
+dispatcher_kill --smoke`` drill end-to-end in a subprocess (SIGKILL,
+restart, ``--recover``, schema-v1.13 artifact).
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from byzantinerandomizedconsensus_tpu.backends.base import get_backend
+from byzantinerandomizedconsensus_tpu.backends.compaction import CompactionPolicy
+from byzantinerandomizedconsensus_tpu.config import SimConfig
+from byzantinerandomizedconsensus_tpu.models import session as _session
+from byzantinerandomizedconsensus_tpu.obs import metrics as _metrics
+from byzantinerandomizedconsensus_tpu.obs import record
+from byzantinerandomizedconsensus_tpu.serve import admission
+from byzantinerandomizedconsensus_tpu.serve.autoscale import Autoscaler
+from byzantinerandomizedconsensus_tpu.serve.fleet import FleetServer
+from byzantinerandomizedconsensus_tpu.serve.server import (ConsensusServer,
+                                                           serve_http)
+from byzantinerandomizedconsensus_tpu.serve.wal import (WAL_NAME,
+                                                        WriteAheadLog)
+
+_POLICY = CompactionPolicy(width=8, segment=1)
+
+
+def _cfg(seed: int, **kw) -> SimConfig:
+    base = dict(protocol="benor", n=5, f=1, instances=4, adversary="none",
+                coin="local", init="random", seed=seed, round_cap=32,
+                delivery="keys")
+    base.update(kw)
+    return SimConfig(**base).validate()
+
+
+def _offline(cfg):
+    ref = get_backend("numpy").run(cfg)
+    return [int(r) for r in ref.rounds], [int(d) for d in ref.decision]
+
+
+# ------------------------------------------------------------------ WAL --
+
+
+def test_wal_round_trip_plan_and_counter(tmp_path):
+    wal = WriteAheadLog(str(tmp_path))
+    cfg_doc = dataclasses.asdict(_cfg(1))
+    wal.append_admit("r000001", cfg_doc, {})
+    wal.append_done("r000001")
+    wal.append_admit("r000002", cfg_doc, {"session_slots": 2})
+    wal.append_admit("r000007", cfg_doc, {})
+    wal.append_done("r000007", failed=True)
+    wal.close()
+
+    entries = WriteAheadLog.read_entries(str(tmp_path))
+    assert [e["op"] for e in entries] == ["admit", "done", "admit",
+                                         "admit", "fail"]
+    plan, counter = WriteAheadLog.plan_recovery(str(tmp_path))
+    assert [e["id"] for e in plan] == ["r000002"]  # done AND fail both close
+    assert plan[0]["env"] == {"session_slots": 2}
+    assert counter == 7  # resume past the highest id, not the open one
+
+
+def test_wal_tolerates_torn_final_line_only(tmp_path):
+    wal = WriteAheadLog(str(tmp_path))
+    cfg_doc = dataclasses.asdict(_cfg(2))
+    wal.append_admit("r000001", cfg_doc, {})
+    wal.append_admit("r000002", cfg_doc, {})
+    wal.close()
+    path = tmp_path / WAL_NAME
+
+    # a crash mid-append tears the FINAL line: reads drop it silently
+    whole = path.read_text()
+    path.write_text(whole + '{"op": "admit", "id": "r0000')
+    plan, counter = WriteAheadLog.plan_recovery(str(tmp_path))
+    assert [e["id"] for e in plan] == ["r000001", "r000002"]
+    assert counter == 2
+
+    # the same tear ANYWHERE else is corruption, not a crash: loud failure
+    lines = whole.splitlines()
+    path.write_text("\n".join([lines[0][: len(lines[0]) // 2]]
+                              + lines[1:]) + "\n")
+    with pytest.raises(ValueError, match="only the final line may be torn"):
+        WriteAheadLog.read_entries(str(tmp_path))
+
+
+def test_wal_repairs_torn_tail_before_appending(tmp_path):
+    """Opening for append truncates a torn final line first — otherwise
+    recovery's own completion records would land after the tear and turn
+    a tolerated crash signature into mid-file corruption."""
+    wal = WriteAheadLog(str(tmp_path))
+    cfg_doc = dataclasses.asdict(_cfg(3))
+    wal.append_admit("r000001", cfg_doc, {})
+    wal.close()
+    path = tmp_path / WAL_NAME
+    path.write_text(path.read_text() + '{"op": "admit", "id')
+
+    wal2 = WriteAheadLog(str(tmp_path))  # the repair seam
+    wal2.append_done("r000001")
+    wal2.close()
+    entries = WriteAheadLog.read_entries(str(tmp_path))
+    assert [e["op"] for e in entries] == ["admit", "done"]
+
+    # a newline-terminated but unparseable tail is repaired the same way
+    path.write_text(path.read_text() + "}}}not json{{{\n")
+    wal3 = WriteAheadLog(str(tmp_path))
+    wal3.append_admit("r000002", cfg_doc, {})
+    wal3.close()
+    assert [e["op"] for e in WriteAheadLog.read_entries(str(tmp_path))] \
+        == ["admit", "done", "admit"]
+
+
+def test_recovery_replays_bit_identical_and_idempotent(tmp_path):
+    """The tentpole's replay law at the library seam: journaled admits
+    with no completion replay under their ORIGINAL ids with replies
+    bit-identical to the offline oracle, the id counter resumes past the
+    journal, completed work never replays, and recovering twice is a
+    no-op (replaying appends fresh completion records)."""
+    cfgs = [_cfg(10), _cfg(11), _cfg(12)]
+    wal = WriteAheadLog(str(tmp_path))
+    for i, c in enumerate(cfgs):
+        wal.append_admit(f"r{i + 1:06d}", dataclasses.asdict(c), {})
+    wal.append_done("r000002")  # this one replied before the crash
+    wal.close()
+
+    srv = ConsensusServer(backend="numpy", policy=_POLICY,
+                          wal_dir=str(tmp_path)).start()
+    try:
+        out = srv.recover(timeout=600.0)
+        assert out["ids"] == ["r000001", "r000003"]
+        assert out["replayed"] == 2 and out["recovered"] == 2
+        assert srv.recovering is False
+        for rid, h, c in zip(out["ids"], out["handles"],
+                             [cfgs[0], cfgs[2]]):
+            rec = h.wait(timeout=600.0)
+            assert rec["request_id"] == rid
+            rounds, decision = _offline(c)
+            assert rec["rounds"] == rounds
+            assert rec["decision"] == decision
+        # counter resumed: the next fresh admission continues the sequence
+        h = srv.submit(_cfg(13))
+        assert h.id == "r000004"
+        h.wait(timeout=600.0)
+        # idempotence: the journal now pairs every admit — nothing replays
+        out2 = srv.recover(timeout=600.0)
+        assert out2["replayed"] == 0 and out2["ids"] == []
+    finally:
+        srv.shutdown()
+
+
+def test_recovery_reproduces_session_envelopes(tmp_path):
+    """A journaled session envelope recovers as a full spec-§11 session:
+    the replayed reply carries the per-slot log and is bit-identical to
+    the offline ``run_session`` chain from the base seed."""
+    cfg = _cfg(21)
+    wal = WriteAheadLog(str(tmp_path))
+    wal.append_admit("r000001", dataclasses.asdict(cfg),
+                     {"session_slots": 3})
+    wal.close()
+
+    srv = ConsensusServer(backend="numpy", policy=_POLICY,
+                          wal_dir=str(tmp_path)).start()
+    try:
+        out = srv.recover(timeout=600.0)
+        assert out["recovered"] == 1
+        rec = out["handles"][0].wait(timeout=600.0)
+        blk = rec["session"]
+        assert blk["slots"] == 3 and len(blk["rounds"]) == 3
+        be = get_backend("numpy")
+        served = list(zip(blk["rounds"], blk["decisions"]))
+        assert _session.replay_matches(be, cfg, served)
+        ref = _session.run_session(be, cfg, 3)
+        assert blk["decisions"][-1] == [int(d) for d in ref[-1].decision]
+    finally:
+        srv.shutdown()
+
+
+# ----------------------------------------------------------- autoscaler --
+
+
+class _FakeFleet:
+    """stats()/scale_up()/scale_down() surface for deterministic tick
+    tests — outstanding work and worker count are plain knobs."""
+
+    def __init__(self, routable: int = 1, outstanding: int = 0):
+        self.routable = routable
+        self.outstanding = outstanding
+        self.ups = 0
+        self.downs = 0
+
+    def stats(self, live=False):
+        return {"workers": self.routable, "routable": self.routable,
+                "submitted": self.outstanding, "replied": 0, "failed": 0,
+                "cancelled": 0}
+
+    def scale_up(self):
+        self.routable += 1
+        self.ups += 1
+        return self.routable - 1
+
+    def scale_down(self, idx=None):
+        self.routable -= 1
+        self.downs += 1
+        return self.routable
+
+
+def test_autoscaler_rejects_bad_shape():
+    fl = _FakeFleet()
+    with pytest.raises(ValueError, match="min_workers"):
+        Autoscaler(fl, min_workers=0)
+    with pytest.raises(ValueError, match="min_workers"):
+        Autoscaler(fl, min_workers=3, max_workers=2)
+    with pytest.raises(ValueError, match="deadband"):
+        Autoscaler(fl, up_per_worker=1.0, down_per_worker=1.0)
+
+
+def test_autoscaler_control_law_hysteresis_cooldown_and_bounds():
+    """The control law on an injected clock: scale-up needs ``up_ticks``
+    of sustained pressure, the post-action cooldown blocks flapping,
+    bounds hold at both ends, and scale-down needs the (longer)
+    ``down_ticks`` streak."""
+    t = [0.0]
+    fl = _FakeFleet(routable=1, outstanding=10)
+    sc = Autoscaler(fl, min_workers=1, max_workers=3, up_per_worker=4.0,
+                    down_per_worker=0.5, up_ticks=2, down_ticks=3,
+                    cooldown_s=10.0, clock=lambda: t[0])
+    assert sc.tick() == "hold"            # hot streak 1 < up_ticks
+    assert sc.tick() == "up"              # streak 2: scale to 2 workers
+    assert fl.routable == 2
+    # pressure 5 >= 4 is still hot, but the cooldown pins the fleet
+    assert sc.tick() == "hold" and sc.tick() == "hold"
+    t[0] = 11.0                           # cooldown expired
+    assert sc.tick() == "up"              # sustained streak carries over
+    assert fl.routable == 3
+    t[0] = 22.0
+    for _ in range(5):                    # at max_workers: hot but capped
+        assert sc.tick() == "hold"
+    assert fl.ups == 2
+
+    fl.outstanding = 0                    # the crowd is gone
+    assert sc.tick() == "hold" and sc.tick() == "hold"  # cold streak 1, 2
+    assert sc.tick() == "down"            # streak 3 == down_ticks
+    assert fl.routable == 2
+    t[0] = 40.0
+    for _ in range(2):
+        assert sc.tick() == "hold"
+    assert sc.tick() == "down"
+    assert fl.routable == 1
+    t[0] = 60.0
+    for _ in range(5):                    # at min_workers: never below
+        assert sc.tick() == "hold"
+    assert fl.downs == 2
+    assert sc.stop() == {"ups": 2, "downs": 2}
+
+
+def test_autoscaler_deadband_holds():
+    """Pressure inside (down_per_worker, up_per_worker) never moves the
+    fleet, no matter how long it persists."""
+    fl = _FakeFleet(routable=2, outstanding=4)   # 2.0 per worker
+    sc = Autoscaler(fl, min_workers=1, max_workers=4, up_per_worker=4.0,
+                    down_per_worker=0.5, up_ticks=1, down_ticks=2,
+                    cooldown_s=0.0, clock=lambda: 0.0)
+    for _ in range(10):
+        assert sc.tick() == "hold"
+    assert fl.ups == 0 and fl.downs == 0
+
+
+def test_thread_fleet_autoscale_round_trip_bit_identical():
+    """The real seam under the law: a backlogged one-worker thread fleet
+    scales up on sustained pressure, the newcomer absorbs stealable work,
+    the idle fleet scales back down gracefully (retired, not lost), and
+    every reply is bit-identical to the offline oracle."""
+    cfgs = [_cfg(50 + i, protocol=p, n=n, delivery=d)
+            for i, (p, n, d) in enumerate(
+                [("benor", 5, "keys"), ("bracha", 7, "keys"),
+                 ("benor", 5, "urn2")] * 2)]
+    with FleetServer(workers=1, mode="thread", backend="numpy",
+                     policy=_POLICY, segment_latency_s=0.05) as fl:
+        sc = Autoscaler(fl, min_workers=1, max_workers=2,
+                        up_per_worker=3.0, down_per_worker=0.5,
+                        up_ticks=1, down_ticks=2, cooldown_s=0.0)
+        handles = [fl.submit(c) for c in cfgs]
+        deadline = time.monotonic() + 60.0
+        while sc.tick() != "up":          # backlog of 6 on 1 worker: hot
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        assert fl.stats(live=False)["routable"] == 2
+        for h, c in zip(handles, cfgs):
+            rec = h.wait(timeout=600.0)
+            rounds, decision = _offline(c)
+            assert rec["rounds"] == rounds and rec["decision"] == decision
+        deadline = time.monotonic() + 60.0
+        while sc.tick() != "down":        # drained: sustained cold
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline and fl.health().get("retiring"):
+            time.sleep(0.05)
+        st = fl.stats(live=False)
+        assert st["routable"] == 1
+        assert st["lost_workers"] == 0 and st["retired_workers"] == 1
+        assert fl.health()["ok"] is True
+
+
+# ------------------------------------------------------ recovering gate --
+
+
+def test_submit_during_recovery_rejects_503_with_retry_after(tmp_path):
+    """While a recovery replay is in progress, fresh submits answer 503
+    with the named ``recovering`` reason, a Retry-After hint, and the
+    ``brc_serve_rejected_total{reason="recovering"}`` count — replayed
+    work never races fresh admissions."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    _metrics.configure()
+    try:
+        with ConsensusServer(backend="numpy", policy=_POLICY) as srv:
+            httpd = serve_http(srv, host="127.0.0.1", port=0)
+            threading.Thread(target=httpd.serve_forever,
+                             daemon=True).start()
+            host, port = httpd.server_address[:2]
+            base = f"http://{host}:{port}"
+            try:
+                srv._recovering = True    # hold the replay window open
+                with pytest.raises(admission.Backpressure) as exc:
+                    srv.submit(_cfg(60))
+                assert exc.value.reason == "recovering"
+
+                body = json.dumps(dataclasses.asdict(_cfg(61))).encode()
+                req = urllib.request.Request(
+                    base + "/submit", data=body,
+                    headers={"Content-Type": "application/json"})
+                with pytest.raises(urllib.error.HTTPError) as exc:
+                    urllib.request.urlopen(req, timeout=30)
+                assert exc.value.code == 503
+                assert float(exc.value.headers["Retry-After"]) > 0
+                doc = json.loads(exc.value.read())
+                assert doc["reason"] == "recovering"
+
+                snap = _metrics.snapshot()
+                series = snap["brc_serve_rejected_total"]["series"]
+                assert any(s["labels"].get("reason") == "recovering"
+                           and s["value"] >= 2 for s in series)
+
+                srv._recovering = False   # replay done: the door reopens
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    assert r.status == 200
+            finally:
+                srv._recovering = False
+                httpd.shutdown()
+                httpd.server_close()
+    finally:
+        _metrics.disable()
+
+
+# -------------------------------------------------------- respawn budget --
+
+
+@pytest.mark.slow
+def test_process_fleet_respawn_budget_and_terminal_state():
+    """Satellite: ``max_respawns`` replaces a crashed worker through the
+    backoff ladder (health returns to ok — the loss is absorbed, not just
+    reported) until the budget is spent, at which point the fleet lands
+    in the NAMED terminal state instead of silently shrinking."""
+    with FleetServer(workers=2, mode="process", policy=_POLICY,
+                     max_respawns=1) as fleet:
+        fleet._workers[0].kill()
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            st = fleet.stats(live=False)
+            if st["respawns"]["used"] == 1 and fleet.health()["ok"]:
+                break
+            time.sleep(0.1)
+        health = fleet.health()
+        assert health["ok"] is True, health  # replaced: green again
+        assert st["lost_workers"] == 1
+        # the replacement still serves bit-identically
+        h = fleet.submit(_cfg(70))
+        rec = h.wait(timeout=600.0)
+        rounds, decision = _offline(_cfg(70))
+        assert rec["rounds"] == rounds and rec["decision"] == decision
+
+        # spend past the budget: the next loss is terminal, and named
+        with fleet._cv:
+            victim = next(w for w in fleet._workers
+                          if w.alive and not w.retiring)
+        victim.kill()
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            health = fleet.health()
+            if health.get("terminal"):
+                break
+            time.sleep(0.1)
+        assert health["terminal"] == "respawn_budget_exhausted"
+        assert health["ok"] is False
+        st = fleet.stats(live=False)
+        assert st["respawns"] == {"budget": 1, "used": 1,
+                                  "terminal": "respawn_budget_exhausted"}
+
+
+# ------------------------------------------------------ subprocess drill --
+
+
+def test_dispatcher_kill_drill_smoke_subprocess(tmp_path):
+    """The kill-the-dispatcher recovery drill end-to-end through the
+    ``loadgen --scenario`` delegation in a real subprocess: SIGKILL
+    mid-stream, restart with ``--recover``, exit 0, and a valid
+    schema-v1.13 record whose elastic block proves recovered work with
+    zero mismatches and zero steady-state recompiles."""
+    out = tmp_path / "elastic_smoke.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m",
+         "byzantinerandomizedconsensus_tpu.tools.loadgen",
+         "--scenario", "dispatcher_kill", "--smoke", "--backend", "numpy",
+         "--out", str(out)],
+        capture_output=True, text=True, timeout=560, env=env)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    doc = json.loads(out.read_text())
+    assert record.validate_record(doc) == [], doc
+    assert doc["record_revision"] == record.RECORD_REVISION
+    eb = doc["elastic"]
+    assert eb["mismatches"] == 0
+    assert eb["steady_state_compiles"] == 0
+    assert eb["recovered"] >= 1
+    assert eb["slo_ok"] is True
+    (row,) = eb["scenarios"]
+    assert row["scenario"] == "dispatcher_kill"
+    # pre-kill replies plus recovered replays cover every admitted
+    # request; the sum can exceed requests when the SIGKILL lands after a
+    # reply but before its WAL completion record is flushed — that
+    # request replays too, which is exactly what idempotence is for
+    assert row["replied"] + row["recovered"] >= row["requests"]
+    assert row["recovered"] == row["owed"] >= 1
+    assert row["slo_ok"] is True

@@ -277,12 +277,54 @@ def test_process_fleet_worker_loss_readmits_bit_identical():
     assert safe_rec["request_id"] == safe.id
 
 
+def test_thread_fleet_scale_down_retires_not_dead():
+    """Round-22 satellite, alongside the worker-kill pin above: a
+    scaled-down worker is **retiring**, never dead — health stays ok (no
+    503), ``lost_workers`` stays 0, the worker table names the state while
+    the drain is in progress, and its in-flight work drains to completion
+    with bit-identical replies under the same ids."""
+    victims = [dataclasses.replace(_HEAVY, seed=201),
+               dataclasses.replace(_HEAVY, seed=202)]
+    with FleetServer(workers=2, mode="thread", policy=_POLICY,
+                     segment_latency_s=0.2) as fleet:
+        doomed = [fleet.submit(c, pin_worker=1) for c in victims]
+        # wait until w1's rotation is genuinely in flight, then retire it
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            with fleet._cv:
+                if fleet._workers[1].inflight:
+                    break
+            time.sleep(0.05)
+        assert fleet.scale_down(1) == 1
+        health = fleet.health()
+        assert health["ok"] is True          # retiring is not dead: no 503
+        assert health["retiring"] == [1]
+        st = fleet.stats(live=False)
+        assert st["routable"] == 1           # out of the routing fabric...
+        assert st["workers"] == 2            # ...but still in the table
+        recs = [h.wait(timeout=600.0) for h in doomed]
+        for h, rec, cfg in zip(doomed, recs, victims):
+            assert rec["request_id"] == h.id
+            rounds, decision = _offline(cfg)
+            assert rec["rounds"] == rounds and rec["decision"] == decision
+        # the drain completes: retired, not lost — and health forgets it
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline and fleet.health().get("retiring"):
+            time.sleep(0.05)
+        assert fleet.health() == {"ok": True, "workers": 1, "alive": 1,
+                                  "dead_workers": []}
+        st = fleet.stats(live=False)
+        assert st["lost_workers"] == 0
+        assert st["retired_workers"] == 1
+
+
 @pytest.mark.slow
 def test_process_fleet_healthz_names_dead_worker():
     """Round-16 satellite: ``GET /healthz`` is per-worker liveness — 200
-    while every worker is up; after a hard kill (the fleet never respawns
-    past the initial backoff ladder) it degrades to 503 with a JSON body
-    naming the dead worker, while survivors keep serving."""
+    while every worker is up; after a hard kill (with the default
+    ``max_respawns=0`` budget the fleet never respawns past the initial
+    backoff ladder) it degrades to 503 with a JSON body naming the dead
+    worker, while survivors keep serving."""
     import threading
     import urllib.error
     import urllib.request

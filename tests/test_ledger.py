@@ -725,6 +725,51 @@ def test_census_includes_session_artifact():
     assert "replay OK" in report
 
 
+def test_census_includes_elastic_artifact():
+    """The round-22 durability/autoscaling artifact: a SIGKILLed
+    dispatcher recovered bit-identically from the write-ahead admission
+    log, and the autoscale flash crowd meeting the p99 bound the pinned
+    static fleet misses — with the schema-v1.13 elastic columns
+    reconstructed by the ledger, and the census floor raised past it."""
+    import pathlib
+
+    from byzantinerandomizedconsensus_tpu.utils.rounds import repo_root
+
+    doc = ledger.build_ledger()
+    assert doc["parse_errors"] == []
+    assert doc["files_scanned"] >= 14
+    rows = {r["artifact"]: r for r in doc["elastic_rows"]}
+    assert "artifacts/elastic_r22.json" in rows, \
+        "elastic_r22.json must yield durability/autoscaling columns"
+    row = rows["artifacts/elastic_r22.json"]
+    assert row["recovered"] >= 1              # the kill drill owed work
+    assert row["scale_up_events"] >= 1 and row["scale_down_events"] >= 1
+    assert row["mismatches"] == 0             # recovery is bit-identical
+    assert row["steady_state_compiles"] == 0  # warm across scale events
+    assert row["slo_ok"] is True
+    assert row["drills"] == {"dispatcher_kill": True,
+                             "autoscale_crowd": True}
+    assert row["elastic_p99_ms"] <= row["slo_ms"] < row["static_p99_ms"]
+
+    ev = json.loads((pathlib.Path(repo_root())
+                     / "artifacts/elastic_r22.json").read_text())
+    assert ev["kind"] == "elastic"
+    assert record.validate_record(ev) == []
+    assert ev["record_revision"] >= 13  # schema v1.13
+    eb = ev["elastic"]
+    assert eb["suite_seed"] == 22
+    assert {s["scenario"] for s in eb["scenarios"]} == \
+        {"dispatcher_kill", "autoscale_crowd"}
+
+    report = ledger.format_report(doc)
+    assert "durability/autoscaling columns" in report
+    assert "dispatcher_kill OK" in report and "autoscale_crowd OK" in report
+    # evidence columns, not a new debt class: the standing-debt set is
+    # untouched by the elastic block (pinned exactly in the test below)
+    assert {d["debt"] for d in ledger.debts_of(doc)} == \
+        {"device-chain", "fused-bitmatch"}
+
+
 def test_debts_verb_prints_standing_rows(capsys):
     """``brc-tpu ledger --debts``: the one-glance "what still owes a TPU
     run" table. As committed, both standing families appear — the r5
