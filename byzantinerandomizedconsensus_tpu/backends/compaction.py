@@ -56,6 +56,7 @@ from byzantinerandomizedconsensus_tpu.backends.batch import (
     ADV_CODES, COIN_CODES, FAULT_CODES, INIT_CODES, FusedBucket,
     FusedLaneConfig, LaneConfig, ShapeBucket, _chunk_instances, _key_label,
     _PadAdversary, compile_cache, lane_tier)
+from byzantinerandomizedconsensus_tpu.backends import lanestate as _lanestate
 from byzantinerandomizedconsensus_tpu.obs import metrics as _metrics
 from byzantinerandomizedconsensus_tpu.obs import trace as _trace
 from byzantinerandomizedconsensus_tpu.ops import prf
@@ -169,6 +170,7 @@ class WorkFeed:
         self._cancelled: list = []
         self._cv = threading.Condition()
         self._closed = False
+        self._poked = False
         # Tokens of live sessions (spec §11) that own this feed: a session's
         # future slots materialize at the grid's retire seam, not here, so
         # "queue empty + closed" is NOT "drained" while an owner lives —
@@ -292,19 +294,30 @@ class WorkFeed:
             self._release_owner(token)
             self._cv.notify_all()
 
+    def poke(self) -> None:
+        """Wake a grid parked in a blocking :meth:`pull` without enqueuing
+        work (round 23): the next blocking pull returns ``[]`` once so
+        ``run_bucket`` reaches its segment boundary and services any
+        pending :class:`~byzantinerandomizedconsensus_tpu.backends.\
+lanestate.LaneControl` request (park/extract)."""
+        with self._cv:
+            self._poked = True
+            self._cv.notify_all()
+
     def pull(self, block: bool = False):
         """Everything pushed since the last pull: a list of
         ``(cfg, ids, token, session)`` items, ``[]`` when nothing is
         pending, or ``None`` once the feed is closed *and* drained.
-        ``block=True`` waits for items or close — the idle server parks
-        here. A feed owned by a live session is never drained: its future
-        slots materialize at the grid's retire seam, so pull keeps the
-        stream open (returns ``[]`` / keeps waiting) until every owner
-        retires its last slot or is cancelled."""
+        ``block=True`` waits for items, close, or a :meth:`poke` — the idle
+        server parks here. A feed owned by a live session is never drained:
+        its future slots materialize at the grid's retire seam, so pull
+        keeps the stream open (returns ``[]`` / keeps waiting) until every
+        owner retires its last slot or is cancelled."""
         with self._cv:
-            while block and not self._items and not (
+            while block and not self._items and not self._poked and not (
                     self._closed and not self._owner_tokens):
                 self._cv.wait()
+            self._poked = False
             if not self._items:
                 return (None if self._closed and not self._owner_tokens
                         else [])
@@ -547,7 +560,7 @@ class _StaticCfgView:
 
 def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
                counters: bool = False, progress=None, feed=None,
-               on_retire=None):
+               on_retire=None, control=None, imports=None):
     """Run every instance of every config of ONE bucket through the
     compacted lane grid. Returns ``(results, docs_or_None, stats)`` with
     ``results`` per-config SimResults bit-identical to the per-chunk path and
@@ -571,6 +584,19 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
     next refill re-seeds the freed lanes in place. No admission round-trip,
     no new program key (the seed is a dynamic operand), and each slot is
     bit-identical to the offline ``run_session`` replay.
+
+    ``control`` (a :class:`~byzantinerandomizedconsensus_tpu.backends.\
+lanestate.LaneControl`) opens the round-23 snapshot seam: at every segment
+    boundary the grid services queued **park** (export every extractable
+    config as :class:`~byzantinerandomizedconsensus_tpu.backends.lanestate.\
+LaneRecord` and return — the preemption path) and **extract** (export just
+    the named tokens, keep flying — the migration path) requests. Spec-§11
+    sessions are never extractable. ``imports`` is the other half: a list of
+    LaneRecords whose pending instances re-enter the work stream and whose
+    mid-round lanes are spliced back into the device carry on host after the
+    ordinary init/refill placement — restored lanes continue bit-identically
+    (PRF draws are coordinate-addressed; placement never enters one), and
+    snapshot arrays are pure data operands, so no program key changes.
     """
     import jax
     import jax.numpy as jnp
@@ -622,6 +648,55 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
         work_pos = np.empty(0, dtype=np.int64)
         work_iid = np.empty(0, dtype=np.uint32)
         op_mat = {}
+
+    # Round-23 restore: imported LaneRecords join the books like configs;
+    # their pending instances enter the work stream as ordinary (pos, iid)
+    # entries (fresh init is a pure function of (key, iid) — bit-identical
+    # to never having been exported) and their mid-round lanes enter it too,
+    # flagged in ``restore_map`` so the host splices the saved carry rows in
+    # right after init/refill places them.
+    restore_map: dict = {}  # (ci, pos) -> (record, lane row j)
+    restored_lanes = 0
+    import_entries: list = []  # (ci, record) — counters acc preload below
+    for rec in (imports or []):
+        if rec.version != _lanestate.LANESTATE_VERSION:
+            raise _lanestate.LaneStateVersionError(
+                f"lanestate version {rec.version!r} (this build speaks "
+                f"{_lanestate.LANESTATE_VERSION})")
+        cfg = rec.cfg.validate()
+        ids = np.asarray(rec.ids, dtype=np.uint32)
+        ci = len(cfgs)
+        cfgs.append(cfg)
+        ids_list.append(ids)
+        tokens.append(rec.token if rec.token is not None else ci)
+        rounds_out.append(np.array(rec.rounds, dtype=np.int32))
+        dec_out.append(np.array(rec.decision, dtype=np.uint8))
+        lane_pos = np.asarray(rec.lanes["pos"], dtype=np.int64)
+        pend = list(rec.pending)
+        remaining.append(len(pend) + len(lane_pos))
+        sess_left.append(1)
+        sess_slot.append(0)
+        sess_owner.append(False)
+        row = _host_op_row(bucket, cfg)
+        for k in row:
+            v = np.asarray(row[k])[None]
+            op_mat[k] = (np.concatenate([op_mat[k], v])
+                         if k in op_mat else v)
+        pos_new = np.concatenate(
+            [lane_pos, np.asarray([p for p, _ in pend], dtype=np.int64)])
+        iid_new = np.concatenate(
+            [ids[lane_pos].astype(np.uint32),
+             np.asarray([i for _, i in pend], dtype=np.uint32)])
+        work_cfg = np.concatenate(
+            [work_cfg, np.full(len(pos_new), ci, dtype=np.int32)])
+        work_pos = np.concatenate([work_pos, pos_new])
+        work_iid = np.concatenate([work_iid, iid_new])
+        total += len(pos_new)
+        for j, p in enumerate(lane_pos):
+            restore_map[(ci, int(p))] = (rec, j)
+        import_entries.append((ci, rec))
+        _trace.event("compaction.import", cfg_index=ci,
+                     **rec.doc_summary())
 
     def _ingest(block=False):
         """Splice newly arrived feed items into the host work stream.
@@ -682,7 +757,12 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
         if counters:
             docs = [_c.counters_doc(c, _c.finalize(c, _c.zeros(c, 0, np)),
                                     backend=backend.name) for c in cfgs]
+        if control is not None:
+            control.detach()
         return results, docs, {"width": 0, "segments": 0, "refills": 0,
+                               "parks": 0, "parked_exit": False,
+                               "exported_cfgs": 0, "exported_lanes": 0,
+                               "restored_lanes": 0,
                                "device_lane_rounds": 0,
                                "useful_lane_rounds": 0, "occupancy": None,
                                "wasted_lane_fraction": None,
@@ -691,6 +771,12 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
     n_counters = len(_c.counter_names(cfgs[0])) if counters else 0
     acc_out = ([np.zeros((len(ids), n_counters, 2), dtype=np.uint32)
                 for ids in ids_list] if counters else None)
+    if counters:
+        # Imported records restore their already-retired instances' partial
+        # counter totals; live lanes' accumulators splice with the carry.
+        for ci, rec in import_entries:
+            if rec.acc_done is not None:
+                acc_out[ci][:] = np.asarray(rec.acc_done, dtype=np.uint32)
 
     base = policy.width or _chunk_instances(
         bucket, 1, total, backend.chunk_bytes, backend.max_chunk)
@@ -832,6 +918,132 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
                 "In-grid session slot re-seeds at the retire seam "
                 "(spec §11)").inc()
 
+    # Round-23 snapshot seam state: configs exported out of this grid behave
+    # like cancelled ones from here on (no retire, no record) — their state
+    # now lives in LaneRecords owned by the control's caller.
+    parked_cis: set = set()
+    exported_lanes = 0
+    parks = 0
+
+    def _restore_rows(carry, placed):
+        """Splice saved carry rows over freshly placed lanes (host-side pure
+        data movement — the restore half of the round-23 seam)."""
+        nonlocal restored_lanes
+        rows = []
+        for w in placed:
+            key = (int(owner_cfg[w]), int(owner_pos[w]))
+            if key in restore_map:
+                rows.append((w,) + restore_map.pop(key))
+        if not rows:
+            return carry
+        with _trace.span("compaction.restore", lanes=len(rows)):
+            host = jax.tree_util.tree_map(
+                lambda a: np.array(a), jax.device_get(carry))
+            r_h, st_h, setup_h, done_h = host[2], host[3], host[4], host[5]
+            leaves, _treedef = jax.tree_util.tree_flatten(setup_h)
+            for w, rec, j in rows:
+                r_h[w] = rec.lanes["r"][j]
+                for k in st_h:
+                    st_h[k][w] = rec.lanes["st"][k][j]
+                for li, leaf in enumerate(leaves):
+                    leaf[w] = rec.lanes["setup"][li][j]
+                done_h[w] = -1
+                if counters and rec.lanes.get("acc") is not None:
+                    host[6][w] = rec.lanes["acc"][j]
+                prev_r[w] = int(r_h[w])
+            carry = jax.tree_util.tree_map(jnp.asarray, host)
+        restored_lanes += len(rows)
+        return carry
+
+    def _export(tokens_req=None) -> list:
+        """Export every extractable config (or just ``tokens_req``'s, by
+        identity) as LaneRecords: slice live lanes off a host copy of the
+        carry, pull queued stream entries, and drop the config from the
+        grid's books. Sessions and dead configs are never exported."""
+        nonlocal work_cfg, work_pos, work_iid, total, exported_lanes
+        cis = []
+        for ci in range(len(cfgs)):
+            if ci in dead or ci in parked_cis or remaining[ci] <= 0:
+                continue
+            if sess_owner[ci] or sess_left[ci] > 1 or sess_slot[ci] > 0:
+                continue  # spec-§11 sessions ride one grid whole
+            if tokens_req is not None and not any(
+                    tokens[ci] is t for t in tokens_req):
+                continue
+            cis.append(ci)
+        if not cis:
+            return []
+        records = []
+        with _trace.span("compaction.snapshot", configs=len(cis)) as sp:
+            host = None
+            if any((owner_cfg == ci).any() for ci in cis):
+                host = jax.tree_util.tree_map(
+                    lambda a: np.array(a), jax.device_get(carry))
+            for ci in cis:
+                sel = owner_cfg == ci
+                n_l = int(sel.sum())
+                if n_l:
+                    leaves, _ = jax.tree_util.tree_flatten(host[4])
+                    lanes = {
+                        "pos": owner_pos[sel].copy(),
+                        "r": host[2][sel],
+                        "st": {k: host[3][k][sel] for k in host[3]},
+                        "setup": [leaf[sel] for leaf in leaves],
+                    }
+                    if counters:
+                        lanes["acc"] = host[6][sel]
+                else:
+                    lanes = {"pos": np.empty(0, dtype=np.int64),
+                             "r": np.empty(0, dtype=np.int32),
+                             "st": {}, "setup": []}
+                tail = work_cfg[head:]
+                mask = tail == ci
+                pend = list(zip(work_pos[head:][mask].tolist(),
+                                work_iid[head:][mask].tolist()))
+                if mask.any():
+                    keep = ~mask
+                    work_cfg = np.concatenate([work_cfg[:head], tail[keep]])
+                    work_pos = np.concatenate(
+                        [work_pos[:head], work_pos[head:][keep]])
+                    work_iid = np.concatenate(
+                        [work_iid[:head], work_iid[head:][keep]])
+                    total -= int(mask.sum())
+                records.append(_lanestate.LaneRecord(
+                    version=_lanestate.LANESTATE_VERSION,
+                    cfg=cfgs[ci],
+                    ids=np.asarray(ids_list[ci], dtype=np.uint32),
+                    rounds=rounds_out[ci].copy(),
+                    decision=dec_out[ci].copy(),
+                    remaining=len(pend) + n_l,
+                    pending=pend,
+                    lanes=lanes,
+                    token=tokens[ci],
+                    acc_done=(np.array(acc_out[ci]) if counters else None)))
+                parked_cis.add(ci)
+                owner_cfg[sel] = -1
+                exported_lanes += n_l
+            sp["lanes"] = sum(r.lane_count() for r in records)
+            sp["pending"] = sum(len(r.pending) for r in records)
+        return records
+
+    def _service_control() -> bool:
+        """Drain the control mailbox at this boundary. True = a park
+        emptied the grid, so run_bucket should return now."""
+        nonlocal parks
+        stop = False
+        while True:
+            req = control._pop_request()
+            if req is None:
+                return stop
+            recs = _export(req.tokens)
+            if req.kind == "park":
+                parks += 1
+                control._deliver_park(req, recs)
+                if not (owner_cfg >= 0).any():
+                    stop = True
+            else:
+                req.deliver(recs)
+
     # Fill the whole grid, then alternate segment dispatches with
     # compaction+refill dispatches whenever the retired fraction crosses the
     # policy threshold (always when the grid fully drains).
@@ -843,7 +1055,9 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
     owner_cfg[:take] = work_cfg[:take]
     owner_pos[:take] = work_pos[:take]
     head = take
+    carry = _restore_rows(carry, range(take))
 
+    parked_exit = False
     while True:
         # The per-trip wall the round-11 anatomy reconstructed by hand is
         # now this span's duration; drain trips get their own kind so the
@@ -939,6 +1153,12 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
             if _reap():  # cancels land at the same boundary
                 live = owner_cfg >= 0
                 free = W - int(live.sum())
+        if control is not None:
+            if _service_control():
+                parked_exit = True
+                break
+            live = owner_cfg >= 0
+            free = W - int(live.sum())
         if head >= total and not live.any():
             # Grid idle. Offline that is the end; a live feed parks here
             # (blocking pull) until new work arrives or the feed closes.
@@ -978,12 +1198,18 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
                 sp["keep"] = n_keep
                 sp["take"] = take
                 sp["queued"] = total - head
+            carry = _restore_rows(carry, range(n_keep, n_keep + take))
             if _metrics.enabled():
                 _metrics.counter("brc_compaction_refills_total",
                                  "Compaction+refill dispatches").inc()
                 _metrics.gauge("brc_compaction_refill_depth",
                                "Work-stream items still queued after the "
                                "last refill").set(total - head)
+
+    if control is not None:
+        # Deliver [] to any still-queued control request: the grid is gone
+        # (drained or parked), so nothing more is extractable from it.
+        control.detach()
 
     results = [SimResult(config=c, inst_ids=i, rounds=r, decision=d)
                for c, i, r, d in zip(cfgs, ids_list, rounds_out, dec_out)]
@@ -1010,6 +1236,11 @@ def run_bucket(backend, bucket, cfgs, ids_list, policy=None,
         "cancelled_cfgs": len(dead),
         "cancelled_lanes": cancelled_lanes,
         "session_reseeds": session_reseeds,
+        "parks": parks,
+        "parked_exit": parked_exit,
+        "exported_cfgs": len(parked_cis),
+        "exported_lanes": exported_lanes,
+        "restored_lanes": restored_lanes,
         "device_lane_rounds": device_rounds,
         "useful_lane_rounds": useful_rounds,
         "occupancy": (round(useful_rounds / device_rounds, 4)

@@ -344,7 +344,8 @@ def run_hunt(args) -> tuple[dict, Archive, int]:
     from byzantinerandomizedconsensus_tpu.serve.server import ConsensusServer
     from byzantinerandomizedconsensus_tpu.tools import loadgen as _loadgen
 
-    space = SearchSpace()
+    space = SearchSpace(
+        committee_scale=getattr(args, "committee_scale", False))
     if args.url:
         server, owned = RemoteServer(args.url), False
     else:
@@ -434,6 +435,10 @@ def main(argv=None) -> int:
     ap.add_argument("--url", default=None,
                     help="hunt a remote server instead of in-process "
                          "(compile pins become unmeasured)")
+    ap.add_argument("--committee-scale", action="store_true",
+                    help="admit §10 delivery='committee' genomes at "
+                         "committee-scale n (pow2 tiers 1024..65536); the "
+                         "warm-up universe grows by 2 programs per tier")
     ap.add_argument("--no-invariants", action="store_true",
                     help="skip the per-reply safety checks (faster; the "
                          "violations pin becomes vacuous)")

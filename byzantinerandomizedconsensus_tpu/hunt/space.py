@@ -21,15 +21,29 @@ honest:
    product (2 protocols × 5 deliveries), enumerable by :meth:`buckets`
    for a complete warm-up. That is what makes the hunt's
    0-steady-state-recompile pin achievable.
+
+**Committee scale (round 23, opt-in).** ``SearchSpace(committee_scale=True)``
+admits the §10 ``delivery="committee"`` family past the n ≤ 40 fold, at
+committee-scale n (10³–10⁵). Rule 3 survives because committee candidates
+are pinned to the pow2 bucket tiers (:data:`COMMITTEE_N_TIERS`, a subset of
+``backends.batch.N_TIERS``): the universe grows by exactly 2 protocols ×
+len(tiers) programs — still closed, still enumerable, still warmable before
+measurement. Repair snaps any off-tier committee n up to its tier and holds
+f under the spec-§10.3 sortition ceiling (k·f_C < C inverted for f), so the
+gate never fires; a mutation that leaves the committee family clamps n back
+to the full-mesh fold. Default ``False`` keeps the legacy 10-program
+universe byte-for-byte.
 """
 
 from __future__ import annotations
 
+import math
 import random
 
-from byzantinerandomizedconsensus_tpu.backends.batch import FusedBucket
+from byzantinerandomizedconsensus_tpu.backends.batch import FusedBucket, n_tier
 from byzantinerandomizedconsensus_tpu.config import (
     DELIVERY_KINDS, FAULT_KINDS, SimConfig)
+from byzantinerandomizedconsensus_tpu.ops import committee as _committee
 from byzantinerandomizedconsensus_tpu.tools import sampler as _sampler
 
 # Genome field order — also the crossover axis order, so it is part of the
@@ -37,6 +51,29 @@ from byzantinerandomizedconsensus_tpu.tools import sampler as _sampler
 GENOME_FIELDS = ("protocol", "n", "f", "instances", "adversary", "coin",
                  "init", "seed", "round_cap", "delivery", "faults",
                  "crash_window")
+
+#: The committee-scale n tiers (round 23): pow2 members of
+#: ``backends.batch.N_TIERS`` spanning 10³–10⁵, the §10 sortition regime
+#: where C(n) < n. Candidates land *exactly* on a tier, so each adds one
+#: compiled program per protocol and the warm-up universe stays closed.
+COMMITTEE_N_TIERS = (1024, 4096, 16384, 65536)
+
+
+def _committee_f_ceiling(protocol: str, adversary: str, n: int) -> int:
+    """Largest f whose spec-§10.3 sortition bound holds: invert
+    f_C = ⌈C·f/n⌉ + ⌊√C⌋ under k·f_C < C (k = 3 bracha, 5 benor+lying,
+    2 benor). Degenerate committees (C = n) defer to the full-mesh
+    ceilings — thresholds reduce to the plain §5 laws there."""
+    c = _committee.committee_size(n)
+    if c >= n:
+        return n
+    lying = adversary in ("byzantine", "adaptive", "adaptive_min")
+    k = 3 if protocol == "bracha" else (5 if lying else 2)
+    margin = (c - 1) // k - math.isqrt(c)
+    if margin < 1:
+        return 0
+    return min(n - 1, margin * n // c)
+
 
 #: per-axis mutation domains (f and seed are handled specially)
 _MUTATION_DOMAINS = {
@@ -68,22 +105,41 @@ class SearchSpace:
 
     generator_version = _sampler.GENERATOR_VERSION
     max_n = _sampler.MAX_SOAK_N
+    max_committee_n = COMMITTEE_N_TIERS[-1]
+
+    def __init__(self, committee_scale: bool = False):
+        self.committee_scale = bool(committee_scale)
 
     def sample(self, rng: random.Random) -> SimConfig:
         """One seeded draw — the chaos generator's laws, verbatim."""
         return _sampler.random_config(rng, chaos=True)
 
+    def _fmax(self, protocol: str, adversary: str, n: int,
+              delivery: str) -> int:
+        """The joint f ceiling: the full-mesh resilience bound, tightened
+        by the §10.3 sortition bound when the delivery is committee."""
+        fmax = _sampler._f_ceiling(protocol, adversary, n)
+        if delivery == "committee":
+            fmax = min(fmax, _committee_f_ceiling(protocol, adversary, n))
+        return fmax
+
     def _repair(self, genome: dict) -> dict:
         """Clamp a mutated/crossed genome back into the admissible region:
-        f into the resilience ceiling for (protocol, adversary, n), the
-        adversary demoted to "none" when the shape cannot host a faulty
-        set. Same ceilings the sampler redraws against."""
-        fmax = _sampler._f_ceiling(
-            genome["protocol"], genome["adversary"], genome["n"])
+        n back under the fold (or snapped up to its pow2 committee tier),
+        f into the resilience ceiling for (protocol, adversary, n,
+        delivery), the adversary demoted to "none" when the shape cannot
+        host a faulty set. Same ceilings the sampler redraws against."""
+        if genome["n"] > self.max_n:
+            if self.committee_scale and genome["delivery"] == "committee":
+                genome["n"] = n_tier(genome["n"])
+            else:
+                genome["n"] = self.max_n
+        fmax = self._fmax(genome["protocol"], genome["adversary"],
+                          genome["n"], genome["delivery"])
         if fmax < 1 and genome["adversary"] != "none":
             genome["adversary"] = "none"
-            fmax = _sampler._f_ceiling(
-                genome["protocol"], "none", genome["n"])
+            fmax = self._fmax(genome["protocol"], "none",
+                              genome["n"], genome["delivery"])
         lo = 0 if genome["adversary"] == "none" else 1
         genome["f"] = min(max(int(genome["f"]), lo), fmax)
         return genome
@@ -93,10 +149,14 @@ class SearchSpace:
         genome = encode(cfg)
         axis = rng.choice(GENOME_FIELDS)
         if axis == "n":
-            genome["n"] = rng.randrange(4, self.max_n + 1)
+            if self.committee_scale and genome["delivery"] == "committee":
+                genome["n"] = rng.choice(
+                    tuple(range(4, self.max_n + 1)) + COMMITTEE_N_TIERS)
+            else:
+                genome["n"] = rng.randrange(4, self.max_n + 1)
         elif axis == "f":
-            fmax = _sampler._f_ceiling(
-                genome["protocol"], genome["adversary"], genome["n"])
+            fmax = self._fmax(genome["protocol"], genome["adversary"],
+                              genome["n"], genome["delivery"])
             lo = 0 if genome["adversary"] == "none" else 1
             if fmax >= lo:
                 genome["f"] = rng.randrange(lo, fmax + 1)
@@ -160,6 +220,17 @@ class SearchSpace:
                     adversary="crash", round_cap=32,
                     delivery=delivery).validate()
                 probe.append(FusedBucket.of(cfg))
+        if self.committee_scale:
+            # the committee-scale wing: one program per (protocol, tier) —
+            # candidates land exactly on COMMITTEE_N_TIERS, so this closes
+            # the universe at 10 + 2·len(tiers)
+            for protocol in _sampler._PROTOCOLS:
+                for tier in COMMITTEE_N_TIERS:
+                    cfg = SimConfig(
+                        protocol=protocol, n=tier, f=1, instances=8,
+                        adversary="crash", round_cap=32,
+                        delivery="committee").validate()
+                    probe.append(FusedBucket.of(cfg))
         return probe
 
     def doc(self) -> dict:
@@ -167,6 +238,9 @@ class SearchSpace:
         return {
             "generator_version": self.generator_version,
             "max_n": self.max_n,
+            "committee_scale": self.committee_scale,
+            "committee_n_tiers": list(COMMITTEE_N_TIERS)
+            if self.committee_scale else [],
             "protocols": list(_sampler._PROTOCOLS),
             "adversaries": list(_sampler._ADVERSARIES),
             "deliveries": list(DELIVERY_KINDS),

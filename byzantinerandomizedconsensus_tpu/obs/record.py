@@ -134,6 +134,23 @@ p99 bound a pinned static fleet misses). Carried by
 ``record_version`` stays 1, the revision is declarative, and the block
 shape is checked only when present.
 
+Schema v1.14 (round 23) adds the **lanestate** and **preempt** blocks
+(:func:`lanestate_block` / :func:`preempt_block` — serializable lane state,
+backends/lanestate.py + the preemption drills of tools/hostile.py).
+``lanestate`` carries the snapshot/restore bit-identity audit: the
+LANESTATE_VERSION the records speak, the fault×adversary×delivery grid
+point count, the restore-mismatch pin (0 — a parked-and-resumed grid
+finishes bit-identical to an uninterrupted run at every point, the
+mid-crash-window and mid-partition points included), and the
+crash-window / serialized-wire round-trip verdicts. ``preempt`` carries
+the ``preempt_storm`` drill: suite seed, park/resume and
+lane-export/import counts, the preemptive deadline hit rate vs the FIFO
+baseline on identical traffic, and the standing mismatch /
+steady-compile pins. Carried by ``artifacts/preempt_r23.json``. Same
+compatibility rule as v1.1–v1.13: ``record_version`` stays 1, the
+revision is declarative, and the block shapes are checked only when
+present.
+
 tools/ledger.py consumes both this format and the legacy r1–r7 shapes;
 :func:`validate_record` is the schema check the tier-1 tests pin, and
 ``brc-tpu ledger --check`` (the regression sentinel) compares the committed
@@ -173,8 +190,13 @@ RECORD_VERSION = 1
 # (round 22) the elastic block (durable/elastic serving: write-ahead
 # admission-log recovery counts from the dispatcher-kill drill, autoscaler
 # scale-event counts from the flash-crowd leg, the named recovering-503
-# rejections, and the bit-match / steady-compile / SLO pins).
-RECORD_REVISION = 13
+# rejections, and the bit-match / steady-compile / SLO pins); v1.14
+# (round 23) the lanestate block (serializable lane state: the
+# snapshot/restore bit-identity grid, crash-window and wire round-trip
+# verdicts) + the preempt block (the preempt_storm drill: park/resume and
+# lane-migration counts, the preemptive-vs-FIFO deadline hit rates, and
+# the bit-match / steady-compile pins).
+RECORD_REVISION = 14
 
 
 def env_fingerprint() -> dict:
@@ -467,7 +489,8 @@ def fleet_block(stats: dict | None) -> dict | None:
     return {k: stats.get(k) for k in
             (FLEET_BLOCK_KEYS + ("warmup_compiles", "duration_s",
                                  "population", "fabric_latency_ms",
-                                 "rotation_cap", "placement"))
+                                 "rotation_cap", "placement",
+                                 "migrations", "lanes_migrated"))
             if k in stats}
 
 
@@ -663,6 +686,56 @@ def elastic_block(stats: dict | None) -> dict | None:
             (ELASTIC_BLOCK_KEYS + ("generator_version", "duration_s",
                                    "static_p99_ms", "elastic_p99_ms",
                                    "slo_ms"))
+            if k in stats}
+
+
+#: The fields a schema-v1.14 ``lanestate`` block must carry (the
+#: serializable-lane-state audit of backends/lanestate.py: the record
+#: version the run speaks, the restore bit-identity grid size, and the
+#: mismatch / crash-window / wire-round-trip pins).
+LANESTATE_BLOCK_KEYS = ("version", "grid_points", "restore_mismatches",
+                        "crash_window_ok", "roundtrip_ok")
+
+
+def lanestate_block(stats: dict | None) -> dict | None:
+    """The schema-v1.14 ``lanestate`` block from a snapshot/restore audit
+    stats dict (tools/hostile.py ``preempt_storm`` restore leg). None in,
+    None out — a record without the block stays a valid v1.x record.
+    ``restore_mismatches`` counts grid points where a parked-and-resumed
+    run diverged from the uninterrupted control (pinned 0);
+    ``crash_window_ok`` / ``roundtrip_ok`` are the mid-crash-window-restore
+    and serialized-wire (JSON) round-trip verdicts."""
+    if stats is None:
+        return None
+    return {k: stats.get(k) for k in
+            (LANESTATE_BLOCK_KEYS + ("grid", "lanes_round_tripped",
+                                     "duration_s"))
+            if k in stats}
+
+
+#: The fields a schema-v1.14 ``preempt`` block must carry (the
+#: preempt_storm drill of tools/hostile.py: suite identity, park/resume
+#: and lane-migration accounting, the preemptive-vs-FIFO deadline hit
+#: rates, and the suite-wide mismatch / steady-compile pins).
+PREEMPT_BLOCK_KEYS = ("suite_seed", "requests", "parks", "resumes",
+                      "lanes_exported", "lanes_imported",
+                      "deadline_hit_rate", "fifo_hit_rate",
+                      "mismatches", "steady_state_compiles")
+
+
+def preempt_block(stats: dict | None) -> dict | None:
+    """The schema-v1.14 ``preempt`` block from a preempt_storm stats dict
+    (tools/hostile.py). None in, None out — a record without the block
+    stays a valid v1.x record. ``deadline_hit_rate`` is the urgent-request
+    deadline hit rate with preemptive scheduling on; ``fifo_hit_rate`` is
+    the same traffic through the round-18 FIFO path (the claim is
+    deadline_hit_rate > fifo_hit_rate at ``mismatches`` == 0 and
+    ``steady_state_compiles`` == 0)."""
+    if stats is None:
+        return None
+    return {k: stats.get(k) for k in
+            (PREEMPT_BLOCK_KEYS + ("generator_version", "urgent_requests",
+                                   "fat_requests", "duration_s"))
             if k in stats}
 
 
@@ -888,6 +961,34 @@ def validate_record(doc: dict) -> list:
                                 problems.append(
                                     f"elastic scenario row {i} missing "
                                     f"{key!r}")
+    ls = doc.get("lanestate")
+    if ls is not None:
+        if not isinstance(ls, dict):
+            problems.append("lanestate block is not a dict")
+        else:
+            for key in LANESTATE_BLOCK_KEYS:
+                if key not in ls:
+                    problems.append(f"lanestate block missing {key!r}")
+            for key in ("crash_window_ok", "roundtrip_ok"):
+                ok = ls.get(key)
+                if ok is not None and not isinstance(ok, bool):
+                    problems.append(
+                        f"lanestate block {key!r} is not a bool")
+    pb = doc.get("preempt")
+    if pb is not None:
+        if not isinstance(pb, dict):
+            problems.append("preempt block is not a dict")
+        else:
+            for key in PREEMPT_BLOCK_KEYS:
+                if key not in pb:
+                    problems.append(f"preempt block missing {key!r}")
+            for key in ("deadline_hit_rate", "fifo_hit_rate"):
+                rate = pb.get(key)
+                if rate is not None and (isinstance(rate, bool)
+                                         or not isinstance(rate,
+                                                           (int, float))):
+                    problems.append(
+                        f"preempt block {key!r} is not a number")
     pg = doc.get("programs")
     if pg is not None:
         if not isinstance(pg, dict):

@@ -63,7 +63,8 @@ runs out, instead of silent permanent loss.
 Trace kinds (docs/OBSERVABILITY.md §3f, role ``fleet-coord``):
 ``fleet.spawn``, ``fleet.backoff``, ``fleet.route``, ``fleet.dispatch``,
 ``fleet.steal``, ``fleet.worker_lost``, ``fleet.readmit``,
-``fleet.shutdown``, ``fleet.retire``, ``fleet.respawn``.
+``fleet.shutdown``, ``fleet.retire``, ``fleet.respawn``,
+``fleet.migrate``.
 """
 
 from __future__ import annotations
@@ -328,6 +329,14 @@ class _ProcessWorker(_WorkerBase):
                 with self._rpc_cv:
                     self._rpc_out[msg.get("rpc")] = msg.get("stats")
                     self._rpc_cv.notify_all()
+            elif op == "export":
+                # round 23 migration: (fid, record-doc) pairs — rpc ids
+                # are fleet-unique, so stats and exports share the map
+                with self._rpc_cv:
+                    self._rpc_out[msg.get("rpc")] = [
+                        (d.get("id"), d.get("record"))
+                        for d in msg.get("lanes") or []]
+                    self._rpc_cv.notify_all()
             elif op == "bye":
                 self.final_stats = msg.get("stats")
                 self.fleet._absorb_worker(self.idx, self.final_stats)
@@ -353,6 +362,32 @@ class _ProcessWorker(_WorkerBase):
                     return self._rpc_out.pop(rpc, None) or self.final_stats
                 self._rpc_cv.wait(left)
             return self._rpc_out.pop(rpc)
+
+    def export_lanes_rpc(self, fids,
+                         timeout: float = _STATS_RPC_TIMEOUT_S) -> list:
+        """Blocking export RPC (round 23 migration): ask the child to
+        serialize the named requests' lane state at its next segment
+        boundary. Returns ``(fid, record-doc)`` pairs; empty on a dead or
+        unresponsive worker (the caller then just leaves the work put)."""
+        if not self.alive:
+            return []
+        rpc = self.fleet._next_rpc()
+        if not self._emit({"op": "export", "rpc": rpc, "ids": list(fids)}):
+            return []
+        deadline = time.monotonic() + timeout
+        with self._rpc_cv:
+            while rpc not in self._rpc_out:
+                left = deadline - time.monotonic()
+                if left <= 0 or not self.alive:
+                    return self._rpc_out.pop(rpc, None) or []
+                self._rpc_cv.wait(left)
+            return self._rpc_out.pop(rpc)
+
+    def import_lane(self, fid: str, doc: dict) -> None:
+        """Hand a serialized LaneRecord to the child under fleet id
+        ``fid`` (a dead pipe surfaces through _worker_lost, which
+        re-admits the request like any other orphan)."""
+        self._emit({"op": "import", "id": fid, "record": doc})
 
     # -- teardown ----------------------------------------------------------
 
@@ -465,6 +500,53 @@ class _ThreadWorker(_WorkerBase):
                 self._handles.pop(fid, None)
             self.fleet._resolve(self, fid, error=handle.error)
 
+    def export_lanes_rpc(self, fids,
+                         timeout: float = _STATS_RPC_TIMEOUT_S) -> list:
+        """In-process export (round 23 migration): same contract as the
+        process worker's RPC — ``(fid, record-doc)`` pairs."""
+        if self.inner is None:
+            return []
+        with self._ids_cv:
+            inner = {self._handles[fid].id: fid
+                     for fid in fids if fid in self._handles}
+        try:
+            recs = self.inner.export_lanes(list(inner), timeout=timeout)
+        except Exception:  # noqa: BLE001 — an export failure just means
+            recs = []      # the work stays put
+        out = []
+        with self._ids_cv:
+            for rec in recs:
+                fid = inner.get(rec.token.id)
+                if fid is None:
+                    continue
+                self._ids.pop(rec.token.id, None)
+                self._handles.pop(fid, None)
+                # complete the dangling inner handle so _watch resolves
+                # (the fleet treats its stale fail as already re-homed)
+                rec.token.error = "migrated"
+                rec.token.done.set()
+                out.append((fid, rec.to_doc()))
+            self._ids_cv.notify_all()
+        return out
+
+    def import_lane(self, fid: str, doc: dict) -> None:
+        if self.inner is None:
+            return
+        try:
+            handle = self.inner.import_lanes([doc])[0]
+        except Exception as e:  # noqa: BLE001 — surface as a request fail
+            threading.Thread(target=self.fleet._resolve,
+                             args=(self, fid),
+                             kwargs={"error": f"import error: {e}"},
+                             daemon=True).start()
+            return
+        with self._ids_cv:
+            self._ids[handle.id] = fid
+            self._handles[fid] = handle
+            self._ids_cv.notify_all()
+        threading.Thread(target=self._watch, args=(fid, handle),
+                         daemon=True).start()
+
     def live_stats(self) -> Optional[dict]:
         if self.inner is None:
             return self.final_stats
@@ -507,7 +589,7 @@ class FleetServer:
                  tenant_inflight_cap: Optional[int] = None,
                  aging_s: float = 5.0,
                  max_respawns: int = 0,
-                 wal_dir=None):
+                 wal_dir=None, migrate: bool = False):
         if workers < 1:
             raise ValueError(f"workers={workers} out of range (>= 1)")
         if mode not in ("process", "thread"):
@@ -560,6 +642,15 @@ class FleetServer:
         self._failed = 0
         self._cancelled_n = 0
         self._steals = 0
+        # round 23: lane-level migration — an idle worker with no whole
+        # rotation to steal imports *serialized lanes* from the busiest
+        # peer's in-flight rotation (backends/lanestate.py), breaking the
+        # indivisible-chain Amdahl cap whole-rotation stealing hits on a
+        # fat-tailed backlog (docs/SERVING.md §Preemption & migration)
+        self._migrate = bool(migrate)
+        self._migrations = 0
+        self._lanes_migrated = 0
+        self._migrating: set = set()    # worker idx with a move in flight
         self._readmitted = 0
         self._lost_workers = 0
         self._retired_n = 0
@@ -803,6 +894,17 @@ class FleetServer:
             w.inflight[req.id] = req
             self._mark_served_locked([req])
             w.send(req)
+            if self._migrate:
+                # a join fattens w's in-flight rotation: give fully idle
+                # peers a pump pass now — with nothing stealable they may
+                # slice lanes off it (round 23), instead of idling until
+                # the next reply happens to pump them
+                for o in self._workers:
+                    if (o.alive and not o.retiring and o is not w
+                            and not o.inflight
+                            and o.current_bucket is None
+                            and not o.pending):
+                        self._pump_locked(o)
         elif w.current_bucket is None and not w.pending:
             self._dispatch_locked(w, req.bucket, [req])
         else:
@@ -875,6 +977,13 @@ class FleetServer:
                  error: Optional[str] = None) -> None:
         """A worker answered (reply or fail) for fleet request ``fid``;
         called from reader / inner-dispatcher threads."""
+        if error == "migrated":
+            # round 23: the victim's handle for an exported request
+            # completes with this named error so its failure watcher never
+            # stalls — but the request itself is mid-migration (the
+            # migration thread re-homes it under the fleet lock), so this
+            # frame must never pop it from the victim's inflight map
+            return
         with self._cv:
             req = w.inflight.pop(fid, None)
             if req is None:
@@ -964,6 +1073,10 @@ class FleetServer:
         victims = [o for o in self._workers
                    if o.alive and o is not w and stealable(o)]
         if not victims:
+            if self._migrate:
+                # no whole rotation to steal anywhere: slice lanes off the
+                # busiest peer's in-flight rotation instead (round 23)
+                self._migrate_locked(w)
             return
 
         def backlog(o):
@@ -985,6 +1098,111 @@ class FleetServer:
         _trace.event("fleet.steal", worker=w.idx, victim=victim.idx,
                      bucket=bucket.label(), requests=len(reqs))
         self._dispatch_locked(w, bucket, reqs)
+
+    # -- lane migration (round 23) -----------------------------------------
+
+    def _migrate_locked(self, thief) -> None:
+        """Plan a lane-level migration onto idle worker ``thief`` (caller
+        holds ``self._cv``): pick the busiest peer with more than one
+        migratable in-flight request (sessions never move — spec §11; a
+        single request is never split off either, its owner would just go
+        idle in turn) and hand roughly half its lane-round weight,
+        heaviest requests first, to a background thread — the export RPC
+        blocks on the victim's next segment boundary and must not hold
+        the routing lock."""
+        if self._stop or thief.idx in self._migrating:
+            return
+
+        def migratable(o):
+            return [r for r in o.inflight.values()
+                    if not r.cancelled and r.session_slots == 1]
+
+        victims = [o for o in self._workers
+                   if o.alive and not o.retiring and o is not thief
+                   and o.idx not in self._migrating
+                   and len(migratable(o)) > 1]
+        if not victims:
+            return
+        victim = max(victims, key=lambda o: (o.load(), -o.idx))
+        cand = sorted(migratable(victim),
+                      key=lambda r: (-(r.cfg.round_cap * r.cfg.instances),
+                                     r.id))
+        target = sum(r.cfg.round_cap * r.cfg.instances for r in cand) // 2
+        take, weight = [], 0
+        for r in cand[:-1]:    # always leave the victim at least one
+            if weight >= target:
+                break
+            take.append(r)
+            weight += r.cfg.round_cap * r.cfg.instances
+        if not take:
+            return
+        self._migrating.add(thief.idx)
+        self._migrating.add(victim.idx)
+        threading.Thread(
+            target=self._migrate_async,
+            args=(thief, victim, [r.id for r in take]),
+            name=f"fleet-migrate-w{victim.idx}-w{thief.idx}",
+            daemon=True).start()
+
+    def _migrate_async(self, thief, victim, fids) -> None:
+        """Execute a planned migration: export the named requests' lane
+        state from the victim (blocking RPC, outside the fleet lock),
+        re-home each exported request to the thief under its fleet id,
+        then ship the records over as ``import`` ops. A request that
+        retired, cancelled, or got orphaned while the export was in
+        flight resolves through its ordinary path and is skipped here."""
+        try:
+            pairs = victim.export_lanes_rpc(fids)
+        except Exception:  # noqa: BLE001 — a failed export leaves the
+            pairs = []     # work on the victim; nothing is lost
+        moved, lanes = [], 0
+        with self._cv:
+            self._migrating.discard(thief.idx)
+            self._migrating.discard(victim.idx)
+            for fid, doc in pairs:
+                req = victim.inflight.pop(fid, None)
+                if req is None:
+                    continue   # resolved while the export was in flight
+                if req.cancelled:
+                    # a forwarded cancel raced the export: the victim can
+                    # no longer answer it, so complete it here (the same
+                    # closure _worker_lost applies to cancelled orphans)
+                    req.error = "cancelled"
+                    self._cancelled_n += 1
+                    self._release_locked(req)
+                    if self._wal is not None:
+                        self._wal.append_done(req.id, failed=True)
+                    req.done.set()
+                    continue
+                if not thief.alive or thief.retiring:
+                    self._route_locked(req)   # re-admit like any orphan
+                    continue
+                thief.inflight[fid] = req
+                moved.append((fid, doc, req))
+                try:
+                    lanes += int(doc["lanes"]["pos"]["shape"][0])
+                except (KeyError, TypeError, IndexError):
+                    pass
+            if moved:
+                if thief.current_bucket is None:
+                    thief.current_bucket = moved[0][2].bucket
+                self._where[moved[0][2].bucket] = thief
+                thief.steals += 1
+                self._migrations += 1
+                self._lanes_migrated += lanes
+                _metrics.counter(
+                    "brc_lane_migrated_total",
+                    "Lanes moved between workers as serialized records"
+                ).inc(lanes)
+                _trace.event("fleet.migrate", thief=thief.idx,
+                             victim=victim.idx, requests=len(moved),
+                             lanes=lanes)
+            if not victim.inflight:
+                victim.current_bucket = None
+                self._pump_locked(victim)
+            self._cv.notify_all()
+        for fid, doc, _req in moved:
+            thief.import_lane(fid, doc)
 
     # -- failure path ------------------------------------------------------
 
@@ -1311,6 +1529,8 @@ class FleetServer:
                 "cancelled": self._cancelled_n,
                 "recovering": self._recovering,
                 "steals": self._steals,
+                "migrations": self._migrations,
+                "lanes_migrated": self._lanes_migrated,
                 "readmitted": self._readmitted,
                 "lost_workers": self._lost_workers,
                 "retired_workers": self._retired_n,

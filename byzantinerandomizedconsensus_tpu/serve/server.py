@@ -52,8 +52,11 @@ import threading
 import time
 from typing import Optional
 
+import numpy as np
+
 from byzantinerandomizedconsensus_tpu.backends import batch as _batch
 from byzantinerandomizedconsensus_tpu.backends import compaction as _compaction
+from byzantinerandomizedconsensus_tpu.backends import lanestate as _lanestate
 from byzantinerandomizedconsensus_tpu.obs import metrics as _metrics
 from byzantinerandomizedconsensus_tpu.obs import record as _record
 from byzantinerandomizedconsensus_tpu.obs import trace as _trace
@@ -136,7 +139,7 @@ class ConsensusServer:
                  rotation_queue_depth: Optional[int] = None,
                  tenant_inflight_cap: Optional[int] = None,
                  aging_s: float = 5.0,
-                 wal_dir=None):
+                 wal_dir=None, preempt: bool = False):
         from byzantinerandomizedconsensus_tpu.backends.base import get_backend
 
         self._backend = get_backend(backend)
@@ -171,8 +174,24 @@ class ConsensusServer:
         self._cv = threading.Condition()
         # bucket -> [ServeRequest] queued while another bucket holds the grid
         self._pending: dict = {}
-        # (bucket, WorkFeed, [ServeRequest]) while a grid is resident
+        # (bucket, WorkFeed, [ServeRequest], LaneControl) while a grid is
+        # resident — the control is the round-23 snapshot mailbox (None on
+        # direct-dispatch kernels, which lane compaction cannot host)
         self._active = None
+        # round 23: preemptive scheduling — True lets a deadline-urgent
+        # arrival park the active rotation's fat-tail lanes to host
+        # (LaneRecords) and resume them after; replies stay bit-identical
+        # because restore is (docs/SERVING.md §Preemption & migration)
+        self._preempt = bool(preempt)
+        # bucket -> ([LaneRecord], [ServeRequest]) rotations parked by a
+        # preemption (or lanes imported by a fleet migration) awaiting
+        # resume; the dispatcher treats these like pending buckets and
+        # re-dispatches them with imports= so lanes continue mid-round
+        self._parked: dict = {}
+        self._preempt_parks = 0
+        self._preempt_resumes = 0
+        self._lanes_exported = 0
+        self._lanes_imported = 0
         self._stop = False
         self._drain_on_stop = True
         self._counter = 0
@@ -230,6 +249,11 @@ class ConsensusServer:
                     for req in reqs:
                         self._fail(req, "server shutdown before dispatch")
                 self._pending.clear()
+                for _recs, reqs in self._parked.values():
+                    for req in reqs:
+                        if not req.done.is_set():
+                            self._fail(req, "server shutdown before resume")
+                self._parked.clear()
             if self._active is not None:
                 self._active[1].close()
             self._cv.notify_all()
@@ -350,6 +374,21 @@ class ConsensusServer:
                         # rotation: the resident grid stops refilling, drains
                         # its stragglers, and yields to this bucket
                         self._active[1].close()
+                        if self._preempt and self._preempt_worthy_locked(req):
+                            # round 23: don't even wait for the drain — park
+                            # the resident lanes to host at the next segment
+                            # boundary; they resume mid-round after the
+                            # urgent bucket replies (bit-identical restore)
+                            self._preempt_parks += 1
+                            _trace.event("serve.preempt", id=req.id,
+                                         parked=self._active[0].label(),
+                                         urgent=bucket.label())
+                            if _metrics.enabled():
+                                _metrics.counter(
+                                    "brc_preempt_parked_total",
+                                    "Rotations parked to host for a "
+                                    "deadline-urgent arrival").inc()
+                            self._active[3].park(self._active[1])
             except _admission.Backpressure:
                 # the journaled admit was refused after all — close it so
                 # recovery never replays a request the client saw rejected
@@ -457,6 +496,30 @@ class ConsensusServer:
 
     # -- dispatcher --------------------------------------------------------
 
+    def _preempt_worthy_locked(self, req: ServeRequest) -> bool:
+        """True when ``req`` justifies parking the active rotation (round
+        23): it carries an explicit deadline, it is EDF-more-urgent than
+        everything the active grid still owes, the grid can actually take a
+        snapshot (lane-compaction kernel, a live control), and no spec-§11
+        session rides the rotation (sessions are never extractable — they
+        chain at the grid's retire seam). Caller holds ``self._cv``."""
+        if req.t_deadline is None:
+            return False
+        if self._active is None or self._active[3] is None:
+            return False
+        live = [r for r in self._active[2] if not r.done.is_set()]
+        if not live:
+            return False
+        if any(r.session_slots > 1 for r in live) \
+                or self._active[1].sessions() > 0:
+            return False
+        urgency_active = min(
+            (r.t_deadline if r.t_deadline is not None
+             else r.t_submit + self._aging_s)
+            - r.priority * self._aging_s for r in live)
+        return (req.t_deadline - req.priority * self._aging_s
+                < urgency_active)
+
     def _next_bucket_locked(self):
         """Pick the bucket for the next grid rotation (round 18).
 
@@ -475,7 +538,18 @@ class ConsensusServer:
 
         Ordering here only chooses *which* grid runs next; same-bucket
         joins stay arrival-timing-free, so program cache keys — and the
-        zero-recompile pin — are untouched. Caller holds ``self._cv``."""
+        zero-recompile pin — are untouched. Round 23: parked rotations
+        (preempted lanes awaiting resume, migrated lanes awaiting import)
+        compete under the same key, so a parked fat tail cannot be starved
+        by a stream of fresh arrivals beyond its EDF/aging due.
+        Caller holds ``self._cv``."""
+        candidates: dict = {}
+        for bucket, reqs in self._pending.items():
+            candidates.setdefault(bucket, []).extend(reqs)
+        for bucket, (_recs, reqs) in self._parked.items():
+            candidates.setdefault(bucket, []).extend(
+                r for r in reqs if not r.done.is_set())
+
         def key(item):
             bucket, reqs = item
             urgency = min(
@@ -488,17 +562,24 @@ class ConsensusServer:
             t0 = min(r.t_submit for r in reqs)
             return (round(urgency, 1), deficit, t0, bucket.label())
 
-        return min(self._pending.items(), key=key)[0]
+        return min(((b, rs) for b, rs in candidates.items() if rs),
+                   key=key)[0]
 
     def _loop(self) -> None:
         while True:
             with self._cv:
-                while not self._stop and not self._pending:
+                while not self._stop and not self._pending \
+                        and not self._parked:
                     self._cv.wait()
-                if not self._pending:
+                if not self._pending and not self._parked:
                     return  # stopped and drained
                 bucket = self._next_bucket_locked()
-                reqs = self._pending.pop(bucket)
+                reqs = self._pending.pop(bucket, [])
+                imports, resumed = self._parked.pop(bucket, ([], []))
+                resumed = [r for r in resumed if not r.done.is_set()]
+                imports = [rec for rec in imports
+                           if rec.token is None
+                           or not rec.token.done.is_set()]
                 feed = _compaction.WorkFeed(round_cap_ceiling=self._ceiling,
                                             max_depth=self._feed_depth)
                 # seed before the feed is visible to submitters: a rotation
@@ -517,14 +598,32 @@ class ConsensusServer:
                             "brc_serve_tenant_served_weight_total",
                             "Lane-round weight dispatched, by tenant",
                             tenant=req.tenant).inc(w)
+                if imports:
+                    # round 23 resume: parked/migrated LaneRecords ride the
+                    # run_bucket imports= seam — their lanes continue
+                    # mid-round, so no re-run, no re-seeding, no new
+                    # program key (snapshot arrays are data operands)
+                    self._preempt_resumes += 1
+                    self._lanes_imported += sum(r.lane_count()
+                                                for r in imports)
+                    _trace.event("serve.resume", bucket=bucket.label(),
+                                 records=len(imports),
+                                 lanes=sum(r.lane_count() for r in imports))
+                    if _metrics.enabled():
+                        _metrics.counter(
+                            "brc_preempt_resumed_total",
+                            "Parked rotations resumed mid-round").inc()
                 _trace.event("serve.rotate", bucket=bucket.label(),
-                             seeded=len(reqs),
+                             seeded=len(reqs), resumed=len(imports),
                              pending_buckets=len(self._pending))
-                run_reqs = list(reqs)
-                self._active = (bucket, feed, run_reqs)
+                run_reqs = list(reqs) + resumed
+                control = (_lanestate.LaneControl()
+                           if getattr(self._backend, "kernel", "xla")
+                           == "xla" else None)
+                self._active = (bucket, feed, run_reqs, control)
                 # keep the feed open only when this bucket is the sole
                 # claimant and the server is live — otherwise seed-and-drain
-                if self._stop or self._pending:
+                if self._stop or self._pending or self._parked:
                     feed.close()
             try:
                 with _trace.span("serve.dispatch", bucket=bucket.label(),
@@ -543,7 +642,8 @@ class ConsensusServer:
                         _compaction.run_bucket(
                             self._backend, bucket, [], [], policy=self._policy,
                             feed=feed, on_retire=self._retire,
-                            progress=self._segment_hook)
+                            progress=self._segment_hook,
+                            control=control, imports=imports)
             except Exception as e:  # noqa: BLE001 — a grid failure must
                 # fail its requests, never kill the dispatcher
                 feed.close()
@@ -551,9 +651,158 @@ class ConsensusServer:
                     for req in run_reqs:
                         if not req.done.is_set():
                             self._fail(req, f"dispatch error: {e!r}")
+            finally:
+                if control is not None:
+                    control.detach()
             with self._cv:
                 self._active = None
+                if control is not None and control.parked:
+                    self._park_rotation_locked(bucket, feed, control.parked)
                 self._cv.notify_all()
+
+    def _park_rotation_locked(self, bucket, feed, parked_records) -> None:
+        """Stash a parked rotation's LaneRecords for a later resume
+        (caller holds ``self._cv``; the grid has already exited). Records
+        whose request finished or cancelled in the meantime are dropped;
+        feed items that raced in after the park boundary re-queue as
+        ordinary pending requests (their lanes never existed, so fresh
+        dispatch is bit-identical)."""
+        recs = [r for r in parked_records
+                if r.token is not None and not r.token.done.is_set()]
+        feed.close()
+        items = feed.pull()
+        for _cfg, _ids, token, _session in (items or []):
+            if token is not None and not token.done.is_set():
+                self._pending.setdefault(bucket, []).append(token)
+        if not recs:
+            return
+        self._lanes_exported += sum(r.lane_count() for r in recs)
+        old_recs, old_reqs = self._parked.get(bucket, ([], []))
+        self._parked[bucket] = (old_recs + recs,
+                                old_reqs + [r.token for r in recs])
+        _trace.event("serve.park", bucket=bucket.label(),
+                     records=len(recs),
+                     lanes=sum(r.lane_count() for r in recs))
+
+    # -- lane export/import (round 23 migration seam) ----------------------
+
+    def _trivial_record(self, req: ServeRequest) -> "_lanestate.LaneRecord":
+        """A pending-only LaneRecord for a request that never reached a
+        grid: every lane is a pure function of ``(key, iid)``, so
+        exporting a queued request is just shipping its config."""
+        ids = np.asarray(
+            self._backend._resolve_inst_ids(req.cfg, None), dtype=np.uint32)
+        k = int(ids.shape[0])
+        return _lanestate.LaneRecord(
+            version=_lanestate.LANESTATE_VERSION,
+            cfg=req.cfg,
+            ids=ids,
+            rounds=np.zeros(k, dtype=np.int32),
+            decision=np.zeros(k, dtype=np.uint8),
+            remaining=k,
+            pending=[(p, int(i)) for p, i in enumerate(ids)],
+            lanes={"pos": np.empty(0, dtype=np.int64),
+                   "r": np.empty(0, dtype=np.int32),
+                   "st": {}, "setup": []},
+            token=req)
+
+    def export_lanes(self, rids, timeout: float = 30.0) -> list:
+        """Extract the named unfinished requests as serialized
+        :class:`~byzantinerandomizedconsensus_tpu.backends.lanestate
+        .LaneRecord` objects — the fleet migration seam (round 23;
+        serve/worker.py ``export`` op). A request still queued for a
+        rotation serializes trivially; one parked by a preemption hands
+        its stored record over; one holding live lanes is exported by the
+        grid at its next segment boundary (``LaneControl.extract``) while
+        the rotation keeps flying. Exported requests leave this server's
+        books entirely — the importer owns their replies. Sessions,
+        finished requests, and unknown ids are skipped (a request that
+        retires while the extract is in flight simply replies here and is
+        absent from the result)."""
+        out, live = [], []
+        with self._cv:
+            active = self._active
+            for rid in rids:
+                req = self._byid.get(rid)
+                if req is None or req.done.is_set() \
+                        or req.session_slots > 1:
+                    continue
+                reqs = self._pending.get(req.bucket)
+                if reqs is not None and req in reqs:
+                    reqs.remove(req)
+                    if not reqs:
+                        del self._pending[req.bucket]
+                    out.append(self._trivial_record(req))
+                    self._release_locked(req)
+                    continue
+                parked = self._parked.get(req.bucket)
+                if parked is not None:
+                    recs, preqs = parked
+                    rec = next((r for r in recs if r.token is req), None)
+                    if rec is not None:
+                        recs.remove(rec)
+                        preqs.remove(req)
+                        if not recs and not preqs:
+                            del self._parked[req.bucket]
+                        out.append(rec)
+                        self._release_locked(req)
+                        continue
+                if active is not None and active[0] == req.bucket \
+                        and active[3] is not None:
+                    live.append(req)
+            self._cv.notify_all()
+        if live:
+            recs = active[3].extract(live, feed=active[1], timeout=timeout)
+            with self._cv:
+                for rec in recs:
+                    self._release_locked(rec.token)
+                    if self._active is active and rec.token in active[2]:
+                        active[2].remove(rec.token)
+                self._cv.notify_all()
+            out.extend(recs)
+        self._lanes_exported += sum(r.lane_count() for r in out)
+        if out:
+            _trace.event("serve.export", records=len(out),
+                         lanes=sum(r.lane_count() for r in out))
+        return out
+
+    def import_lanes(self, docs,
+                     tenant: str = _admission.DEFAULT_TENANT) -> list:
+        """Admit serialized LaneRecord documents (round 23 migration
+        import — serve/worker.py ``import`` op; raw LaneRecords also
+        accepted). Each record becomes a fresh parked request; the
+        dispatcher resumes it through ``run_bucket``'s ``imports=`` seam,
+        so mid-round lanes continue bit-identically. Returns the
+        :class:`ServeRequest` handles (replies stream as usual)."""
+        recs = [rec if isinstance(rec, _lanestate.LaneRecord)
+                else _lanestate.LaneRecord.from_doc(rec) for rec in docs]
+        handles = []
+        with self._cv:
+            if self._stop:
+                raise RuntimeError("server is shutting down")
+            for rec in recs:
+                self._counter += 1
+                rid = f"r{self._counter:06d}"
+                bucket = _admission.bucket_of(rec.cfg)
+                req = ServeRequest(rid, rec.cfg, bucket, tenant=tenant)
+                rec.token = req
+                old_recs, old_reqs = self._parked.get(bucket, ([], []))
+                self._parked[bucket] = (old_recs + [rec],
+                                        old_reqs + [req])
+                self._submitted += 1
+                self._byid[req.id] = req
+                self._tenant_inflight[tenant] = \
+                    self._tenant_inflight.get(tenant, 0) + 1
+                handles.append(req)
+                _trace.event("serve.import", id=rid, bucket=bucket.label(),
+                             lanes=rec.lane_count(),
+                             pending=len(rec.pending))
+            if handles and self._active is not None:
+                # force a rotation so the imported lanes dispatch promptly
+                # (the EDF key decides whether they actually go first)
+                self._active[1].close()
+            self._cv.notify_all()
+        return handles
 
     def _dispatch_direct(self, feed) -> None:
         """Drain ``feed`` one config at a time through ``backend.run`` —
@@ -810,6 +1059,18 @@ class ConsensusServer:
                 "recovering": self._recovering,
                 "active_bucket": active,
                 "pending": pending,
+                # round-23 preemption plane: parked rotations awaiting
+                # resume, and the lane snapshot/restore odometers
+                "parked": {
+                    b.label(): sum(1 for r in reqs if not r.done.is_set())
+                    for b, (_recs, reqs) in self._parked.items()},
+                "preempt": {
+                    "enabled": self._preempt,
+                    "parks": self._preempt_parks,
+                    "resumes": self._preempt_resumes,
+                    "lanes_exported": self._lanes_exported,
+                    "lanes_imported": self._lanes_imported,
+                },
                 # round-18 traffic plane: per-tenant outstanding requests
                 # (zero entries kept for ever-seen tenants so the gauge
                 # falls back to 0) and the configured bounds (all None =
@@ -1085,6 +1346,17 @@ def main(argv=None) -> int:
     ap.add_argument("--min-workers", type=int, default=0,
                     help="autoscaler floor (used with --max-workers; "
                          "defaults to --workers)")
+    ap.add_argument("--preempt", action="store_true",
+                    help="preemptive scheduling (round 23): a deadline-"
+                         "urgent arrival parks the active rotation's lanes "
+                         "to host (bit-identical snapshot/restore, "
+                         "backends/lanestate.py) and resumes them after; "
+                         "docs/SERVING.md §Preemption & migration")
+    ap.add_argument("--migrate", action="store_true",
+                    help="lane-level work stealing for the fleet (round "
+                         "23): an idle worker imports serialized lanes "
+                         "from the busiest worker instead of waiting for "
+                         "a whole stealable rotation")
     ap.add_argument("--max-workers", type=int, default=0,
                     help=">0 enables the metrics-driven autoscaler "
                          "(serve/autoscale.py): scale the fleet between "
@@ -1116,7 +1388,8 @@ def main(argv=None) -> int:
                                     args.rotation_queue_depth or None),
                                 tenant_inflight_cap=args.tenant_cap or None,
                                 max_respawns=args.max_respawns,
-                                wal_dir=wal_dir)
+                                wal_dir=wal_dir,
+                                migrate=args.migrate)
     else:
         server_cm = ConsensusServer(backend=args.backend, policy=policy,
                                     round_cap_ceiling=args.round_cap_ceiling,
@@ -1125,7 +1398,8 @@ def main(argv=None) -> int:
                                         args.rotation_queue_depth or None),
                                     tenant_inflight_cap=args.tenant_cap
                                     or None,
-                                    wal_dir=wal_dir)
+                                    wal_dir=wal_dir,
+                                    preempt=args.preempt)
     with server_cm as srv:
         httpd = serve_http(srv, host=args.host, port=args.port)
         scaler = None

@@ -16,6 +16,12 @@ parent → worker
     ``{"op": "submit", "id": fid, "cfg": {...SimConfig fields...}}``
     ``{"op": "cancel", "id": fid}``   (round 18: inner server.cancel —
     the answer comes back as a ``fail`` frame with error "cancelled")
+    ``{"op": "export", "rpc": k, "ids": [fid, ...]}``  (round 23: serialize
+    the named requests' lane state — ``server.export_lanes`` — so the
+    fleet can migrate them mid-round; unknown/finished ids are skipped)
+    ``{"op": "import", "id": fid, "record": {...}}``   (round 23: restore
+    a serialized LaneRecord under fleet id ``fid`` —
+    ``server.import_lanes``; the reply streams back as usual)
     ``{"op": "stats", "rpc": k}``
     ``{"op": "shutdown"}``
 
@@ -23,6 +29,7 @@ worker → parent
     ``{"op": "ready", "pid": p, "worker": i}``   (backend is live)
     ``{"op": "reply", "id": fid, "record": {...}}``  (streamed at retire)
     ``{"op": "fail", "id": fid, "error": "..."}``
+    ``{"op": "export", "rpc": k, "lanes": [{"id": fid, "record": ...}]}``
     ``{"op": "stats", "rpc": k, "stats": {...}}``
     ``{"op": "bye", "stats": {...}}``            (drained; about to exit)
 
@@ -198,6 +205,49 @@ def main(argv=None) -> int:
                     # cancel sets error="cancelled" + done; the watcher
                     # thread then emits the fail frame the parent expects
                     server.cancel(handle.id)
+            elif op == "export":
+                # round 23 migration: serialize the named requests' lane
+                # state at the grid's next segment boundary. A request
+                # that retires while the extract is in flight is simply
+                # absent from the reply (its own reply frame answers it).
+                fids = msg.get("ids") or []
+                with ids_cv:
+                    inner = {handles[fid].id: fid
+                             for fid in fids if fid in handles}
+                try:
+                    recs = server.export_lanes(list(inner))
+                except Exception:  # noqa: BLE001 — report empty, don't die
+                    recs = []
+                lanes = []
+                with ids_cv:
+                    for rec in recs:
+                        fid = inner.get(rec.token.id)
+                        if fid is None:
+                            continue
+                        ids.pop(rec.token.id, None)
+                        handles.pop(fid, None)
+                        # complete the dangling handle so the (serial)
+                        # failure watcher never stalls on it; the parent
+                        # treats the resulting fail frame as stale
+                        rec.token.error = "migrated"
+                        rec.token.done.set()
+                        lanes.append({"id": fid, "record": rec.to_doc()})
+                    ids_cv.notify_all()
+                emit({"op": "export", "rpc": msg.get("rpc"),
+                      "lanes": lanes})
+            elif op == "import":
+                fid = msg.get("id")
+                try:
+                    handle = server.import_lanes([msg.get("record")])[0]
+                except Exception as e:  # noqa: BLE001 — report, don't die
+                    emit({"op": "fail", "id": fid,
+                          "error": f"import error: {e}"})
+                    continue
+                with ids_cv:
+                    ids[handle.id] = fid
+                    handles[fid] = handle
+                    ids_cv.notify_all()
+                watch.put((fid, handle))
             elif op == "stats":
                 emit({"op": "stats", "rpc": msg.get("rpc"),
                       "stats": worker_stats()})

@@ -38,9 +38,27 @@ identical (homogeneous strata are law-independent), and the ``--presets``
 rows quantify the §4c-vs-§4b-v2 deviation at the five benchmark shapes for
 the ship-or-bury decision (docs/PERF.md round 6).
 
+Round 23 adds the committee-vs-full-mesh statistical leg (``--committee``,
+spec §10 — ROADMAP #2 leg (c)): the §10 sortition family is a *different
+protocol* over sampled quorums, not another exact sampler, so the leg keys
+on two distribution-level quantities per row. (1) the rounds-to-decision
+TV distance against the same shape under the §4b-v2 full mesh — the cost
+of trading O(n·f) for O(C·polylog n) must show up as a bounded liveness
+shift, not a safety change; (2) the **measured f_C tail**: over every
+sampled committee (instance × round × phase, via
+``ops/committee.membership_plane`` — the actual §10.1 sortition, on the
+actual §3.2 faulty sets), the fraction whose faulty-member count exceeds
+the §10.3 budget f_C = ⌈C·f/n⌉ + ⌊√C⌋, next to its Chernoff bound
+exp(a − μ − a·ln(a/μ)) for a = f_C + 1, μ = C·f/n. The bound must
+dominate the measurement on every row (committees are Bernoulli(C/n)
+samples of the faulty set, so the classical bound applies verbatim) —
+that is the sortition-margin soundness evidence the §10.3 resilience
+gates in config.validate() lean on.
+
 CLI: ``python -m byzantinerandomizedconsensus_tpu.tools.divergence``
 (``--full`` adds the large-n config-5-family rows on an accelerated backend;
-``--presets`` adds the five-preset §4c deviation rows).
+``--presets`` adds the five-preset §4c deviation rows; ``--committee`` adds
+the §10 committee-vs-full-mesh rows).
 """
 
 from __future__ import annotations
@@ -48,6 +66,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import math
 import pathlib
 
 from byzantinerandomizedconsensus_tpu.config import SimConfig
@@ -295,11 +314,132 @@ def fault_rows_summary(rows: list) -> dict:
     }
 
 
+# Committee-vs-full-mesh leg (spec §10, round 23). Shapes where C(n) < n so
+# sortition is non-degenerate; the first two carry f_C ≥ f (the sampling
+# margin swallows the whole faulty set — tail exactly 0), the larger-f rows
+# have a genuinely non-trivial tail for the Chernoff comparison.
+COMMITTEE_GRID: tuple[SimConfig, ...] = (
+    SimConfig(protocol="bracha", n=64, f=12, adversary="adaptive",
+              coin="shared", seed=7, round_cap=96, delivery="committee"),
+    SimConfig(protocol="benor", n=64, f=6, adversary="crash", coin="local",
+              seed=9, round_cap=96, delivery="committee"),
+    SimConfig(protocol="bracha", n=128, f=25, adversary="adaptive",
+              coin="local", seed=8, round_cap=96, delivery="committee"),
+    SimConfig(protocol="bracha", n=256, f=48, adversary="adaptive",
+              coin="shared", seed=10, round_cap=96, delivery="committee"),
+)
+
+#: full-mesh reference law for the committee TV rows: §4b-v2, the count-level
+#: sampler the committee family replaces (keys at these n is the O(n²) path)
+COMMITTEE_MESH_REFERENCE = "urn2"
+
+
+def fc_tail_row(cfg: SimConfig, rounds_sampled: int = 16) -> dict:
+    """Measured §10.3 sortition-margin tail vs its Chernoff bound.
+
+    Every committee of ``rounds_sampled`` rounds × all phases × all
+    instances is materialized through the real §10.1 sortition
+    (``membership_plane``) and intersected with the real §3.2 faulty sets
+    (``faulty_mask``); the tail is the fraction whose faulty-member count
+    exceeds f_C. The bound is the classical multiplicative Chernoff tail
+    for Binomial(f, C/n) at a = f_C + 1 — membership of each faulty
+    replica is an independent Bernoulli(C/n) draw (distinct PRF purposes),
+    so it bounds the true tail; the measurement must sit under it."""
+    import numpy as np
+
+    from byzantinerandomizedconsensus_tpu.models.adversaries import faulty_mask
+    from byzantinerandomizedconsensus_tpu.ops import committee as _committee
+
+    c = _committee.committee_size(cfg.n)
+    fc = _committee.committee_fault_budget(cfg.n, cfg.f)
+    inst = np.arange(cfg.instances, dtype=np.uint32)
+    faulty = faulty_mask(cfg, cfg.seed, inst, xp=np)  # (B, n) bool
+    phases = 3 if cfg.protocol == "bracha" else 2
+    sampled = exceed = 0
+    member_sum = 0
+    for rnd in range(rounds_sampled):
+        for t in range(phases):
+            member = _committee.membership_plane(
+                cfg, cfg.seed, inst, rnd, t, xp=np)  # (B, n) bool
+            bad = (member & faulty).sum(axis=1)
+            exceed += int((bad > fc).sum())
+            sampled += int(bad.shape[0])
+            member_sum += int(member.sum())
+    mu = c * cfg.f / cfg.n
+    a = fc + 1
+    chernoff = 1.0 if a <= mu else math.exp(a - mu - a * math.log(a / mu))
+    measured = exceed / max(1, sampled)
+    return {
+        "committee_c": int(c), "committee_f_budget": int(fc),
+        "committees_sampled": sampled, "fc_exceed_count": exceed,
+        "fc_tail_measured": measured,
+        "fc_tail_chernoff": chernoff,
+        "fc_bound_holds": bool(measured <= chernoff),
+        "fc_tail_trivial": bool(fc >= cfg.f),
+        "mean_committee_size_measured": member_sum / max(1, sampled),
+        "rounds_sampled": rounds_sampled, "phases": phases,
+    }
+
+
+def committee_row(cfg: SimConfig, instances: int, backend: str) -> dict:
+    """One §10 row: the shape under the committee law vs the same shape
+    under the full-mesh reference (rounds-histogram TV + outcome stats),
+    plus the measured-vs-Chernoff f_C tail. Per-instance disagreement is
+    reported but *expected* — the committee family is a different protocol
+    over sampled quorums, so only distribution-level agreement is a claim."""
+    cfg = dataclasses.replace(cfg, instances=instances).validate()
+    mesh = dataclasses.replace(
+        cfg, delivery=COMMITTEE_MESH_REFERENCE).validate()
+    rc = Simulator(cfg, backend).run()
+    rm = Simulator(mesh, backend).run()
+    row = {
+        "protocol": cfg.protocol, "n": cfg.n, "f": cfg.f,
+        "adversary": cfg.adversary, "coin": cfg.coin, "seed": cfg.seed,
+        "round_cap": cfg.round_cap, "instances": instances,
+        "backend": backend, "mesh_reference": COMMITTEE_MESH_REFERENCE,
+        "rounds_hist_tv_mesh_committee": rounds_hist_tv(rm.rounds, rc.rounds),
+        "frac_rounds_differ_mesh_committee": float(
+            (rm.rounds != rc.rounds).mean()),
+        "mean_rounds_committee": float(rc.rounds.mean()),
+        "mean_rounds_mesh": float(rm.rounds.mean()),
+        "p1_committee": float((rc.decision == 1).mean()),
+        "p1_mesh": float((rm.decision == 1).mean()),
+        "capped_committee": float((rc.decision == 2).mean()),
+        "capped_mesh": float((rm.decision == 2).mean()),
+    }
+    row.update(fc_tail_row(cfg))
+    return row
+
+
+def run_committee_rows(instances: int = 400, backend: str = "numpy",
+                       progress=print) -> list:
+    rows = []
+    for cfg in COMMITTEE_GRID:
+        rows.append(committee_row(cfg, instances, backend))
+        progress(json.dumps(rows[-1]))
+    return rows
+
+
+def committee_rows_summary(rows: list) -> dict:
+    nontrivial = [r for r in rows if not r["fc_tail_trivial"]]
+    return {
+        "committee_rows": len(rows),
+        "committee_max_rounds_hist_tv": max(
+            r["rounds_hist_tv_mesh_committee"] for r in rows),
+        "committee_max_capped": max(r["capped_committee"] for r in rows),
+        "committee_fc_bound_holds_all": all(r["fc_bound_holds"] for r in rows),
+        "committee_max_fc_tail_measured": max(
+            r["fc_tail_measured"] for r in rows),
+        "committee_nontrivial_tail_rows": len(nontrivial),
+    }
+
+
 def run_divergence(instances: int = 400, backend: str = "numpy",
                    full: bool = False, full_backend: str = "jax",
                    full_instances: int = 2000, presets: bool = False,
                    preset_instances: int = 2000, preset_backend: str = "native",
                    faults: bool = False, fault_instances: int = 400,
+                   committee: bool = False, committee_instances: int = 400,
                    batched: bool = False, progress=print) -> dict:
     rows = []
     batch_report = None
@@ -370,6 +510,11 @@ def run_divergence(instances: int = 400, backend: str = "numpy",
                                batched=batched, progress=progress)
         out["fault_rows"] = frows
         summary.update(fault_rows_summary(frows))
+    if committee:
+        crows = run_committee_rows(instances=committee_instances,
+                                   backend=backend, progress=progress)
+        out["committee_rows"] = crows
+        summary.update(committee_rows_summary(crows))
     return out
 
 
@@ -394,6 +539,11 @@ def main(argv=None) -> int:
                     help="add the spec-§9 fault-schedule liveness rows "
                          "(rounds-histogram TV vs the fault-free baseline)")
     ap.add_argument("--fault-instances", type=int, default=400)
+    ap.add_argument("--committee", action="store_true",
+                    help="add the spec-§10 committee-vs-full-mesh rows "
+                         "(rounds-histogram TV vs the §4b-v2 reference + "
+                         "measured f_C tail vs its Chernoff bound)")
+    ap.add_argument("--committee-instances", type=int, default=400)
     ap.add_argument("--batched", action="store_true",
                     help="run the grid through the shape-bucketed lane "
                          "runner (backends/batch.py) when the backend "
@@ -414,6 +564,8 @@ def main(argv=None) -> int:
                             preset_backend=args.preset_backend,
                             faults=args.faults,
                             fault_instances=args.fault_instances,
+                            committee=args.committee,
+                            committee_instances=args.committee_instances,
                             batched=args.batched)
     out = pathlib.Path(args.out)
     out.parent.mkdir(parents=True, exist_ok=True)

@@ -68,6 +68,32 @@ measurement, not claims:
     scale-down must retire — not kill — workers (health stays ok,
     0 lost), and surviving-worker steady-state compiles stay 0.
 
+Round 23 adds the **preempt** drills (:data:`PREEMPT_SCENARIOS`,
+``--scenario preempt_storm`` / ``preempt``) — the serializable-lane-state
+suite writing the schema-v1.14 ``lanestate`` + ``preempt`` blocks
+(``artifacts/preempt_r23.json``):
+
+``preempt_storm``
+    A fat-tail rotation (adaptive adversary at full fault budget, split
+    init — the slowest admitted work) holds the grid when
+    deadline-urgent small requests arrive. With ``--preempt`` scheduling
+    the server parks the fat lanes to host (serializable LaneRecords,
+    backends/lanestate.py), runs the urgent bucket, and resumes the fat
+    lanes mid-round; the same traffic through the round-18 FIFO
+    (non-preemptive EDF) server is the baseline. Gates: the preemptive
+    deadline hit rate must beat the FIFO baseline, every reply —
+    parked-and-resumed fat work included — stays bit-identical to the
+    numpy oracle AND to the FIFO leg, and steady-state compiles stay 0
+    (park/restore moves pure data, never a program key).
+
+The preempt suite also runs the **restore bit-identity grid** (every
+``faults`` × adversary × delivery point, the mid-crash-window and
+mid-partition captures included: export at a segment boundary, JSON wire
+round-trip, import into a different server, finish — pinned identical to
+the uninterrupted control), and, unless ``--smoke``, re-runs the r15
+fat-tail fleet sweep (``loadgen --workers 1,2,4 --migrate``) with
+lane-level migration on.
+
 Every scenario's population is a pure function of ``(suite seed,
 scenario index)``; observed counts (rejections, cancel timing splits)
 are measurements, the gates are the claims. The committed artifact::
@@ -117,6 +143,12 @@ SCENARIOS = ("flash_crowd", "heavy_tail", "bucket_churn", "tenant_hog",
 #: overflow gate); they write the schema-v1.13 ``elastic`` record.
 ELASTIC_SCENARIOS = ("dispatcher_kill", "autoscale_crowd")
 
+#: Round-23 preemption/serializable-lane-state drills — again a separate
+#: family (schema-v1.14 ``lanestate`` + ``preempt`` record); ``--scenario
+#: preempt`` runs the storm, the restore bit-identity grid, and (non-smoke)
+#: the ``--migrate`` fleet sweep.
+PREEMPT_SCENARIOS = ("preempt_storm",)
+
 #: Admitted round_cap ceiling for the hostile servers — half the serving
 #: default: the suite's populations are many small requests, and the
 #: ceiling is the drain-segment length every warm-up must pay for.
@@ -132,6 +164,7 @@ _SIZES = {
     "session_hog": (15, 8),  # hog sessions 1/3, interactive 2/3
     "dispatcher_kill": (12, 6),   # last third are 32-slot sessions
     "autoscale_crowd": (36, 18),  # interleaved across 3 fused buckets
+    "preempt_storm": (12, 6),     # 1/3 fat rotations, 2/3 urgent
 }
 
 #: session_hog: chained decision slots per hog session (each hog envelope
@@ -996,6 +1029,311 @@ _ELASTIC_RUNNERS = {
 }
 
 
+# ---------------------------------------------- preempt drills (r23) --
+
+def _fat_cfg(seed: int, *, faults: str = "none",
+             adversary: str = "adaptive", delivery: str = "urn2",
+             instances: int = 32, round_cap: int = 48) -> SimConfig:
+    """The slowest admitted work by construction: split init keeps both
+    value camps alive and the adaptive adversary at the full f=3 budget
+    delays convergence (mean ~35 rounds/lane at n=10, many lanes riding
+    the cap) — these are the lanes a preemption must park mid-round."""
+    return SimConfig(protocol="bracha", n=10, f=3, instances=instances,
+                     adversary=adversary, coin="local", init="split",
+                     seed=seed, round_cap=round_cap, delivery=delivery,
+                     faults=faults).validate()
+
+
+def _restore_grid(args, seed: int) -> dict:
+    """The snapshot/restore bit-identity grid: at every ``faults`` ×
+    adversary × delivery point, capture the live request's lanes at a
+    segment boundary (the recover points mid-crash-window, the partition
+    points mid-partition — the capture lands inside the fault schedule by
+    construction), JSON-round-trip the records, restore them into a
+    DIFFERENT server, and demand the finished reply bit-identical to the
+    uninterrupted control AND the numpy oracle. The PRF addresses every
+    draw by (key, instance, round, step), so where a lane finishes must
+    never matter — this leg is that law, measured."""
+    from byzantinerandomizedconsensus_tpu.backends import (
+        lanestate as _lanestate)
+    from byzantinerandomizedconsensus_tpu.serve.server import ConsensusServer
+
+    pairs = ((("adaptive", "urn2"),) if args.smoke else
+             (("none", "keys"), ("adaptive", "urn2"), ("byzantine", "urn")))
+    points = [(ft, adv, dl)
+              for ft in ("none", "recover", "partition", "omission")
+              for adv, dl in pairs]
+
+    lat = 0.02
+
+    def hook(_msg, _sleep=time.sleep, _lat=lat):
+        _sleep(_lat)
+
+    t0 = time.perf_counter()
+    mism = 0
+    lanes_rt = 0
+    rows = []
+    with ConsensusServer(backend=args.backend, policy=args.policy,
+                         round_cap_ceiling=ROUND_CAP_CEILING) as control, \
+         ConsensusServer(backend=args.backend, policy=args.policy,
+                         round_cap_ceiling=ROUND_CAP_CEILING,
+                         segment_hook=hook) as victim, \
+         ConsensusServer(backend=args.backend, policy=args.policy,
+                         round_cap_ceiling=ROUND_CAP_CEILING) as thief:
+        for idx, (ft, adv, dl) in enumerate(points):
+            cfg = _fat_cfg(seed * 100 + idx, faults=ft, adversary=adv,
+                           delivery=dl, instances=48)
+            base = control.submit(cfg).wait(timeout=900.0)
+            h = victim.submit(cfg)
+            t1 = time.monotonic()
+            while h.t_dispatch is None and time.monotonic() - t1 < 300.0:
+                time.sleep(0.005)
+            # land the capture a few segments in: mid-round, and (for the
+            # recover/partition points) inside the active fault window —
+            # early enough that even the fast-deciding adversary-free
+            # points (mean ~2-3 rounds/lane) are still mid-wave
+            time.sleep(4 * lat)
+            recs = victim.export_lanes([h.id], timeout=300.0)
+            if not recs:
+                mism += 1
+                rows.append({"faults": ft, "adversary": adv,
+                             "delivery": dl, "captured": 0, "ok": False})
+                continue
+            lanes = sum(r.lane_count() for r in recs)
+            lanes_rt += lanes
+            docs = [json.loads(json.dumps(r.to_doc())) for r in recs]
+            rep = thief.import_lanes(docs)[0].wait(timeout=900.0)
+            ok = (rep["rounds"] == base["rounds"]
+                  and rep["decision"] == base["decision"]
+                  and _mismatch_count([(cfg, rep)]) == 0)
+            if not ok:
+                mism += 1
+            rows.append({"faults": ft, "adversary": adv, "delivery": dl,
+                         "captured": lanes, "ok": ok})
+            print(f"preempt: restore [{ft}/{adv}/{dl}] captured {lanes} "
+                  f"lanes mid-round — {'OK' if ok else 'MISMATCH'}")
+    return {
+        "version": _lanestate.LANESTATE_VERSION,
+        "grid_points": len(points),
+        "restore_mismatches": mism,
+        "crash_window_ok": all(r["ok"] for r in rows
+                               if r["faults"] == "recover"),
+        "roundtrip_ok": mism == 0,
+        "grid": rows,
+        "lanes_round_tripped": lanes_rt,
+        "duration_s": round(time.perf_counter() - t0, 3),
+    }
+
+
+def _scenario_preempt_storm(args, seed: int) -> dict:
+    """Deadline-urgent arrivals vs a grid-holding fat rotation, twice:
+    once with preemptive scheduling (park the fat lanes, run the urgent
+    bucket, resume), once through the round-18 FIFO path on identical
+    traffic. Segment timing is sleep-dominated so the hit-rate split
+    measures scheduling, not the host; replies from BOTH legs are
+    bit-compared to each other and to the numpy oracle."""
+    from byzantinerandomizedconsensus_tpu.serve.server import ConsensusServer
+
+    n_req = _SIZES["preempt_storm"][1 if args.smoke else 0]
+    n_fat = max(2, n_req // 3)
+    n_urg = n_req - n_fat
+    fat_cfgs = [_fat_cfg(seed * 1000 + i) for i in range(n_fat)]
+    urg_cfgs = [_cfg("benor", 5, 1, seed * 1000 + 500 + i, instances=2,
+                     round_cap=16) for i in range(n_urg)]
+    deadline_ms = 2500.0
+    lat = 0.01
+
+    def hook(_msg, _sleep=time.sleep, _lat=lat):
+        _sleep(_lat)
+
+    def leg(preempt: bool):
+        with ConsensusServer(backend=args.backend, policy=args.policy,
+                             round_cap_ceiling=ROUND_CAP_CEILING,
+                             segment_hook=hook, preempt=preempt) as srv:
+            buckets = [_admission.bucket_of(fat_cfgs[0]),
+                       _admission.bucket_of(urg_cfgs[0])]
+            warm_compiles = _warm(srv, buckets, burst=3)
+            fat_handles = [srv.submit(c) for c in fat_cfgs]
+            t1 = time.monotonic()
+            while (all(h.t_dispatch is None for h in fat_handles)
+                   and time.monotonic() - t1 < 300.0):
+                time.sleep(0.005)
+            time.sleep(0.3)  # the fat rotation is mid-round when...
+            urg_handles = [srv.submit({**dataclasses.asdict(c),
+                                       "deadline_ms": deadline_ms})
+                           for c in urg_cfgs]  # ...the storm arrives
+            for h in fat_handles + urg_handles:
+                h.wait(timeout=1800.0)
+            steady = srv.compile_count() - warm_compiles
+            pstats = srv.stats()["preempt"]
+        hits = sum(1 for h in urg_handles if h.t_reply <= h.t_deadline)
+        return (round(hits / len(urg_handles), 4), fat_handles,
+                urg_handles, steady, pstats)
+
+    hit_pre, fat_p, urg_p, steady_p, pstats = leg(preempt=True)
+    hit_fifo, fat_f, urg_f, steady_f, _ = leg(preempt=False)
+
+    # one oracle pass (preempt leg), then cross-leg bit-identity: where a
+    # lane ran — parked/resumed or straight through — must never matter
+    mism = _mismatch_count(
+        [(c, h.record) for c, h in zip(fat_cfgs, fat_p)]
+        + [(c, h.record) for c, h in zip(urg_cfgs, urg_p)])
+    for a, b in zip(fat_p + urg_p, fat_f + urg_f):
+        if (a.record["rounds"] != b.record["rounds"]
+                or a.record["decision"] != b.record["decision"]):
+            mism += 1
+    slo_ok = (hit_pre > hit_fifo and pstats["parks"] >= 1
+              and pstats["resumes"] >= 1)
+    return _row("preempt_storm", seed, 2 * n_req,
+                2 * (len(fat_p) + len(urg_p)), mismatches=mism,
+                steady=steady_p + steady_f, slo_ok=slo_ok,
+                deadline_hit_rate=hit_pre, fifo_hit_rate=hit_fifo,
+                parks=pstats["parks"], resumes=pstats["resumes"],
+                lanes_exported=pstats["lanes_exported"],
+                lanes_imported=pstats["lanes_imported"],
+                fat_requests=n_fat, urgent_requests=n_urg,
+                segment_latency_s=lat)
+
+
+def _migration_sweep(args) -> dict:
+    """The r15 fat-tail fleet sweep re-run with lane-level migration on
+    (``loadgen --workers 1,2,4 --migrate``): same stream, same seed, same
+    fabric latency — the scaling claim now has serialized mid-round lanes
+    moving between workers under it. Returns the summary the preempt
+    artifact embeds (the full serve_fleet record lands beside it)."""
+    from byzantinerandomizedconsensus_tpu.tools import loadgen as _loadgen
+
+    # land beside the suite artifact under the SAME round stamp — an
+    # explicit ``--out artifacts/preempt_r23.json`` must not leave the
+    # sweep record on whatever round VERDICT.md currently parses to
+    if args.out and "preempt" in pathlib.Path(args.out).name:
+        suite = pathlib.Path(args.out)
+        out = suite.with_name(
+            suite.name.replace("preempt", "serve_fleet_migrate", 1))
+    else:
+        out = pathlib.Path(default_artifact("serve_fleet_migrate"))
+    out.parent.mkdir(parents=True, exist_ok=True)
+    rc = _loadgen.main(["--workers", "1,2,4", "--fleet-latency-ms", "60",
+                        "--requests", "200", "--seed", "15", "--rate", "4",
+                        "--migrate", "--out", str(out)])
+    doc = json.loads(out.read_text())
+    legs = doc.get("legs") or {}
+    return {
+        "artifact": out.name,
+        "exit_code": rc,
+        "workers": doc.get("workers_swept"),
+        "scaling_4w_vs_1w": (doc.get("summary") or {}).get(
+            "scaling_4w_vs_1w"),
+        "steady_state_compiles": {k: leg.get("steady_state_compiles")
+                                  for k, leg in legs.items()},
+        "migrations": {k: leg.get("migrations") for k, leg in legs.items()},
+        "lanes_migrated": {k: leg.get("lanes_migrated")
+                           for k, leg in legs.items()},
+        "differential_mismatches": (doc.get("differential") or {}).get(
+            "mismatches"),
+    }
+
+
+def _preempt_main(args) -> int:
+    """Run the round-23 preemption suite and write the schema-v1.14
+    ``lanestate`` + ``preempt`` record (``artifacts/preempt_r23.json``).
+    Exit ladder: 3 invalid record, 1 mismatch (storm differential or a
+    restore-grid divergence), 2 steady-state compiles, 5 hit-rate /
+    restore-grid gate, 4 migration-sweep scaling below the r15 bar."""
+    out = pathlib.Path(args.out or default_artifact("preempt"))
+    out.parent.mkdir(parents=True, exist_ok=True)
+
+    t0 = time.perf_counter()
+    print(f"preempt: restore bit-identity grid, seed {args.seed} …")
+    ls_stats = _restore_grid(args, args.seed * 100 + 7)
+    print(f"preempt: restore grid {ls_stats['grid_points']} points, "
+          f"{ls_stats['lanes_round_tripped']} lanes round-tripped, "
+          f"{ls_stats['restore_mismatches']} mismatches")
+
+    seed = args.seed * 100
+    print(f"preempt: [preempt_storm] seed {seed} …")
+    row = _scenario_preempt_storm(args, seed)
+    print(f"preempt: [preempt_storm] hit rate {row['deadline_hit_rate']} "
+          f"vs FIFO {row['fifo_hit_rate']}, parks {row['parks']}, "
+          f"lanes exported/imported {row['lanes_exported']}/"
+          f"{row['lanes_imported']}, mismatches {row['mismatches']}, "
+          f"steady compiles {row['steady_state_compiles']}")
+
+    sweep = None
+    if not args.smoke:
+        print("preempt: migration sweep (loadgen --workers 1,2,4 "
+              "--migrate) …")
+        sweep = _migration_sweep(args)
+        print(f"preempt: sweep scaling {sweep['scaling_4w_vs_1w']}x at 4 "
+              f"workers, migrations {sweep['migrations']}, exit "
+              f"{sweep['exit_code']}")
+
+    stats = {
+        "suite_seed": args.seed,
+        "generator_version": HOSTILE_GENERATOR_VERSION,
+        "requests": row["requests"],
+        "parks": row["parks"],
+        "resumes": row["resumes"],
+        "lanes_exported": row["lanes_exported"],
+        "lanes_imported": row["lanes_imported"],
+        "deadline_hit_rate": row["deadline_hit_rate"],
+        "fifo_hit_rate": row["fifo_hit_rate"],
+        "mismatches": row["mismatches"] + ls_stats["restore_mismatches"],
+        "steady_state_compiles": row["steady_state_compiles"],
+        "urgent_requests": row["urgent_requests"],
+        "fat_requests": row["fat_requests"],
+        "duration_s": round(time.perf_counter() - t0, 3),
+    }
+
+    doc = {
+        **record.new_record(
+            "preempt",
+            description="Serializable lane state: the snapshot/restore "
+                        "bit-identity grid across every fault x adversary "
+                        "x delivery point, the preempt_storm deadline "
+                        "drill vs the FIFO baseline, and the fat-tail "
+                        "fleet sweep with lane-level migration."),
+        "seed": args.seed,
+        "smoke": bool(args.smoke),
+        "backend": args.backend,
+        "policy": args.policy.doc(),
+        "round_cap_ceiling": ROUND_CAP_CEILING,
+        "lanestate": record.lanestate_block(ls_stats),
+        "preempt": record.preempt_block(stats),
+        "scenarios": [row],
+    }
+    if sweep is not None:
+        doc["migration_sweep"] = sweep
+    problems = record.validate_record(doc)
+    if problems:
+        print(f"preempt: INVALID RECORD: {problems}", file=sys.stderr)
+        return 3
+    out.write_text(json.dumps(doc, indent=1) + "\n")
+    print(f"preempt: wrote {out}")
+
+    if stats["mismatches"]:
+        print("preempt: DIFFERENTIAL MISMATCH", file=sys.stderr)
+        return 1
+    if stats["steady_state_compiles"]:
+        print("preempt: STEADY-STATE RECOMPILES", file=sys.stderr)
+        return 2
+    if not (row["slo_ok"] and ls_stats["roundtrip_ok"]
+            and ls_stats["crash_window_ok"]):
+        print("preempt: HIT-RATE / RESTORE GATE FAILED", file=sys.stderr)
+        return 5
+    if sweep is not None:
+        scaling = sweep["scaling_4w_vs_1w"]
+        steady_all = sum(sum(v or []) for v in
+                         sweep["steady_state_compiles"].values())
+        if (sweep["exit_code"] != 0 or scaling is None or scaling <= 3.14
+                or steady_all):
+            print(f"preempt: MIGRATION SWEEP GATE FAILED "
+                  f"(scaling {scaling}, steady {steady_all}, exit "
+                  f"{sweep['exit_code']})", file=sys.stderr)
+            return 4
+    return 0
+
+
 # ---------------------------------------------------------------- main --
 
 def _elastic_main(args) -> int:
@@ -1084,11 +1422,14 @@ def main(argv=None) -> int:
                     "traffic, every gate exit-code enforced.")
     ap.add_argument("--scenario", default="all",
                     choices=SCENARIOS + ELASTIC_SCENARIOS
-                    + ("all", "elastic"),
+                    + PREEMPT_SCENARIOS + ("all", "elastic", "preempt"),
                     help="'all' runs the six r18 hostile scenarios; "
                          "'elastic' the two r22 durability drills "
                          "(dispatcher_kill + autoscale_crowd, schema-v1.13 "
-                         "elastic record)")
+                         "elastic record); 'preempt' (or preempt_storm) "
+                         "the r23 preemption suite — restore bit-identity "
+                         "grid, preempt_storm vs FIFO, migration sweep "
+                         "(schema-v1.14 lanestate + preempt record)")
     ap.add_argument("--seed", type=int, default=18)
     ap.add_argument("--backend", default="jax")
     ap.add_argument("--policy", default="width=8,segment=1",
@@ -1112,6 +1453,8 @@ def main(argv=None) -> int:
 
     if args.scenario == "elastic" or args.scenario in ELASTIC_SCENARIOS:
         return _elastic_main(args)
+    if args.scenario == "preempt" or args.scenario in PREEMPT_SCENARIOS:
+        return _preempt_main(args)
 
     names = SCENARIOS if args.scenario == "all" else (args.scenario,)
     out = pathlib.Path(args.out or default_artifact("hostile"))

@@ -300,6 +300,10 @@ def _fleet_rows_of(name: str, doc) -> list:
                 "fleet_steals": fl.get("steals"),
                 "fleet_readmitted": fl.get("readmitted"),
                 "fleet_throughput_cps": fl.get("throughput_cps"),
+                # round 23: lane-level migration counters (absent on pre-
+                # v1.14 artifacts — whole-rotation stealing only)
+                "fleet_migrations": fl.get("migrations"),
+                "fleet_lanes_migrated": fl.get("lanes_migrated"),
             })
     return rows
 
@@ -384,6 +388,7 @@ def _committee_rows_of(name: str, doc) -> list:
     from byzantinerandomizedconsensus_tpu.obs import record as _record
 
     rows = []
+    platform = doc.get("platform") if isinstance(doc, dict) else None
     for path, cb in _blocks_of(doc, "committee", _record.COMMITTEE_BLOCK_KEYS):
         ns = cb.get("ns") if isinstance(cb.get("ns"), list) else []
         sizes = cb.get("committee_sizes")
@@ -394,6 +399,10 @@ def _committee_rows_of(name: str, doc) -> list:
         rows.append({
             "artifact": name,
             "path": path,
+            # the debt bit (round 23): a flatness headline measured off the
+            # device of record — named until the curve re-runs on TPU
+            "platform": platform,
+            "device_debt": platform not in (None, "tpu"),
             "points": len(ns),
             "n_max": max(ns) if ns else None,
             "c_max": max(sizes.values()) if sizes else None,
@@ -490,6 +499,53 @@ def _elastic_rows_of(name: str, doc) -> list:
             "slo_ms": eb.get("slo_ms"),
             "slo_ok": eb.get("slo_ok"),
             "drills": drills,
+        })
+    return rows
+
+
+def _lanestate_rows_of(name: str, doc) -> list:
+    """Schema-v1.14 ``lanestate`` blocks of one artifact: (path, snapshot
+    ABI version, restore-grid points, restore mismatches, crash-window and
+    round-trip verdicts) rows — the ledger's serialized-lane columns
+    (round 23)."""
+    from byzantinerandomizedconsensus_tpu.obs import record as _record
+
+    rows = []
+    for path, lb in _blocks_of(doc, "lanestate", _record.LANESTATE_BLOCK_KEYS):
+        rows.append({
+            "artifact": name,
+            "path": path,
+            "version": lb.get("version"),
+            "grid_points": lb.get("grid_points"),
+            "restore_mismatches": lb.get("restore_mismatches"),
+            "crash_window_ok": lb.get("crash_window_ok"),
+            "roundtrip_ok": lb.get("roundtrip_ok"),
+            "lanes_round_tripped": lb.get("lanes_round_tripped"),
+        })
+    return rows
+
+
+def _preempt_rows_of(name: str, doc) -> list:
+    """Schema-v1.14 ``preempt`` blocks of one artifact: (path, requests,
+    parks/resumes, lanes exported/imported, deadline hit-rate vs the FIFO
+    baseline, mismatches, steady-state compiles) rows — the ledger's
+    preemptive-scheduling columns (round 23)."""
+    from byzantinerandomizedconsensus_tpu.obs import record as _record
+
+    rows = []
+    for path, pb in _blocks_of(doc, "preempt", _record.PREEMPT_BLOCK_KEYS):
+        rows.append({
+            "artifact": name,
+            "path": path,
+            "requests": pb.get("requests"),
+            "parks": pb.get("parks"),
+            "resumes": pb.get("resumes"),
+            "lanes_exported": pb.get("lanes_exported"),
+            "lanes_imported": pb.get("lanes_imported"),
+            "deadline_hit_rate": pb.get("deadline_hit_rate"),
+            "fifo_hit_rate": pb.get("fifo_hit_rate"),
+            "mismatches": pb.get("mismatches"),
+            "steady_state_compiles": pb.get("steady_state_compiles"),
         })
     return rows
 
@@ -760,6 +816,14 @@ def build_ledger(root=None) -> dict:
     for name, doc in sorted(docs.items()):
         elastic_rows.extend(_elastic_rows_of(name, doc))
 
+    # ---- serialized-lane / preemption columns (schema v1.14, round 23):
+    # every committed artifact carrying a lanestate or preempt block.
+    lanestate_rows = []
+    preempt_rows = []
+    for name, doc in sorted(docs.items()):
+        lanestate_rows.extend(_lanestate_rows_of(name, doc))
+        preempt_rows.extend(_preempt_rows_of(name, doc))
+
     from byzantinerandomizedconsensus_tpu.obs import record
 
     return {
@@ -782,6 +846,8 @@ def build_ledger(root=None) -> dict:
         "fused_rows": fused_rows,
         "session_rows": session_rows,
         "elastic_rows": elastic_rows,
+        "lanestate_rows": lanestate_rows,
+        "preempt_rows": preempt_rows,
         "bench_rounds": {str(r): bench[r] for r in rounds_seen},
         "wall_chain": chain,
         "device_chain": device_chain,
@@ -1012,6 +1078,36 @@ def format_report(doc: dict) -> str:
                 f"elastic p99 {row['elastic_p99_ms']} ms vs SLO "
                 f"{row['slo_ms']} ms (static {row['static_p99_ms']} ms) — "
                 f"{drills or 'no drills'}")
+    # Present only once an artifact carries the v1.14 lanestate block.
+    if doc.get("lanestate_rows"):
+        lines.append("serialized-lane columns (schema v1.14 — "
+                     "artifact[path]: snapshot ABI, restore grid points, "
+                     "mismatches, crash-window / round-trip verdicts):")
+        for row in doc["lanestate_rows"]:
+            lines.append(
+                f"  {row['artifact']}[{row['path']}]: "
+                f"lanestate v{row['version']}, "
+                f"{row['grid_points']} grid points, "
+                f"{row['restore_mismatches']} restore mismatches, "
+                f"{row['lanes_round_tripped']} lanes round-tripped, "
+                f"crash-window {'OK' if row['crash_window_ok'] else 'FAIL'}, "
+                f"round-trip {'OK' if row['roundtrip_ok'] else 'FAIL'}")
+    # Present only once an artifact carries the v1.14 preempt block.
+    if doc.get("preempt_rows"):
+        lines.append("preemption columns (schema v1.14 — artifact[path]: "
+                     "requests, parks/resumes, lanes exported/imported, "
+                     "deadline hit-rate vs FIFO, mismatches, steady-state "
+                     "compiles):")
+        for row in doc["preempt_rows"]:
+            lines.append(
+                f"  {row['artifact']}[{row['path']}]: "
+                f"{row['requests']} requests, "
+                f"{row['parks']} parks / {row['resumes']} resumes "
+                f"({row['lanes_exported']}/{row['lanes_imported']} lanes "
+                f"out/in), deadline hit-rate {row['deadline_hit_rate']} "
+                f"vs FIFO {row['fifo_hit_rate']}, "
+                f"{row['mismatches']} mismatches, "
+                f"{row['steady_state_compiles']} steady-state compiles")
     sent = doc.get("sentinel")
     if sent is not None:
         lines.append(
@@ -1030,12 +1126,14 @@ def format_report(doc: dict) -> str:
 
 def debts_of(doc: dict) -> list:
     """The standing DEBT rows of a ledger document — claims whose evidence
-    has not yet run on the device of record. Two standing families as of
-    round 21: the r5 device-chain anchor (every later committed round is
-    CPU-only, so the noise-immune chain cannot extend) and the r20 fused
-    bit-match whose ``device_of_record`` is still ``interpret/cpu``. Pure
-    function of :func:`build_ledger`'s output so tests can feed it
-    fabricated ledgers."""
+    has not yet run on the device of record. Three standing families as of
+    round 23: the r5 device-chain anchor (every later committed round is
+    CPU-only, so the noise-immune chain cannot extend), the r20 fused
+    bit-match whose ``device_of_record`` is still ``interpret/cpu``, and
+    the r19 committee flatness curve (the x1.031 per-replica headline was
+    measured on CPU — it needs device confirmation before §10 cost claims
+    ride on it). Pure function of :func:`build_ledger`'s output so tests
+    can feed it fabricated ledgers."""
     debts = []
     dc = doc.get("device_chain") or {}
     broken = dc.get("broken_rounds") or []
@@ -1062,6 +1160,17 @@ def debts_of(doc: dict) -> list:
                              f"{row.get('mismatches')} mismatches"),
                 "closes_with": ("re-run `brc-tpu programs fused` on a TPU "
                                 "session"),
+            })
+    for row in doc.get("committee_rows") or []:
+        if row.get("device_debt"):
+            debts.append({
+                "debt": "committee-curve",
+                "where": f"{row['artifact']}[{row['path']}]",
+                "evidence": (f"per-replica flatness x"
+                             f"{row.get('flat_committee')} over "
+                             f"{row.get('n_span_committee')}x n span, "
+                             f"platform={row.get('platform')}"),
+                "closes_with": "re-run `brc-tpu committee` on a TPU session",
             })
     return debts
 
@@ -1103,7 +1212,8 @@ def main(argv=None) -> int:
                     help="print only the standing DEBT rows (claims whose "
                          "evidence has not yet run on the device of record: "
                          "the r5 device-chain anchor, the r20 fused "
-                         "interpret/cpu bit-match) as a table; exit 0")
+                         "interpret/cpu bit-match, the r19 committee "
+                         "flatness curve) as a table; exit 0")
     args = ap.parse_args(argv)
 
     doc = build_ledger(args.root)

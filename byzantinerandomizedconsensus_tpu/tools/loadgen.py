@@ -68,6 +68,16 @@ round-15 committed artifact::
         --requests 200 --seed 15 --rate 4 --trace \\
         --out artifacts/serve_fleet_r15.json
 
+**Lane migration (round 23)** — ``--migrate`` turns on lane-level fleet
+migration: an idle worker with no pending rotation left to steal pulls
+*serialized mid-round lanes* (backends/lanestate.py LaneRecords, over
+the worker protocol's export/import ops) out of the busiest peer and
+resumes them locally — work stealing below the request boundary. The
+round-23 committed sweep re-runs the r15 command with ``--migrate``
+(``artifacts/serve_fleet_migrate_r23.json``); replies stay
+bit-identical (the same fleet differential) and the per-worker
+zero-recompile pin holds — restored lanes are pure data operands.
+
 **SLO enforcement (round 16)** — ``--slo-p99-ms`` / ``--slo-error-rate``
 turn the run into a gate against the *live metrics plane*: the in-process
 server (or each fleet leg) is wrapped in a real ephemeral
@@ -701,7 +711,7 @@ def _fleet_leg(args, policy, k: int, stream, buckets,
         workers=k, mode="process", backend=args.backend, policy=policy,
         round_cap_ceiling=ROUND_CAP_CEILING, trace_dir=trace_dir,
         segment_latency_s=args.fleet_latency_ms / 1000.0,
-        rotation_cap=args.rotation_cap)
+        rotation_cap=args.rotation_cap, migrate=args.migrate)
     with fleet:
         endpoint = _MetricsEndpoint(fleet) if _slo_enabled(args) else None
         phase_scrapes = {}
@@ -768,6 +778,8 @@ def _fleet_leg(args, policy, k: int, stream, buckets,
         "per_worker": per_worker,
         "steady_state_compiles": steady,
         "steals": stats["steals"],
+        "migrations": stats.get("migrations", 0),
+        "lanes_migrated": stats.get("lanes_migrated", 0),
         "readmitted": stats["readmitted"],
         "lost_workers": stats["lost_workers"],
         "stats": stats,
@@ -840,6 +852,8 @@ def _run_fleet(args, policy, workers_list, stream, digest, cfgs, buckets,
         "throughput_cps": head["burst"]["throughput_cps"],
         "steady_state_compiles": sum(head["steady_state_compiles"]),
         "steals": head["steals"],
+        "migrations": head["migrations"],
+        "lanes_migrated": head["lanes_migrated"],
         "readmitted": head["readmitted"],
         "lost_workers": head["lost_workers"],
         "per_worker": head["per_worker"],
@@ -858,6 +872,7 @@ def _run_fleet(args, policy, workers_list, stream, digest, cfgs, buckets,
                         "steady_state_compiles":
                             legs[str(k)]["steady_state_compiles"],
                         "steals": legs[str(k)]["steals"],
+                        "migrations": legs[str(k)]["migrations"],
                         "stream_digest": digest}
                for k in workers_list}
 
@@ -922,7 +937,8 @@ def _run_fleet(args, policy, workers_list, stream, digest, cfgs, buckets,
         scale_note = (f", scaling {list(doc['summary'].values())[0]}x "
                       f"({headline_k}w vs 1w)")
     print(f"loadgen: fleet steady-state compiles {steady_total}, "
-          f"steals {head['steals']}, differential mismatches "
+          f"steals {head['steals']}, migrations {head['migrations']} "
+          f"({head['lanes_migrated']} lanes), differential mismatches "
           f"{differential['mismatches']}{scale_note}")
     if differential["mismatches"]:
         return 1
@@ -1006,6 +1022,13 @@ def main(argv=None) -> int:
     ap.add_argument("--min-amortization", type=float, default=1.5,
                     help="session bench: exit 4 if the session-vs-"
                          "independent decisions/s ratio falls below this")
+    ap.add_argument("--migrate", action="store_true",
+                    help="fleet mode: lane-level migration (round 23) — an "
+                         "idle worker with nothing left to steal pulls "
+                         "SERIALIZED mid-round lanes out of the busiest "
+                         "peer (serve.export_lanes over the worker "
+                         "protocol) and resumes them locally; replies stay "
+                         "bit-identical (backends/lanestate.py)")
     ap.add_argument("--rotation-cap", type=int, default=64,
                     help="fleet mode: max instance-lanes per dispatched "
                          "rotation (work-sharing granularity; default = one "

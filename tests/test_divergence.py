@@ -131,6 +131,58 @@ def test_binary_alphabet_adaptive_counts_model_invariant():
     np.testing.assert_array_equal(k1, expect1)
 
 
+def test_committee_leg_row_shape_and_chernoff_bound():
+    """The spec-§10 committee-vs-full-mesh leg (round 23): one live row —
+    the measured f_C tail (real §10.1 sortition on the real §3.2 faulty
+    sets) must sit under its Chernoff bound, and the committee's liveness
+    shift vs the §4b-v2 reference is a bounded TV distance with nothing
+    capped. The n=256 f=48 shape has a genuinely non-trivial tail
+    (f_C = 20 < f), so the bound comparison has discriminating power."""
+    from byzantinerandomizedconsensus_tpu.tools.divergence import (
+        COMMITTEE_GRID, committee_row)
+
+    cfg = COMMITTEE_GRID[-1]
+    assert cfg.n == 256 and cfg.f == 48
+    row = committee_row(cfg, instances=120, backend="numpy")
+    assert row["committee_c"] < cfg.n            # sortition non-degenerate
+    assert row["fc_tail_trivial"] is False
+    assert row["committees_sampled"] >= 1000
+    assert 0.0 < row["fc_tail_chernoff"] < 0.5
+    assert row["fc_bound_holds"] is True
+    assert 0.0 <= row["rounds_hist_tv_mesh_committee"] <= 1.0
+    assert row["capped_committee"] == 0.0
+    # the sortition law lands the committee at its designed size on average
+    assert abs(row["mean_committee_size_measured"] - row["committee_c"]) < 2.0
+
+
+def test_committee_leg_artifact_pinned():
+    """The committed r23 committee-vs-full-mesh rows (ROADMAP #2 leg (c)):
+    every COMMITTEE_GRID shape present, the Chernoff bound dominating the
+    measured f_C tail on every row, at least two rows with a non-trivial
+    tail, and no liveness loss (nothing capped)."""
+    import json
+    import pathlib
+
+    from byzantinerandomizedconsensus_tpu.tools.divergence import (
+        COMMITTEE_GRID)
+
+    root = pathlib.Path(__file__).resolve().parents[1]
+    doc = json.loads((root / "artifacts/divergence_r23.json").read_text())
+    rows = doc["committee_rows"]
+    assert len(rows) == len(COMMITTEE_GRID)
+    for row in rows:
+        assert row["fc_bound_holds"] is True
+        assert row["capped_committee"] == 0.0
+        assert 0.0 <= row["rounds_hist_tv_mesh_committee"] <= 1.0
+    s = doc["summary"]
+    assert s["committee_fc_bound_holds_all"] is True
+    assert s["committee_nontrivial_tail_rows"] >= 2
+    assert s["committee_max_capped"] == 0.0
+    assert s["committee_max_fc_tail_measured"] <= \
+        min(r["fc_tail_chernoff"] for r in rows
+            if not r["fc_tail_trivial"])
+
+
 def test_fault_liveness_row_shape():
     """The spec-§9 liveness leg: one config, fault-free baseline vs every
     fault kind — rows carry the TV distance and outcome stats per kind, and

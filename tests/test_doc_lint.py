@@ -62,9 +62,13 @@ def test_span_kind_census_is_nontrivial_and_complete():
                      "serve.session_slot", "serve.session_done",
                      "serve.recover", "serve.recovered", "fleet.retire",
                      "fleet.respawn", "autoscale.start", "autoscale.stop",
-                     "autoscale.up", "autoscale.down"):
+                     "autoscale.up", "autoscale.down",
+                     "compaction.snapshot", "compaction.restore",
+                     "compaction.import", "serve.preempt", "serve.park",
+                     "serve.resume", "serve.export", "serve.import",
+                     "fleet.migrate"):
         assert expected in kinds, (expected, sorted(kinds))
-    assert len(kinds) >= 60
+    assert len(kinds) >= 69
 
 
 def test_every_emitted_span_kind_is_documented():
@@ -141,9 +145,11 @@ def test_metric_name_census_is_nontrivial_and_complete():
                      "brc_wal_records_total", "brc_wal_recovered_total",
                      "brc_fleet_retired_total",
                      "brc_autoscale_target_workers",
-                     "brc_autoscale_up_total", "brc_autoscale_down_total"):
+                     "brc_autoscale_up_total", "brc_autoscale_down_total",
+                     "brc_preempt_parked_total", "brc_preempt_resumed_total",
+                     "brc_lane_migrated_total"):
         assert expected in names, (expected, sorted(names))
-    assert len(names) >= 54
+    assert len(names) >= 57
 
 
 def test_every_registered_metric_is_documented():
@@ -180,6 +186,8 @@ def test_every_record_block_key_is_documented():
         "fused": record.FUSED_BLOCK_KEYS,
         "session": record.SESSION_BLOCK_KEYS,
         "elastic": record.ELASTIC_BLOCK_KEYS,
+        "lanestate": record.LANESTATE_BLOCK_KEYS,
+        "preempt": record.PREEMPT_BLOCK_KEYS,
         "counters": ("supported", "totals"),
     }
     missing = []

@@ -64,7 +64,42 @@ def test_space_candidates_are_admissible_everywhere():
         assert FusedBucket.of(cfg) in sp.buckets()
 
 
-def test_space_bucket_universe_is_complete_and_tiny():
+def test_space_committee_scale_wing():
+    """SearchSpace(committee_scale=True) (round 23): §10 committee genomes
+    ride the pow2 tiers past n ≤ 40, every candidate decodes admissibly
+    (sortition f ceiling included), and the compiled-program universe stays
+    closed at 10 + 2·len(COMMITTEE_N_TIERS)."""
+    sp = SearchSpace(committee_scale=True)
+    buckets = sp.buckets()
+    tiers = hunt_space.COMMITTEE_N_TIERS
+    assert len(buckets) == 10 + 2 * len(tiers)
+    assert len(set(buckets)) == len(buckets)
+    assert all(t & (t - 1) == 0 for t in tiers)  # pow2, tier-exact
+    assert 1_000 <= tiers[0] and tiers[-1] <= 131_072
+
+    big = 0
+    for seed in range(120):
+        rng = random.Random(seed)
+        base = SimConfig(protocol="bracha", n=20, f=3, instances=8,
+                         adversary="adaptive", delivery="committee",
+                         seed=seed, round_cap=32).validate()
+        m = sp.mutate(base, rng)
+        m.validate()
+        assert FusedBucket.of(m) in buckets
+        if m.n > sp.max_n:
+            big += 1
+            assert m.delivery == "committee" and m.n in tiers
+            # crossing with a full-mesh parent must clamp n back under
+            # the fold — delivery gates the committee wing
+            child = sp.crossover(m, sp.sample(rng), rng)
+            child.validate()
+            assert child.delivery == "committee" or child.n <= sp.max_n
+            assert FusedBucket.of(child) in buckets
+    assert big >= 1  # the wing is actually reachable
+
+    # the default space is byte-for-byte the legacy universe
+    assert len(SearchSpace().buckets()) == 10
+    assert SearchSpace().doc()["committee_n_tiers"] == []
     """n ≤ 40 folds everything to one tier: the whole compiled-program
     universe is 2 protocols × 5 deliveries (committee joined in round 19) —
     what makes a complete warm-up (and hence the 0-steady-state-recompile

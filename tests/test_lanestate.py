@@ -1,0 +1,246 @@
+"""Serializable lane state (round 23): snapshot/restore at the segment
+boundary.
+
+The tentpole law under test: a lane parked mid-round at a segment boundary
+and restored later continues *bit-identically* — because every PRF draw is
+addressed by (key, instance, round, step) and lane placement never enters a
+draw, the restored grid replays the exact trajectory the uninterrupted grid
+would have taken. These tests pin that law
+
+  * across the fault × adversary × delivery grid on BOTH backends (the jax
+    grid compiles the same programs either way, so restore costs zero extra
+    compilations),
+  * across a crash-recovery *window* boundary (lanes captured while their
+    crashed replicas are still silent, restored into the rejoin rounds),
+  * through a JSON round-trip of the record (the exact bytes the fleet
+    worker protocol ships), including the real worker subprocess leg, and
+  * the version gate: a record from a different lanestate revision is
+    refused by name (LaneStateVersionError), never spliced.
+"""
+
+import dataclasses
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from byzantinerandomizedconsensus_tpu import SimConfig
+from byzantinerandomizedconsensus_tpu.backends import compaction
+from byzantinerandomizedconsensus_tpu.backends.batch import FusedBucket
+from byzantinerandomizedconsensus_tpu.backends.compaction import (
+    CompactionPolicy)
+from byzantinerandomizedconsensus_tpu.backends.lanestate import (
+    LANESTATE_VERSION, LaneControl, LaneRecord, LaneStateVersionError)
+from byzantinerandomizedconsensus_tpu.backends.base import get_backend
+
+_POLICY = CompactionPolicy(width=8, segment=1)
+
+
+def _fat_cfg(seed, **kw):
+    """The slow shape (tools/hostile.py's preempt grid): bracha n=10 f=3
+    under the adaptive adversary from split init runs ~35 rounds/lane
+    fault-free — segments at every round, so a park request always finds
+    live mid-round lanes to capture."""
+    base = dict(protocol="bracha", n=10, f=3, instances=16,
+                adversary="adaptive", coin="local", init="split",
+                seed=seed, round_cap=48, delivery="urn2", faults="none")
+    base.update(kw)
+    return SimConfig(**base).validate()
+
+
+def _park_restore(backend_name, cfg, *, via_json=False):
+    """Run cfg uninterrupted, then again with a park queued before the
+    first segment; restore the captured lanes in a fresh run_bucket call
+    and return (baseline, restored, records)."""
+    bk = get_backend(backend_name)
+    bucket = FusedBucket.of(cfg)
+    ids = [np.arange(cfg.instances, dtype=np.int64)]
+    res0, _, _ = compaction.run_bucket(bk, bucket, [cfg], ids,
+                                       policy=_POLICY)
+    ctl = LaneControl()
+    req = ctl.park()  # queued before start: serviced at the 1st boundary
+    hold = {}
+    t = threading.Thread(
+        target=lambda: hold.update(
+            out=compaction.run_bucket(bk, bucket, [cfg], ids,
+                                      policy=_POLICY, control=ctl)))
+    t.start()
+    recs = req.wait(60)
+    t.join(120)
+    assert not t.is_alive()
+    assert recs, "park captured no lanes at the segment boundary"
+    if via_json:
+        # the exact serialization the fleet worker protocol ships
+        recs = [LaneRecord.from_doc(json.loads(
+            json.dumps(rec.to_doc()))) for rec in recs]
+    res1, _, _ = compaction.run_bucket(bk, bucket, [], [],
+                                       policy=_POLICY, imports=recs)
+    assert len(res1) == 1
+    return res0[0], res1[0], recs
+
+
+def _assert_identical(base, restored):
+    order = np.argsort(np.asarray(base.inst_ids))
+    r_order = np.argsort(np.asarray(restored.inst_ids))
+    np.testing.assert_array_equal(
+        np.asarray(base.inst_ids)[order],
+        np.asarray(restored.inst_ids)[r_order])
+    np.testing.assert_array_equal(np.asarray(base.rounds)[order],
+                                  np.asarray(restored.rounds)[r_order])
+    np.testing.assert_array_equal(np.asarray(base.decision)[order],
+                                  np.asarray(restored.decision)[r_order])
+
+
+@pytest.mark.parametrize("faults", ["none", "partition", "omission"])
+@pytest.mark.parametrize("adversary,delivery", [
+    ("adaptive", "urn2"), ("byzantine", "urn"), ("none", "keys"),
+])
+def test_restore_bit_identity_grid_numpy(faults, adversary, delivery):
+    """Mid-round restore == uninterrupted run, exactly, across the
+    fault × adversary × delivery grid (numpy backend: bit-deterministic,
+    so this is an exact-value pin, not a statistical one)."""
+    cfg = _fat_cfg(seed=31, faults=faults, adversary=adversary,
+                   delivery=delivery)
+    base, restored, recs = _park_restore("numpy", cfg)
+    assert all(r.version == LANESTATE_VERSION for r in recs)
+    _assert_identical(base, restored)
+
+
+@pytest.mark.parametrize("faults", ["none", "partition"])
+def test_restore_bit_identity_jax(faults):
+    """The same law on the jax backend: snapshot arrays are pure data
+    operands, so the restored grid re-enters the *same* compiled program
+    and must produce the same bits."""
+    cfg = _fat_cfg(seed=32, faults=faults)
+    base, restored, _ = _park_restore("jax", cfg)
+    _assert_identical(base, restored)
+
+
+def test_restore_across_crash_window_boundary():
+    """Lanes captured while crashed replicas are still silent (inside the
+    §3.3 recovery window) restore into the rejoin rounds bit-identically —
+    the window schedule is PRF-addressed by round, so it re-derives on the
+    restored side rather than being (incorrectly) frozen at capture."""
+    cfg = _fat_cfg(seed=33, faults="recover", crash_window=12)
+    base, restored, recs = _park_restore("numpy", cfg)
+    # the park lands at the first segment boundary — round ≈ 1, well
+    # inside the 12-round window, so restored lanes cross it live
+    for rec in recs:
+        rounds_at_capture = np.asarray(rec.lanes["r"]).ravel()
+        assert (rounds_at_capture < cfg.crash_window).any(), \
+            "capture landed past the recovery window; tighten the park"
+    _assert_identical(base, restored)
+
+
+def test_record_json_roundtrip_exact():
+    """to_doc → JSON bytes → from_doc is loss-free: every lane plane and
+    bookkeeping field survives, and the runtime token never serializes."""
+    cfg = _fat_cfg(seed=34, faults="partition")
+    _, _, recs = _park_restore("numpy", cfg, via_json=True)
+    rec = recs[0]
+    doc = json.loads(json.dumps(rec.to_doc()))
+    back = LaneRecord.from_doc(doc)
+    assert back.version == rec.version == LANESTATE_VERSION
+    assert back.token is None
+    assert "token" not in doc
+    np.testing.assert_array_equal(back.ids, rec.ids)
+    np.testing.assert_array_equal(back.rounds, rec.rounds)
+    np.testing.assert_array_equal(back.decision, rec.decision)
+    assert back.remaining == rec.remaining
+    assert back.pending == rec.pending
+    for key in ("pos", "r"):
+        np.testing.assert_array_equal(
+            np.asarray(rec.lanes[key]), back.lanes[key], err_msg=key)
+    assert set(back.lanes["st"]) == set(rec.lanes["st"])
+    for key, plane in rec.lanes["st"].items():
+        np.testing.assert_array_equal(np.asarray(plane),
+                                      back.lanes["st"][key], err_msg=key)
+    assert len(back.lanes["setup"]) == len(rec.lanes["setup"])
+
+
+def test_version_mismatch_rejected_by_name():
+    """A record stamped with a foreign lanestate revision is refused with
+    LaneStateVersionError — pinned by name and message, because a silent
+    cross-version splice would corrupt lane draws undetectably."""
+    cfg = _fat_cfg(seed=35)
+    _, _, recs = _park_restore("numpy", cfg)
+    doc = recs[0].to_doc()
+    doc["version"] = LANESTATE_VERSION + 1
+    with pytest.raises(LaneStateVersionError, match="lanestate version"):
+        LaneRecord.from_doc(doc)
+    doc["version"] = 0
+    with pytest.raises(LaneStateVersionError, match="refusing to restore"):
+        LaneRecord.from_doc(doc)
+
+
+@pytest.mark.slow
+def test_worker_protocol_lane_roundtrip():
+    """The real migration wire: a fleet worker subprocess serializes an
+    in-flight request's lanes through the JSON-lines export op; importing
+    the record back (as a thieving worker would) yields a reply
+    bit-identical to an uninterrupted submit of the same config."""
+    import subprocess
+    import sys
+
+    cfg = _fat_cfg(seed=36, faults="partition", instances=24)
+    payload = dataclasses.asdict(cfg)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "byzantinerandomizedconsensus_tpu"
+         ".serve.worker", "--index", "0", "--backend", "numpy",
+         "--policy", "width=8,segment=1", "--round-cap-ceiling", "64",
+         "--segment-latency-s", "0.05"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True)
+
+    def emit(doc):
+        proc.stdin.write(json.dumps(doc) + "\n")
+        proc.stdin.flush()
+
+    def read_until(want_ops, want_id=None):
+        # a migrated request's dangling handle emits a stale fail frame
+        # (error "migrated") — filter by id so it never satisfies a wait
+        for line in proc.stdout:
+            line = line.strip()
+            if not line:
+                continue
+            msg = json.loads(line)
+            if msg.get("op") not in want_ops:
+                continue
+            if want_id is not None and msg.get("id") != want_id:
+                continue
+            return msg
+        raise AssertionError(f"worker EOF before {want_ops}")
+
+    try:
+        assert read_until({"ready"})["op"] == "ready"
+        # baseline: uninterrupted run of the config
+        emit({"op": "submit", "id": "base", "cfg": payload})
+        base = read_until({"reply", "fail"}, "base")
+        assert base["op"] == "reply", base
+        # the migration leg: submit again, export mid-flight, import back
+        lanes = []
+        for attempt in range(4):
+            fid = f"mig{attempt}"
+            emit({"op": "submit", "id": fid, "cfg": payload})
+            emit({"op": "export", "rpc": attempt, "ids": [fid]})
+            msg = read_until({"export"})
+            lanes = msg.get("lanes") or []
+            if lanes:
+                break
+            # raced a fast retirement: drain the reply and try again
+            read_until({"reply", "fail"}, fid)
+        assert lanes, "export never caught the request in flight"
+        for lane in lanes:
+            assert lane["record"]["version"] == LANESTATE_VERSION
+            emit({"op": "import", "id": "back-" + lane["id"],
+                  "record": lane["record"]})
+        restored = read_until({"reply", "fail"},
+                              "back-" + lanes[0]["id"])
+        assert restored["op"] == "reply", restored
+        for key in ("inst_ids", "rounds", "decision"):
+            assert restored["record"][key] == base["record"][key], key
+    finally:
+        emit({"op": "shutdown"})
+        proc.stdin.close()
+        proc.wait(timeout=60)

@@ -764,20 +764,75 @@ def test_census_includes_elastic_artifact():
     report = ledger.format_report(doc)
     assert "durability/autoscaling columns" in report
     assert "dispatcher_kill OK" in report and "autoscale_crowd OK" in report
-    # evidence columns, not a new debt class: the standing-debt set is
-    # untouched by the elastic block (pinned exactly in the test below)
+    # evidence columns, not a new debt class: the elastic block adds no
+    # standing debt (the full set is pinned exactly in the test below)
     assert {d["debt"] for d in ledger.debts_of(doc)} == \
-        {"device-chain", "fused-bitmatch"}
+        {"device-chain", "fused-bitmatch", "committee-curve"}
+
+
+def test_census_includes_preempt_artifact():
+    """The round-23 serialized-lane artifact: restore proven bit-identical
+    across the fault×adversary×delivery grid, the preempt_storm drill
+    beating the FIFO deadline baseline, and the lane-migration fleet sweep
+    — with the schema-v1.14 lanestate/preempt columns reconstructed by the
+    ledger and the census floor raised past it."""
+    import pathlib
+
+    from byzantinerandomizedconsensus_tpu.utils.rounds import repo_root
+
+    doc = ledger.build_ledger()
+    assert doc["parse_errors"] == []
+    assert doc["files_scanned"] >= 15
+
+    ls = {r["artifact"]: r for r in doc["lanestate_rows"]}
+    assert "artifacts/preempt_r23.json" in ls, \
+        "preempt_r23.json must yield serialized-lane columns"
+    row = ls["artifacts/preempt_r23.json"]
+    assert row["version"] >= 1
+    assert row["grid_points"] >= 12           # full fault x adversary grid
+    assert row["restore_mismatches"] == 0     # restore is bit-identical
+    assert row["crash_window_ok"] is True     # mid-crash-window included
+    assert row["roundtrip_ok"] is True
+    assert row["lanes_round_tripped"] >= 1
+
+    pr = {r["artifact"]: r for r in doc["preempt_rows"]}
+    assert "artifacts/preempt_r23.json" in pr
+    prow = pr["artifacts/preempt_r23.json"]
+    assert prow["parks"] >= 1 and prow["resumes"] >= 1
+    assert prow["lanes_exported"] >= 1 and prow["lanes_imported"] >= 1
+    assert prow["deadline_hit_rate"] > prow["fifo_hit_rate"]
+    assert prow["mismatches"] == 0
+    assert prow["steady_state_compiles"] == 0
+
+    # the lane-migration sweep artifact joins the fleet columns with the
+    # round-23 migration counters
+    fleet = [r for r in doc["fleet_rows"]
+             if r["artifact"] == "artifacts/serve_fleet_migrate_r23.json"]
+    assert fleet, "serve_fleet_migrate_r23.json must yield fleet columns"
+    assert any((r.get("fleet_migrations") or 0) >= 1 for r in fleet)
+    assert all(r["steady_state_compiles"] == 0 for r in fleet)
+
+    pv = json.loads((pathlib.Path(repo_root())
+                     / "artifacts/preempt_r23.json").read_text())
+    assert pv["kind"] == "preempt"
+    assert record.validate_record(pv) == []
+    assert pv["record_revision"] >= 14  # schema v1.14
+
+    report = ledger.format_report(doc)
+    assert "serialized-lane columns" in report
+    assert "preemption columns" in report
 
 
 def test_debts_verb_prints_standing_rows(capsys):
     """``brc-tpu ledger --debts``: the one-glance "what still owes a TPU
-    run" table. As committed, both standing families appear — the r5
-    device-chain anchor (every later round CPU-only) and the r20 fused
-    bit-match at device_of_record interpret/cpu — and the verb exits 0."""
+    run" table. As committed, all three standing families appear — the r5
+    device-chain anchor (every later round CPU-only), the r20 fused
+    bit-match at device_of_record interpret/cpu, and the r19 committee
+    flatness curve measured off-device — and the verb exits 0."""
     doc = ledger.build_ledger()
     debts = ledger.debts_of(doc)
-    assert {d["debt"] for d in debts} == {"device-chain", "fused-bitmatch"}
+    assert {d["debt"] for d in debts} == \
+        {"device-chain", "fused-bitmatch", "committee-curve"}
     for d in debts:
         assert d["where"] and d["evidence"] and d["closes_with"]
 
@@ -787,12 +842,17 @@ def test_debts_verb_prints_standing_rows(capsys):
     assert lines[1].split() == ["DEBT", "WHERE", "EVIDENCE", "CLOSES", "WITH"]
     assert any(line.startswith("device-chain") for line in lines[2:])
     assert any(line.startswith("fused-bitmatch") for line in lines[2:])
+    committee = [line for line in lines[2:]
+                 if line.startswith("committee-curve")]
+    assert committee and "x1.031" in committee[0]  # the r19 headline, named
 
     assert ledger.main(["--debts"]) == 0
     out = capsys.readouterr().out
-    assert "device-chain" in out and "fused-bitmatch" in out
+    assert "device-chain" in out and "fused-bitmatch" in out \
+        and "committee-curve" in out
 
     # a debt-free ledger renders the explicit all-clear, not an empty table
-    clean = {"device_chain": {"broken_rounds": []}, "fused_rows": []}
+    clean = {"device_chain": {"broken_rounds": []}, "fused_rows": [],
+             "committee_rows": []}
     assert ledger.format_debts(clean) == "standing debts: none"
     assert ledger.debts_of(clean) == []
